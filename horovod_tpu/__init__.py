@@ -1,0 +1,77 @@
+"""horovod_tpu — a TPU-native distributed training framework.
+
+A ground-up re-design of Horovod's contract (reference: Horovod v0.16.1,
+/root/reference) for TPU hardware: named-tensor collectives (allreduce,
+allgather, broadcast) negotiated by a rank-0 coordinator, tensor fusion,
+auto-tuning, timeline profiling and stall detection — with the data plane
+lowered to XLA collectives over a `jax.sharding.Mesh` (ICI/DCN) instead of
+MPI/NCCL, and the control plane carried by a TCP coordination service
+instead of `MPI_Gather`/`MPI_Bcast` (reference: horovod/common/operations.cc).
+
+Framework adapters live in submodules, mirroring the reference layout
+(reference: horovod/{tensorflow,torch,mxnet,keras}/__init__.py):
+
+- ``horovod_tpu.jax``   — flagship adapter: jax arrays, optax optimizers.
+- ``horovod_tpu.flax``  — flax TrainState helpers + callbacks.
+- ``horovod_tpu.torch`` — torch CPU tensors staged via dlpack.
+- ``horovod_tpu.keras`` — Keras-3 (JAX backend) callbacks.
+- ``horovod_tpu.spmd``  — in-jit SPMD collectives over the device mesh.
+- ``horovod_tpu.parallel`` — beyond-parity extensions: tensor/sequence
+  parallelism, ring attention for long context.
+
+Top-level exports are the framework-neutral basics + numpy-facing ops API,
+so ``import horovod_tpu as hvd; hvd.init(); hvd.allreduce(x)`` works with
+no framework at all (reference: horovod/common/__init__.py HorovodBasics).
+"""
+
+from horovod_tpu.version import __version__
+
+from horovod_tpu.common.basics import (
+    init,
+    shutdown,
+    initialized,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    cross_rank,
+    cross_size,
+    is_homogeneous,
+    coordinator_threads_supported,
+    mpi_threads_supported,
+)
+
+from horovod_tpu.ops import (
+    allreduce,
+    allreduce_async,
+    allgather,
+    allgather_async,
+    broadcast,
+    broadcast_async,
+    alltoall,
+    alltoall_async,
+    reducescatter,
+    reducescatter_async,
+    barrier,
+    poll,
+    synchronize,
+    Average,
+    Sum,
+)
+
+from horovod_tpu.common.compression import Compression
+
+__all__ = [
+    "__version__",
+    "init", "shutdown", "initialized",
+    "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
+    "is_homogeneous", "coordinator_threads_supported", "mpi_threads_supported",
+    "allreduce", "allreduce_async",
+    "allgather", "allgather_async",
+    "broadcast", "broadcast_async",
+    "alltoall", "alltoall_async",
+    "reducescatter", "reducescatter_async",
+    "barrier", "poll", "synchronize",
+    "Average", "Sum",
+    "Compression",
+]

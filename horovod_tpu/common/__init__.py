@@ -1,0 +1,7 @@
+"""Core runtime shared by every framework adapter.
+
+Mirrors the role of the reference's ``horovod/common`` C++ core
+(reference: horovod/common/operations.cc, global_state.h): one
+process-global runtime owning a background coordination thread; framework
+adapters only differ in how their tensors are staged into it.
+"""

@@ -1,0 +1,166 @@
+"""Process lifecycle + identity: init / shutdown / rank / size / ...
+
+Equivalent of the reference's ``HorovodBasics`` ctypes surface
+(reference: horovod/common/__init__.py:51-154) and the C API behind it
+(reference: horovod/common/operations.cc:1371-1426 horovod_init/rank/...).
+
+Identity comes from the launcher's env (``HOROVOD_RANK``/``HOROVOD_SIZE``
++ ``HOROVOD_CONTROLLER_ADDR``/``PORT``, exported by hvdtpurun — see
+horovod_tpu/run) the way the reference reads MPI's; with no env set,
+``init()`` brings up a size-1 world, which still runs the full cycle
+loop so async semantics/fusion/timeline behave identically at any size.
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Optional
+
+from horovod_tpu.common import logging as hlog
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.controller import (
+    Controller, LocalController, TcpCoordinator, TcpWorker,
+)
+from horovod_tpu.common.runtime import Runtime
+from horovod_tpu.ops.local_ops import LocalBackend
+from horovod_tpu.ops.operation_manager import OperationManager
+from horovod_tpu.ops.socket_ops import SocketBackend
+from horovod_tpu.ops.xla_ops import XlaMeshBackend
+
+_lock = threading.Lock()
+_runtime: Optional[Runtime] = None
+
+
+def _require_runtime() -> Runtime:
+    if _runtime is None:
+        raise ValueError(
+            "horovod_tpu has not been initialized; run hvd.init() first.")
+    return _runtime
+
+
+def init(comm=None, config: Optional[Config] = None) -> None:
+    """Initialize the runtime. ``comm`` accepts a (rank, size) tuple for
+    explicit worlds (reference: common/__init__.py:58-84 init(comm=...));
+    otherwise identity comes from the environment.
+    """
+    global _runtime
+    with _lock:
+        if _runtime is not None and _runtime.alive:
+            return  # already initialized (reference: InitializeHorovodOnce
+                    # test-and-set, operations.cc:1342-1360)
+        cfg = config or Config.from_env()
+        hlog.set_level(cfg.log_level)
+        if comm is not None:
+            rank, size = comm
+            cfg.rank, cfg.size = int(rank), int(size)
+        size = cfg.size if cfg.size > 0 else 1
+        rank = cfg.rank if cfg.rank >= 0 else 0
+        secret = cfg.secret_key.encode() if cfg.secret_key else b""
+
+        if size == 1:
+            controller: Controller = LocalController()
+        elif rank == 0:
+            coord = TcpCoordinator(size, port=cfg.controller_port,
+                                   secret=secret,
+                                   start_timeout=cfg.start_timeout)
+            coord.accept_workers()
+            controller = coord
+        else:
+            if not cfg.controller_addr or not cfg.controller_port:
+                raise ValueError(
+                    "HOROVOD_CONTROLLER_ADDR/PORT must be set for "
+                    "multi-process init (use the hvdtpurun launcher).")
+            controller = TcpWorker(rank, size, cfg.controller_addr,
+                                   cfg.controller_port, secret=secret,
+                                   start_timeout=cfg.start_timeout)
+
+        backends = [
+            XlaMeshBackend(lambda: controller.rank, lambda: controller.size),
+            SocketBackend(controller),
+            LocalBackend(lambda: controller.size),
+        ]
+        op_manager = OperationManager(backends)
+
+        parameter_manager = None
+        if cfg.autotune:
+            from horovod_tpu.common.parameter_manager import ParameterManager
+            parameter_manager = ParameterManager(cfg, controller)
+
+        rt = Runtime(cfg, controller, op_manager, parameter_manager)
+        rt.start()
+        _runtime = rt
+        from horovod_tpu import ops
+        ops.reset_name_counters()
+        hlog.debug(f"horovod_tpu initialized: rank {controller.rank} of "
+                   f"{controller.size}", rank=controller.rank)
+
+
+def shutdown() -> None:
+    """Stop the background loop; pending handles complete with
+    SHUT_DOWN_ERROR (reference: operations.cc:1377-1383 horovod_shutdown,
+    898-913)."""
+    global _runtime
+    with _lock:
+        rt = _runtime
+        if rt is None:
+            return
+        rt.request_shutdown()
+        rt.join(timeout=30.0)
+        _runtime = None
+
+
+atexit.register(shutdown)
+
+
+def initialized() -> bool:
+    return _runtime is not None and _runtime.alive
+
+
+def runtime() -> Runtime:
+    """Internal: the live Runtime (framework adapters use this)."""
+    return _require_runtime()
+
+
+def rank() -> int:
+    return _require_runtime().controller.topology.rank
+
+
+def size() -> int:
+    return _require_runtime().controller.topology.size
+
+
+def local_rank() -> int:
+    return _require_runtime().controller.topology.local_rank
+
+
+def local_size() -> int:
+    return _require_runtime().controller.topology.local_size
+
+
+def cross_rank() -> int:
+    """Rank among hosts (reference: global_state.h cross_rank)."""
+    return _require_runtime().controller.topology.cross_rank
+
+
+def cross_size() -> int:
+    return _require_runtime().controller.topology.cross_size
+
+
+def is_homogeneous() -> bool:
+    """True when every host runs the same number of ranks
+    (reference: operations.cc:741-757)."""
+    return _require_runtime().controller.topology.is_homogeneous
+
+
+def coordinator_threads_supported() -> bool:
+    """Enqueues may come from any thread (the table is mutex-guarded),
+    so multi-threaded use is always supported — unlike the reference,
+    where this depends on MPI_THREAD_MULTIPLE
+    (reference: operations.cc:674-693, common/__init__.py:150-154)."""
+    return True
+
+
+def mpi_threads_supported() -> bool:
+    """Reference-compat alias for coordinator_threads_supported."""
+    return coordinator_threads_supported()

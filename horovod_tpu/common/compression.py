@@ -1,0 +1,91 @@
+"""Gradient compression algorithms used during allreduce.
+
+(reference: horovod/tensorflow/compression.py:1-74 and the identically
+shaped horovod/torch/compression.py). The reference offers none/fp16;
+on TPU the natural wire type is bfloat16 — same byte savings as fp16 but
+with float32's exponent range, so no loss-scaling is needed — so we add
+``Compression.bf16`` and make it the recommended choice.
+
+Works on anything with a ``dtype`` and ``astype`` (numpy or jax arrays).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _astype(tensor, dtype):
+    # jax arrays and numpy arrays both have .astype; jax inside jit too.
+    return tensor.astype(dtype)
+
+
+def _is_floating(tensor) -> bool:
+    d = np.dtype(tensor.dtype) if not hasattr(tensor.dtype, "name") \
+        else tensor.dtype
+    name = getattr(d, "name", str(d))
+    return name in ("float16", "float32", "float64", "bfloat16")
+
+
+class Compressor:
+    """Interface to compress and decompress a tensor
+    (reference: compression.py:22-33)."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """No-op (reference: compression.py:36-44)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    _wire_dtype: str = "float16"
+
+    @classmethod
+    def compress(cls, tensor):
+        ctx = tensor.dtype
+        if _is_floating(tensor):
+            if cls._wire_dtype == "bfloat16":
+                import ml_dtypes
+                wire = np.dtype(ml_dtypes.bfloat16)
+            else:
+                wire = np.dtype(cls._wire_dtype)
+            tensor = _astype(tensor, wire)
+        return tensor, ctx
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        if ctx is not None and _is_floating(tensor):
+            tensor = _astype(tensor, ctx)
+        return tensor
+
+
+class FP16Compressor(_CastCompressor):
+    """Cast to float16 on the wire (reference: compression.py:46-64)."""
+    _wire_dtype = "float16"
+
+
+class BF16Compressor(_CastCompressor):
+    """Cast to bfloat16 on the wire — TPU-native extension; bf16 is the
+    MXU/ICI-preferred reduced-precision type."""
+    _wire_dtype = "bfloat16"
+
+
+class Compression:
+    """Option enum-alike (reference: compression.py:67-73)."""
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

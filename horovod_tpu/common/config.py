@@ -1,0 +1,145 @@
+"""Environment-variable configuration surface.
+
+The reference reads all knobs from ``HOROVOD_*`` env vars once at
+background-thread startup (reference: horovod/common/operations.cc:626-639
+helpers and 792-871). We keep the exact same names so scripts tuned for
+the reference carry over, plus ``HOROVOD_TPU_*`` extensions for the
+TPU-specific machinery.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        return default
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    # Reference semantics: set and == "1" → on (operations.cc:626-631).
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return v.strip() in ("1", "true", "True", "TRUE", "yes", "on")
+
+
+@dataclasses.dataclass
+class Config:
+    """Snapshot of all runtime knobs, read once at init.
+
+    Defaults follow the reference: 64 MiB fusion threshold
+    (operations.cc:807-812), 5 ms cycle time (operations.cc:815-820),
+    60 s stall check (operations.cc:543-624).
+    """
+
+    # Tensor fusion (reference: operations.cc:424-446, 807-820)
+    fusion_threshold_bytes: int = 64 * 1024 * 1024
+    cycle_time_ms: float = 5.0
+
+    # Hierarchical collectives (reference: operations.cc:822-841); on TPU
+    # this selects ICI×DCN mesh-axis-factored collectives (read by the
+    # spmd hierarchical helpers; the flat TCP/XLA backends ignore it).
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+
+    # Timeline (reference: operations.cc:792-798)
+    timeline_path: str = ""
+    timeline_mark_cycles: bool = False
+
+    # Stall detection (reference: operations.cc:543-624)
+    stall_check_disable: bool = False
+    stall_check_time_seconds: float = 60.0
+    stall_shutdown_time_seconds: float = 0.0
+
+    # Autotune (reference: operations.cc:862-871, parameter_manager.cc)
+    autotune: bool = False
+    autotune_log: str = ""
+    autotune_warmup_samples: int = 3
+    autotune_steps_per_sample: int = 10
+    autotune_bayes_opt_max_samples: int = 20
+    autotune_gaussian_process_noise: float = 0.8
+
+    # Logging (reference: logging.h, HOROVOD_LOG_LEVEL)
+    log_level: str = "warning"
+    log_hide_time: bool = False
+
+    # Control plane (TPU-native: TCP coordination service instead of MPI).
+    # Rendezvous address of the rank-0 coordinator.
+    controller_addr: str = ""
+    controller_port: int = 0
+    secret_key: str = ""
+    start_timeout: float = 30.0
+
+    # Native C++ core (horovod_tpu/native). On by default when the shared
+    # library is importable; HOROVOD_TPU_NATIVE=0 forces pure-Python.
+    native_core: bool = True
+
+    # Elastic/launcher-provided identity (reference: test/common.py:25-57
+    # reads OMPI_COMM_WORLD_RANK; we read HOROVOD_RANK/SIZE first).
+    rank: int = -1
+    size: int = -1
+    local_rank: int = -1
+    local_size: int = -1
+
+    @staticmethod
+    def from_env() -> "Config":
+        c = Config()
+        c.fusion_threshold_bytes = _env_int(
+            "HOROVOD_FUSION_THRESHOLD", c.fusion_threshold_bytes)
+        c.cycle_time_ms = _env_float("HOROVOD_CYCLE_TIME", c.cycle_time_ms)
+        c.hierarchical_allreduce = _env_bool(
+            "HOROVOD_HIERARCHICAL_ALLREDUCE", c.hierarchical_allreduce)
+        c.hierarchical_allgather = _env_bool(
+            "HOROVOD_HIERARCHICAL_ALLGATHER", c.hierarchical_allgather)
+        c.timeline_path = os.environ.get("HOROVOD_TIMELINE", "")
+        c.timeline_mark_cycles = _env_bool(
+            "HOROVOD_TIMELINE_MARK_CYCLES", c.timeline_mark_cycles)
+        c.stall_check_disable = _env_bool(
+            "HOROVOD_STALL_CHECK_DISABLE", c.stall_check_disable)
+        c.stall_check_time_seconds = _env_float(
+            "HOROVOD_STALL_CHECK_TIME_SECONDS", c.stall_check_time_seconds)
+        c.stall_shutdown_time_seconds = _env_float(
+            "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS",
+            c.stall_shutdown_time_seconds)
+        c.autotune = _env_bool("HOROVOD_AUTOTUNE", c.autotune)
+        c.autotune_log = os.environ.get("HOROVOD_AUTOTUNE_LOG", "")
+        c.autotune_warmup_samples = _env_int(
+            "HOROVOD_AUTOTUNE_WARMUP_SAMPLES", c.autotune_warmup_samples)
+        c.autotune_steps_per_sample = _env_int(
+            "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", c.autotune_steps_per_sample)
+        c.autotune_bayes_opt_max_samples = _env_int(
+            "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES",
+            c.autotune_bayes_opt_max_samples)
+        c.autotune_gaussian_process_noise = _env_float(
+            "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE",
+            c.autotune_gaussian_process_noise)
+        c.log_level = os.environ.get("HOROVOD_LOG_LEVEL", c.log_level)
+        c.log_hide_time = _env_bool("HOROVOD_LOG_HIDE_TIME", c.log_hide_time)
+        c.controller_addr = os.environ.get("HOROVOD_CONTROLLER_ADDR", "")
+        c.controller_port = _env_int("HOROVOD_CONTROLLER_PORT", 0)
+        c.secret_key = os.environ.get("HOROVOD_SECRET_KEY", "")
+        c.start_timeout = _env_float("HOROVOD_START_TIMEOUT", c.start_timeout)
+        c.native_core = _env_bool("HOROVOD_TPU_NATIVE", c.native_core)
+        c.rank = _env_int("HOROVOD_RANK", c.rank)
+        c.size = _env_int("HOROVOD_SIZE", c.size)
+        c.local_rank = _env_int("HOROVOD_LOCAL_RANK", c.local_rank)
+        c.local_size = _env_int("HOROVOD_LOCAL_SIZE", c.local_size)
+        return c

@@ -1,0 +1,314 @@
+"""Control-plane controllers: how ranks exchange Request/Response lists.
+
+The reference's control plane is MPI on a private duplicated communicator:
+each cycle, workers ``MPI_Gather`` + ``MPI_Gatherv`` their serialized
+``RequestList`` to rank 0 and receive the fused ``ResponseList`` via
+``MPI_Bcast`` (reference: horovod/common/operations.cc:1044-1065 and
+1281-1302). A TPU pod has no MPI; this module supplies the same three
+primitives — gather-to-coordinator, broadcast-from-coordinator, identity
+metadata — over persistent HMAC'd TCP connections, plus a trivial
+in-process controller for size-1 worlds.
+
+The handshake also computes local/cross topology: ranks are grouped by
+hostname exactly like the reference's ``MPI_Comm_split_type(SHARED)`` +
+``MPI_Comm_split(local_rank)`` construction
+(reference: operations.cc:729-764, run/common/util/host_hash.py).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from typing import Dict, List, Optional
+
+from horovod_tpu.common import logging as hlog
+from horovod_tpu.common import network
+
+# Frame tags on the controller channel.
+TAG_HANDSHAKE = 1
+TAG_REQUESTS = 2    # worker -> coordinator: serialized RequestList
+TAG_RESPONSES = 3   # coordinator -> worker: serialized ResponseList
+TAG_DATA = 4        # data-plane payload (socket fallback backend)
+
+
+class Topology:
+    """World/local/cross identity of this process
+    (reference: global_state.h:95-118)."""
+
+    __slots__ = ("rank", "size", "local_rank", "local_size",
+                 "cross_rank", "cross_size", "is_homogeneous",
+                 "local_sizes")
+
+    def __init__(self, rank: int, size: int, local_rank: int = 0,
+                 local_size: int = 1, cross_rank: int = 0,
+                 cross_size: int = 1, is_homogeneous: bool = True,
+                 local_sizes: Optional[List[int]] = None):
+        self.rank = rank
+        self.size = size
+        self.local_rank = local_rank
+        self.local_size = local_size
+        self.cross_rank = cross_rank
+        self.cross_size = cross_size
+        self.is_homogeneous = is_homogeneous
+        self.local_sizes = local_sizes or [local_size]
+
+
+def compute_topology(rank: int, hostnames: List[str]) -> Topology:
+    """Group ranks by hostname → local/cross communicator shape
+    (reference: operations.cc:729-764; homogeneity check 741-757)."""
+    size = len(hostnames)
+    my_host = hostnames[rank]
+    local_ranks = [r for r in range(size) if hostnames[r] == my_host]
+    local_rank = local_ranks.index(rank)
+    local_size = len(local_ranks)
+    # cross communicator: one member per host, split by local_rank
+    hosts_in_order: List[str] = []
+    for h in hostnames:
+        if h not in hosts_in_order:
+            hosts_in_order.append(h)
+    cross_rank = hosts_in_order.index(my_host)
+    cross_size = len(hosts_in_order)
+    local_sizes = [sum(1 for h in hostnames if h == host)
+                   for host in hosts_in_order]
+    is_homogeneous = all(s == local_sizes[0] for s in local_sizes)
+    return Topology(rank=rank, size=size, local_rank=local_rank,
+                    local_size=local_size, cross_rank=cross_rank,
+                    cross_size=cross_size, is_homogeneous=is_homogeneous,
+                    local_sizes=local_sizes)
+
+
+class Controller:
+    """Abstract control plane."""
+
+    topology: Topology
+
+    @property
+    def rank(self) -> int:
+        return self.topology.rank
+
+    @property
+    def size(self) -> int:
+        return self.topology.size
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.rank == 0
+
+    def gather_requests(self, payload: bytes) -> Optional[List[bytes]]:
+        """Coordinator: returns all ranks' serialized RequestLists
+        (index = rank), including its own. Workers: send and return None."""
+        raise NotImplementedError
+
+    def broadcast_responses(self, payload: Optional[bytes]) -> bytes:
+        """Coordinator passes the serialized ResponseList; workers pass
+        None. Everyone returns the broadcast bytes."""
+        raise NotImplementedError
+
+    # Data-plane helpers for the socket fallback backend -----------------
+    def gather_data(self, payload: bytes) -> Optional[List[bytes]]:
+        raise NotImplementedError
+
+    def broadcast_data(self, payload: Optional[bytes],
+                       root_rank: int = 0) -> bytes:
+        raise NotImplementedError
+
+    def scatter_data(self, payloads: Optional[List[bytes]]) -> bytes:
+        """Coordinator passes one payload per rank; every rank returns
+        its own."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class LocalController(Controller):
+    """Size-1 world: negotiation is immediate."""
+
+    def __init__(self):
+        self.topology = Topology(rank=0, size=1)
+
+    def gather_requests(self, payload: bytes) -> Optional[List[bytes]]:
+        return [payload]
+
+    def broadcast_responses(self, payload: Optional[bytes]) -> bytes:
+        assert payload is not None
+        return payload
+
+    def gather_data(self, payload: bytes) -> Optional[List[bytes]]:
+        return [payload]
+
+    def broadcast_data(self, payload: Optional[bytes],
+                       root_rank: int = 0) -> bytes:
+        assert payload is not None
+        return payload
+
+    def scatter_data(self, payloads: Optional[List[bytes]]) -> bytes:
+        assert payloads is not None and len(payloads) == 1
+        return payloads[0]
+
+
+class TcpCoordinator(Controller):
+    """Rank 0: accepts one persistent connection per worker."""
+
+    def __init__(self, size: int, port: int = 0, secret: bytes = b"",
+                 start_timeout: float = 30.0):
+        self._secret = secret
+        self._server = network.listen(port)
+        self.port = self._server.getsockname()[1]
+        self._channels: Dict[int, network.Channel] = {}
+        self._hostname = socket.gethostname()
+        self._size = size
+        self._start_timeout = start_timeout
+        self.topology = None  # set by accept_workers
+
+    def accept_workers(self) -> None:
+        deadline = time.monotonic() + self._start_timeout
+        hostnames = [None] * self._size
+        hostnames[0] = self._hostname
+        self._server.settimeout(1.0)
+        while len(self._channels) < self._size - 1:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"Only {len(self._channels) + 1}/{self._size} ranks "
+                    f"connected within start timeout; increase "
+                    f"HOROVOD_START_TIMEOUT if startup is slow.")
+            try:
+                sock, _ = self._server.accept()
+            except socket.timeout:
+                continue
+            # A stray probe, a garbage frame, or a worker dying
+            # mid-handshake must not abort startup — reject the
+            # connection and keep waiting for legitimate workers.
+            try:
+                sock.settimeout(5.0)
+                ch = network.Channel(sock, self._secret)
+                tag, payload = ch.recv()
+                if tag != TAG_HANDSHAKE:
+                    raise ConnectionError(f"unexpected tag {tag}")
+                hello = json.loads(payload.decode())
+                r = int(hello["rank"])
+                if r <= 0 or r >= self._size or r in self._channels:
+                    raise ConnectionError(f"bad or duplicate rank {r}")
+            except (ConnectionError, socket.timeout, ValueError,
+                    KeyError, UnicodeDecodeError) as e:
+                hlog.warning(f"rejected connection during startup: {e}",
+                             rank=0)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
+            sock.settimeout(None)
+            hostnames[r] = hello["hostname"]
+            self._channels[r] = ch
+        # Broadcast the full hostname list so every rank derives the same
+        # topology (reference: operations.cc:729-764).
+        blob = json.dumps({"hostnames": hostnames}).encode()
+        for r, ch in self._channels.items():
+            ch.send(blob, TAG_HANDSHAKE)
+        self.topology = compute_topology(0, hostnames)
+        hlog.debug(f"coordinator up: {self._size} ranks, "
+                   f"{self.topology.cross_size} hosts", rank=0)
+
+    def gather_requests(self, payload: bytes) -> Optional[List[bytes]]:
+        out: List[bytes] = [b""] * self._size
+        out[0] = payload
+        for r, ch in self._channels.items():
+            tag, data = ch.recv()
+            if tag != TAG_REQUESTS:
+                raise ConnectionError(
+                    f"expected TAG_REQUESTS from rank {r}, got {tag}")
+            out[r] = data
+        return out
+
+    def broadcast_responses(self, payload: Optional[bytes]) -> bytes:
+        assert payload is not None
+        for ch in self._channels.values():
+            ch.send(payload, TAG_RESPONSES)
+        return payload
+
+    def gather_data(self, payload: bytes) -> Optional[List[bytes]]:
+        out: List[bytes] = [b""] * self._size
+        out[0] = payload
+        for r, ch in self._channels.items():
+            tag, data = ch.recv()
+            if tag != TAG_DATA:
+                raise ConnectionError(
+                    f"expected TAG_DATA from rank {r}, got {tag}")
+            out[r] = data
+        return out
+
+    def broadcast_data(self, payload: Optional[bytes],
+                       root_rank: int = 0) -> bytes:
+        if root_rank != 0:
+            # Pull the payload up from the root, then fan out.
+            tag, payload = self._channels[root_rank].recv()
+            if tag != TAG_DATA:
+                raise ConnectionError("expected TAG_DATA from root")
+        assert payload is not None
+        for ch in self._channels.values():
+            ch.send(payload, TAG_DATA)
+        return payload
+
+    def scatter_data(self, payloads: Optional[List[bytes]]) -> bytes:
+        assert payloads is not None and len(payloads) == self._size
+        for r, ch in self._channels.items():
+            ch.send(payloads[r], TAG_DATA)
+        return payloads[0]
+
+    def close(self) -> None:
+        for ch in self._channels.values():
+            ch.close()
+        self._server.close()
+
+
+class TcpWorker(Controller):
+    """Ranks 1..size-1: one persistent connection to the coordinator."""
+
+    def __init__(self, rank: int, size: int, addr: str, port: int,
+                 secret: bytes = b"", start_timeout: float = 30.0):
+        self._ch = network.connect(addr, port, secret,
+                                   timeout=start_timeout,
+                                   retry_deadline=start_timeout)
+        hello = json.dumps({
+            "rank": rank, "hostname": socket.gethostname()}).encode()
+        self._ch.send(hello, TAG_HANDSHAKE)
+        tag, payload = self._ch.recv()
+        if tag != TAG_HANDSHAKE:
+            raise ConnectionError("handshake failed")
+        hostnames = json.loads(payload.decode())["hostnames"]
+        self.topology = compute_topology(rank, hostnames)
+
+    def gather_requests(self, payload: bytes) -> Optional[List[bytes]]:
+        self._ch.send(payload, TAG_REQUESTS)
+        return None
+
+    def broadcast_responses(self, payload: Optional[bytes]) -> bytes:
+        tag, data = self._ch.recv()
+        if tag != TAG_RESPONSES:
+            raise ConnectionError(f"expected TAG_RESPONSES, got {tag}")
+        return data
+
+    def gather_data(self, payload: bytes) -> Optional[List[bytes]]:
+        self._ch.send(payload, TAG_DATA)
+        return None
+
+    def broadcast_data(self, payload: Optional[bytes],
+                       root_rank: int = 0) -> bytes:
+        if payload is not None and self.rank == root_rank:
+            self._ch.send(payload, TAG_DATA)
+        tag, data = self._ch.recv()
+        if tag != TAG_DATA:
+            raise ConnectionError(f"expected TAG_DATA, got {tag}")
+        return data
+
+    def scatter_data(self, payloads: Optional[List[bytes]]) -> bytes:
+        tag, data = self._ch.recv()
+        if tag != TAG_DATA:
+            raise ConnectionError(f"expected TAG_DATA, got {tag}")
+        return data
+
+    def close(self) -> None:
+        self._ch.close()

@@ -1,0 +1,342 @@
+"""Rank-0 coordinator: readiness counting, response construction, fusion.
+
+This is the negotiation brain of the framework — the part of the
+reference's background loop that turns independently-ordered per-rank
+requests into one globally agreed, validated, fused execution order
+(reference: horovod/common/operations.cc — ``IncrementTensorCount``
+163-189, ``ConstructResponse`` 197-399, the fusion batching loop
+1118-1234, ``CheckForStalledTensors`` 543-624).
+
+On TPU this total order matters twice: it preserves Horovod's contract
+(any rank may submit in any order) *and* it is exactly the guarantee
+multi-controller JAX needs — every process must issue identical XLA
+computations in identical order, which the broadcast ResponseList
+provides by construction.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from horovod_tpu.common import logging as hlog
+from horovod_tpu.common.message import (
+    DataType, Request, RequestType, Response, ResponseType, datatype_name,
+    datatype_size,
+)
+
+
+class _TensorRecord:
+    __slots__ = ("requests", "first_request_time")
+
+    def __init__(self):
+        self.requests: List[Request] = []
+        self.first_request_time = time.monotonic()
+
+
+class MessageTable:
+    """Pending negotiations: tensor name → requests received so far
+    (reference: global_state.h:120-125, operations.cc:110-117)."""
+
+    def __init__(self):
+        self._table: Dict[str, _TensorRecord] = {}
+        # FIFO of names that became ready this cycle, in readiness order
+        # (reference: operations.cc ready_to_reduce, 1069-1079).
+        self._ready: List[str] = []
+
+    def increment_tensor_count(self, msg: Request, size: int,
+                               timeline=None) -> bool:
+        """Record one rank's request; True when all ``size`` ranks have
+        reported (reference: operations.cc:163-189)."""
+        name = msg.tensor_name
+        rec = self._table.get(name)
+        if rec is None:
+            rec = _TensorRecord()
+            self._table[name] = rec
+            if timeline is not None:
+                timeline.negotiate_start(name, msg.request_type)
+        rec.requests.append(msg)
+        if timeline is not None:
+            timeline.negotiate_rank_ready(name, msg.request_rank)
+        ready = len(rec.requests) == size
+        if ready:
+            self._ready.append(name)
+        return ready
+
+    def pop_ready(self) -> List[str]:
+        ready = self._ready
+        self._ready = []
+        return ready
+
+    def requests_for(self, name: str) -> List[Request]:
+        return self._table[name].requests
+
+    def remove(self, name: str) -> None:
+        del self._table[name]
+
+    def pending(self) -> List[Tuple[str, float, List[int]]]:
+        """(name, age_seconds, ranks_reported) for stall reporting."""
+        now = time.monotonic()
+        return [(name, now - rec.first_request_time,
+                 sorted(r.request_rank for r in rec.requests))
+                for name, rec in self._table.items()]
+
+    def __len__(self):
+        return len(self._table)
+
+
+def construct_response(table: MessageTable, name: str,
+                       size: int) -> Response:
+    """Build the (validated) Response for a fully-negotiated tensor
+    (reference: operations.cc:197-399). Removes the entry from the table.
+
+    Validation performed across ranks, any failure → ERROR response that
+    every requesting rank surfaces as an exception:
+    - mismatched collective op
+    - mismatched dtype
+    - mismatched shapes (allreduce/broadcast/reducescatter: all dims;
+      allgather/alltoall: all dims but dim 0)
+    - mismatched root ranks (broadcast)
+    - mixed host/device placement
+    """
+    requests = table.requests_for(name)
+    assert len(requests) == size
+
+    error = None
+
+    first = requests[0]
+    # Op consistency (reference: operations.cc:223-237).
+    for req in requests[1:]:
+        if req.request_type != first.request_type:
+            error = ("Mismatched collective operations requested: one rank "
+                     f"requested {first.request_type.name}, another rank "
+                     f"requested {req.request_type.name}.")
+            break
+
+    # Dtype consistency (reference: operations.cc:205-221).
+    if error is None:
+        for req in requests[1:]:
+            if req.tensor_type != first.tensor_type:
+                error = ("Mismatched data types: one rank sent "
+                         f"{datatype_name(first.tensor_type)}, another rank "
+                         f"sent {datatype_name(req.tensor_type)}.")
+                break
+
+    # Placement consistency (reference: operations.cc:352-365 CPU-vs-GPU).
+    if error is None:
+        on_device = [req.device >= 0 for req in requests]
+        if any(on_device) and not all(on_device):
+            error = ("Mismatched tensor placement: some ranks submitted "
+                     "host tensors while others submitted device tensors.")
+
+    op = first.request_type
+    tensor_sizes: List[int] = []
+
+    if error is None and op in (RequestType.ALLREDUCE,
+                                RequestType.BROADCAST,
+                                RequestType.REDUCESCATTER,
+                                RequestType.ALLTOALL):
+        # Exact shape match (reference: operations.cc:240-260).
+        for req in requests[1:]:
+            if req.tensor_shape != first.tensor_shape:
+                error = (f"Mismatched {op.name.lower()} tensor shapes: one "
+                         f"rank sent a tensor of shape "
+                         f"{list(first.tensor_shape)}, another rank sent a "
+                         f"tensor of shape {list(req.tensor_shape)}.")
+                break
+
+    if error is None and op == RequestType.ALLGATHER:
+        # Same rank; same dims except dim 0 (reference: 262-319).
+        for req in requests[1:]:
+            if len(req.tensor_shape) != len(first.tensor_shape):
+                error = (f"Mismatched {op.name.lower()} tensor ranks: one "
+                         f"rank sent a {len(first.tensor_shape)}-d tensor, "
+                         f"another rank sent a "
+                         f"{len(req.tensor_shape)}-d tensor.")
+                break
+            if req.tensor_shape[1:] != first.tensor_shape[1:]:
+                error = (f"Mismatched {op.name.lower()} tensor shapes: "
+                         "dimensions beyond the first must match on every "
+                         f"rank; got {list(first.tensor_shape)} and "
+                         f"{list(req.tensor_shape)}.")
+                break
+        if error is None:
+            if not first.tensor_shape:
+                error = (f"Rank zero tensors cannot be "
+                         f"{op.name.lower()}ed: at least one dimension is "
+                         "required.")
+            else:
+                # dim-0 size per rank, in rank order (reference: 300-316).
+                by_rank = sorted(requests, key=lambda r: r.request_rank)
+                tensor_sizes = [r.tensor_shape[0] for r in by_rank]
+
+    if error is None and op == RequestType.ALLTOALL:
+        if not first.tensor_shape or first.tensor_shape[0] % size != 0:
+            error = ("alltoall requires the first dimension to be "
+                     f"divisible by the world size {size}; got shape "
+                     f"{list(first.tensor_shape)}.")
+
+    if error is None and op == RequestType.REDUCESCATTER:
+        if not first.tensor_shape or first.tensor_shape[0] % size != 0:
+            error = ("reducescatter requires the first dimension to be "
+                     f"divisible by the world size {size}; got shape "
+                     f"{list(first.tensor_shape)}.")
+
+    if error is None and op == RequestType.BROADCAST:
+        # Root rank consistency (reference: operations.cc:321-337).
+        for req in requests[1:]:
+            if req.root_rank != first.root_rank:
+                error = ("Mismatched broadcast root ranks: one rank "
+                         f"specified root rank {first.root_rank}, another "
+                         f"rank specified root rank {req.root_rank}.")
+                break
+        if error is None and not (0 <= first.root_rank < size):
+            error = (f"Invalid broadcast root rank {first.root_rank} for "
+                     f"world size {size}.")
+
+    devices = [0] * size
+    for req in requests:
+        devices[req.request_rank] = req.device
+
+    table.remove(name)
+
+    if error is not None:
+        return Response(response_type=ResponseType.ERROR,
+                        tensor_names=[name], error_message=error)
+
+    if op == RequestType.ALLREDUCE:
+        numel = 1
+        for d in first.tensor_shape:
+            numel *= d
+        return Response(response_type=ResponseType.ALLREDUCE,
+                        tensor_names=[name], devices=devices,
+                        tensor_sizes=[numel],
+                        prescale_factor=first.prescale_factor,
+                        postscale_factor=first.postscale_factor)
+    if op == RequestType.ALLGATHER:
+        return Response(response_type=ResponseType.ALLGATHER,
+                        tensor_names=[name], devices=devices,
+                        tensor_sizes=tensor_sizes)
+    if op == RequestType.BROADCAST:
+        return Response(response_type=ResponseType.BROADCAST,
+                        tensor_names=[name], devices=devices)
+    if op == RequestType.ALLTOALL:
+        return Response(response_type=ResponseType.ALLTOALL,
+                        tensor_names=[name], devices=devices)
+    if op == RequestType.REDUCESCATTER:
+        numel = 1
+        for d in first.tensor_shape:
+            numel *= d
+        return Response(response_type=ResponseType.REDUCESCATTER,
+                        tensor_names=[name], devices=devices,
+                        tensor_sizes=[numel])
+    if op == RequestType.BARRIER:
+        return Response(response_type=ResponseType.BARRIER,
+                        tensor_names=[name])
+    # JOIN (elastic membership) is wire-defined for forward compat but
+    # not implemented; answer with ERROR rather than killing the loop.
+    return Response(response_type=ResponseType.ERROR, tensor_names=[name],
+                    error_message=f"Operation {op.name} is not supported "
+                    "by this coordinator.")
+
+
+def _response_bytes(resp: Response, dtype: DataType) -> int:
+    return sum(resp.tensor_sizes) * datatype_size(dtype)
+
+
+def fuse_responses(responses: List[Response],
+                   dtypes: Dict[str, DataType],
+                   fusion_threshold_bytes: int) -> List[Response]:
+    """Batch compatible consecutive ALLREDUCE responses under the fusion
+    threshold, with the reference's look-ahead-skip behaviour: a tensor
+    that cannot join the current batch does not end it — later compatible
+    tensors may still join, and skipped ones are retried in order
+    (reference: horovod/common/operations.cc:1118-1234).
+
+    ``dtypes`` maps tensor name → dtype (fusion requires same dtype and
+    same device placement; we fuse host-side entries and device entries
+    separately via the devices signature).
+    """
+    queue = list(responses)
+    fused: List[Response] = []
+    while queue:
+        resp = queue.pop(0)
+        if resp.response_type != ResponseType.ALLREDUCE:
+            fused.append(resp)
+            continue
+        dtype = dtypes[resp.tensor_names[0]]
+        tensor_bytes = _response_bytes(resp, dtype)
+        if tensor_bytes >= fusion_threshold_bytes:
+            fused.append(resp)
+            continue
+        skipped: List[Response] = []
+        while queue:
+            cand = queue.pop(0)
+            joinable = (
+                cand.response_type == ResponseType.ALLREDUCE
+                and dtypes[cand.tensor_names[0]] == dtype
+                and cand.devices == resp.devices
+                and cand.prescale_factor == resp.prescale_factor
+                and cand.postscale_factor == resp.postscale_factor
+                and tensor_bytes + _response_bytes(cand, dtype)
+                    <= fusion_threshold_bytes)
+            if joinable:
+                for n in cand.tensor_names:
+                    resp.add_tensor_name(n)
+                for s in cand.tensor_sizes:
+                    resp.add_tensor_size(s)
+                tensor_bytes += _response_bytes(cand, dtype)
+            else:
+                skipped.append(cand)
+        queue = skipped
+        fused.append(resp)
+    return fused
+
+
+class StallInspector:
+    """Coordinator-side stall detection
+    (reference: operations.cc:543-624 CheckForStalledTensors; env knobs
+    HOROVOD_STALL_CHECK_TIME_SECONDS / HOROVOD_STALL_SHUTDOWN_TIME_SECONDS)."""
+
+    def __init__(self, size: int, warning_time: float = 60.0,
+                 shutdown_time: float = 0.0, disabled: bool = False):
+        self.size = size
+        self.warning_time = warning_time
+        self.shutdown_time = shutdown_time
+        self.disabled = disabled
+        self._last_check = time.monotonic()
+        self._warned: set = set()
+
+    def should_check(self) -> bool:
+        if self.disabled or self.warning_time <= 0:
+            return False
+        return time.monotonic() - self._last_check >= self.warning_time
+
+    def check(self, table: MessageTable) -> bool:
+        """Log a report of stalled tensors; returns True if the shutdown
+        threshold was exceeded (caller must initiate shutdown)."""
+        self._last_check = time.monotonic()
+        must_shutdown = False
+        for name, age, ranks_reported in table.pending():
+            if age < self.warning_time:
+                continue
+            missing = [r for r in range(self.size)
+                       if r not in ranks_reported]
+            if name in self._warned:
+                if self.shutdown_time > 0 and age >= self.shutdown_time:
+                    must_shutdown = True
+                continue
+            self._warned.add(name)
+            hlog.warning(
+                f"One or more tensors were submitted to be reduced, "
+                f"gathered or broadcasted by subset of ranks and are "
+                f"waiting for remainder of ranks for more than "
+                f"{int(age)} seconds. Stalled op: {name} "
+                f"[ready ranks: {ranks_reported}, "
+                f"waiting on ranks: {missing}]")
+            if self.shutdown_time > 0 and age >= self.shutdown_time:
+                hlog.error(
+                    f"Stalled tensor {name} exceeded the shutdown "
+                    f"threshold of {self.shutdown_time} s; shutting down.")
+                must_shutdown = True
+        return must_shutdown

@@ -1,0 +1,83 @@
+"""Leveled logging with per-rank prefix.
+
+Python equivalent of the reference's stream-style C++ ``LOG(LEVEL, rank)``
+macros (reference: horovod/common/logging.h:52-53). Level comes from
+``HOROVOD_LOG_LEVEL`` (trace/debug/info/warning/error/fatal) and timestamps
+can be hidden with ``HOROVOD_LOG_HIDE_TIME``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+TRACE, DEBUG, INFO, WARNING, ERROR, FATAL = range(6)
+
+_LEVEL_NAMES = ["trace", "debug", "info", "warning", "error", "fatal"]
+_lock = threading.Lock()
+
+
+def _min_level() -> int:
+    name = os.environ.get("HOROVOD_LOG_LEVEL", "warning").lower()
+    try:
+        return _LEVEL_NAMES.index(name)
+    except ValueError:
+        return WARNING
+
+
+_min = _min_level()
+
+
+def reset_level() -> None:
+    """Re-read HOROVOD_LOG_LEVEL (used by tests)."""
+    global _min
+    _min = _min_level()
+
+
+def set_level(name: str) -> None:
+    """Set the level programmatically (Config.log_level is applied via
+    this at init)."""
+    global _min
+    try:
+        _min = _LEVEL_NAMES.index(name.lower())
+    except ValueError:
+        _min = WARNING
+
+
+def log(level: int, msg: str, rank: int | None = None) -> None:
+    if level < _min:
+        return
+    parts = []
+    if not os.environ.get("HOROVOD_LOG_HIDE_TIME"):
+        t = time.time()
+        parts.append(time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(t))
+                     + ".%06d" % int((t % 1) * 1e6))
+    if rank is not None:
+        parts.append("[%d]" % rank)
+    parts.append("[%s]" % _LEVEL_NAMES[level].upper())
+    line = " ".join(parts) + " " + msg + "\n"
+    with _lock:
+        sys.stderr.write(line)
+        sys.stderr.flush()
+
+
+def trace(msg, rank=None):
+    log(TRACE, msg, rank)
+
+
+def debug(msg, rank=None):
+    log(DEBUG, msg, rank)
+
+
+def info(msg, rank=None):
+    log(INFO, msg, rank)
+
+
+def warning(msg, rank=None):
+    log(WARNING, msg, rank)
+
+
+def error(msg, rank=None):
+    log(ERROR, msg, rank)

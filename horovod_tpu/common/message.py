@@ -1,0 +1,242 @@
+"""Coordinator message protocol: Request / RequestList / Response / ResponseList.
+
+Python mirror of the reference's message layer
+(reference: horovod/common/message.h:45-185, message.cc, wire/message.fbs).
+The reference serializes with FlatBuffers; we use a purpose-built
+little-endian binary wire format (see `wire.py`) that the native C++ core
+(horovod_tpu/native) reads and writes with the identical layout, so the
+control plane can mix Python and C++ endpoints.
+
+Differences from the reference, by design:
+- dtype set adds BFLOAT16 (the TPU-native wire/accumulate type).
+- op set adds ALLTOALL, REDUCESCATTER, BARRIER and JOIN — native TPU
+  extensions the reference gained only in later versions.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import List, Sequence
+
+import numpy as np
+
+
+class DataType(enum.IntEnum):
+    """Tensor element types (reference: message.h:29-41 DataType)."""
+    UINT8 = 0
+    INT8 = 1
+    UINT16 = 2
+    INT16 = 3
+    INT32 = 4
+    INT64 = 5
+    FLOAT16 = 6
+    FLOAT32 = 7
+    FLOAT64 = 8
+    BOOL = 9
+    BFLOAT16 = 10  # TPU extension
+
+
+_NP_TO_DT = {
+    np.dtype(np.uint8): DataType.UINT8,
+    np.dtype(np.int8): DataType.INT8,
+    np.dtype(np.uint16): DataType.UINT16,
+    np.dtype(np.int16): DataType.INT16,
+    np.dtype(np.int32): DataType.INT32,
+    np.dtype(np.int64): DataType.INT64,
+    np.dtype(np.float16): DataType.FLOAT16,
+    np.dtype(np.float32): DataType.FLOAT32,
+    np.dtype(np.float64): DataType.FLOAT64,
+    np.dtype(np.bool_): DataType.BOOL,
+}
+
+_DT_SIZE = {
+    DataType.UINT8: 1, DataType.INT8: 1,
+    DataType.UINT16: 2, DataType.INT16: 2,
+    DataType.INT32: 4, DataType.INT64: 8,
+    DataType.FLOAT16: 2, DataType.FLOAT32: 4, DataType.FLOAT64: 8,
+    DataType.BOOL: 1, DataType.BFLOAT16: 2,
+}
+
+
+def numpy_dtype_to_datatype(dtype) -> DataType:
+    dtype = np.dtype(dtype) if not isinstance(dtype, np.dtype) else dtype
+    # ml_dtypes bfloat16 registers as a numpy extension dtype.
+    if dtype.name == "bfloat16":
+        return DataType.BFLOAT16
+    try:
+        return _NP_TO_DT[dtype]
+    except KeyError:
+        raise ValueError(f"Unsupported dtype for horovod_tpu: {dtype}")
+
+
+def datatype_to_numpy_dtype(dt: DataType):
+    if dt == DataType.BFLOAT16:
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    for np_dt, d in _NP_TO_DT.items():
+        if d == dt:
+            return np_dt
+    raise ValueError(f"Unknown DataType {dt}")
+
+
+def datatype_size(dt: DataType) -> int:
+    return _DT_SIZE[dt]
+
+
+def datatype_name(dt: DataType) -> str:
+    return DataType(dt).name.lower()
+
+
+class RequestType(enum.IntEnum):
+    """(reference: message.h:48-52 Request::RequestType)"""
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+    # TPU-native extensions:
+    ALLTOALL = 3
+    REDUCESCATTER = 4
+    BARRIER = 5
+    JOIN = 6
+
+
+class ResponseType(enum.IntEnum):
+    """(reference: message.h:133-138 Response::ResponseType)"""
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+    ALLTOALL = 3
+    REDUCESCATTER = 4
+    BARRIER = 5
+    JOIN = 6
+    ERROR = 7
+
+
+class Request:
+    """A rank's announcement that one named tensor is ready
+    (reference: message.h:45-98)."""
+
+    __slots__ = ("request_rank", "request_type", "tensor_type",
+                 "tensor_name", "root_rank", "device", "tensor_shape",
+                 "prescale_factor", "postscale_factor")
+
+    def __init__(self, request_rank: int = 0,
+                 request_type: RequestType = RequestType.ALLREDUCE,
+                 tensor_type: DataType = DataType.FLOAT32,
+                 tensor_name: str = "",
+                 root_rank: int = -1,
+                 device: int = -1,
+                 tensor_shape: Sequence[int] = (),
+                 prescale_factor: float = 1.0,
+                 postscale_factor: float = 1.0):
+        self.request_rank = request_rank
+        self.request_type = RequestType(request_type)
+        self.tensor_type = DataType(tensor_type)
+        self.tensor_name = tensor_name
+        self.root_rank = root_rank
+        self.device = device
+        self.tensor_shape = tuple(int(d) for d in tensor_shape)
+        self.prescale_factor = prescale_factor
+        self.postscale_factor = postscale_factor
+
+    def __eq__(self, other):
+        return (isinstance(other, Request) and
+                all(getattr(self, s) == getattr(other, s)
+                    for s in Request.__slots__))
+
+    def __repr__(self):
+        return (f"Request({self.request_type.name}, rank={self.request_rank},"
+                f" name={self.tensor_name!r}, dtype={self.tensor_type.name},"
+                f" shape={self.tensor_shape}, root={self.root_rank},"
+                f" device={self.device})")
+
+
+class RequestList:
+    """One cycle's worth of requests from a rank, plus the shutdown bit
+    (reference: message.h:100-123)."""
+
+    __slots__ = ("requests", "shutdown")
+
+    def __init__(self, requests: List[Request] | None = None,
+                 shutdown: bool = False):
+        self.requests = requests if requests is not None else []
+        self.shutdown = shutdown
+
+    def add_request(self, req: Request) -> None:
+        self.requests.append(req)
+
+    def __eq__(self, other):
+        return (isinstance(other, RequestList)
+                and self.shutdown == other.shutdown
+                and self.requests == other.requests)
+
+
+class Response:
+    """Coordinator's verdict for one (possibly fused) set of tensors
+    (reference: message.h:130-185)."""
+
+    __slots__ = ("response_type", "tensor_names", "error_message",
+                 "devices", "tensor_sizes", "prescale_factor",
+                 "postscale_factor")
+
+    def __init__(self, response_type: ResponseType = ResponseType.ALLREDUCE,
+                 tensor_names: List[str] | None = None,
+                 error_message: str = "",
+                 devices: List[int] | None = None,
+                 tensor_sizes: List[int] | None = None,
+                 prescale_factor: float = 1.0,
+                 postscale_factor: float = 1.0):
+        self.response_type = ResponseType(response_type)
+        self.tensor_names = tensor_names if tensor_names is not None else []
+        self.error_message = error_message
+        self.devices = devices if devices is not None else []
+        self.tensor_sizes = tensor_sizes if tensor_sizes is not None else []
+        self.prescale_factor = prescale_factor
+        self.postscale_factor = postscale_factor
+
+    def add_tensor_name(self, name: str) -> None:
+        self.tensor_names.append(name)
+
+    def add_tensor_size(self, size: int) -> None:
+        self.tensor_sizes.append(size)
+
+    def __eq__(self, other):
+        return (isinstance(other, Response) and
+                all(getattr(self, s) == getattr(other, s)
+                    for s in Response.__slots__))
+
+    def __repr__(self):
+        return (f"Response({self.response_type.name},"
+                f" names={self.tensor_names},"
+                f" err={self.error_message!r})")
+
+
+class ResponseList:
+    """One cycle's broadcast from the coordinator: ordered, fused responses
+    + shutdown bit (reference: message.h:187-214), plus the autotuner's
+    currently tuned parameters so workers track the coordinator — the
+    wire-level stand-in for the reference's MPI struct param sync
+    (reference: parameter_manager.cc:64-78 SyncParams). Zero = untuned.
+    """
+
+    __slots__ = ("responses", "shutdown", "tuned_cycle_time_ms",
+                 "tuned_fusion_threshold_bytes")
+
+    def __init__(self, responses: List[Response] | None = None,
+                 shutdown: bool = False,
+                 tuned_cycle_time_ms: float = 0.0,
+                 tuned_fusion_threshold_bytes: int = 0):
+        self.responses = responses if responses is not None else []
+        self.shutdown = shutdown
+        self.tuned_cycle_time_ms = tuned_cycle_time_ms
+        self.tuned_fusion_threshold_bytes = tuned_fusion_threshold_bytes
+
+    def add_response(self, resp: Response) -> None:
+        self.responses.append(resp)
+
+    def __eq__(self, other):
+        return (isinstance(other, ResponseList)
+                and self.shutdown == other.shutdown
+                and self.tuned_cycle_time_ms == other.tuned_cycle_time_ms
+                and self.tuned_fusion_threshold_bytes
+                    == other.tuned_fusion_threshold_bytes
+                and self.responses == other.responses)

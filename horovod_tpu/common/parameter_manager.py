@@ -1,0 +1,112 @@
+"""Autotuner: steer fusion threshold × cycle time for throughput.
+
+(reference: horovod/common/parameter_manager.{h,cc} — joint Bayesian
+optimization of fusion-threshold-MB ∈ [0,64] × cycle-time-ms ∈ [1,100],
+parameter_manager.h:169-207; score = bytes/µs over samples of
+``steps_per_sample`` cycles with median-of-k smoothing,
+parameter_manager.cc:28-31,145-171; warmup discard; rank-0 tunes and
+the tuned values ride to workers — in the reference via a custom MPI
+struct broadcast (cc:64-78), here inside the ResponseList trailer,
+which every rank already receives every cycle.)
+
+Enabled with ``HOROVOD_AUTOTUNE=1``; progress optionally logged to
+``HOROVOD_AUTOTUNE_LOG`` as CSV.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from horovod_tpu.common import logging as hlog
+from horovod_tpu.optim.bayesian_optimization import BayesianOptimization
+
+_MB = 1024 * 1024
+
+
+class ParameterManager:
+    def __init__(self, config, controller):
+        self._is_coordinator = controller.rank == 0
+        self._warmup_remaining = config.autotune_warmup_samples
+        self._steps_per_sample = config.autotune_steps_per_sample
+        self._max_samples = config.autotune_bayes_opt_max_samples
+        self._bo = BayesianOptimization(
+            bounds=[(0.0, 64.0), (1.0, 100.0)],  # MB, ms
+            alpha=config.autotune_gaussian_process_noise)
+        self._log_path = config.autotune_log
+        if self._log_path and self._is_coordinator:
+            with open(self._log_path, "w") as f:
+                f.write("sample,fusion_threshold_mb,cycle_time_ms,"
+                        "score_bytes_per_us\n")
+
+        self._current = np.asarray(
+            [config.fusion_threshold_bytes / _MB, config.cycle_time_ms])
+        self._tuning = self._is_coordinator
+        self._samples_taken = 0
+        # per-sample accumulation
+        self._cycle_count = 0
+        self._bytes_acc = 0
+        self._t0 = time.monotonic()
+        # median-of-k smoothing (reference: median of scores, cc:145-171)
+        self._scores = []
+
+    # -- values consumed by the runtime ---------------------------------
+    def fusion_threshold_bytes(self) -> int:
+        return int(self._current[0] * _MB)
+
+    def cycle_time_ms(self) -> float:
+        return float(self._current[1])
+
+    def apply_synced(self, fusion_threshold_bytes: int,
+                     cycle_time_ms: float) -> None:
+        """Workers adopt the coordinator's tuned values (reference:
+        SyncParams, parameter_manager.cc:64-78)."""
+        if not self._is_coordinator and fusion_threshold_bytes > 0:
+            self._current = np.asarray(
+                [fusion_threshold_bytes / _MB, cycle_time_ms])
+
+    # -- sampling --------------------------------------------------------
+    def on_cycle(self, nbytes: int) -> None:
+        """Called by the background loop once per cycle with the bytes
+        processed (reference: parameter_manager.cc Update)."""
+        if not self._tuning:
+            return
+        self._bytes_acc += nbytes
+        self._cycle_count += 1
+        if self._cycle_count < self._steps_per_sample:
+            return
+        elapsed_us = (time.monotonic() - self._t0) * 1e6
+        score = self._bytes_acc / max(elapsed_us, 1.0)
+        self._cycle_count = 0
+        self._bytes_acc = 0
+        self._t0 = time.monotonic()
+
+        if self._warmup_remaining > 0:
+            self._warmup_remaining -= 1
+            return
+
+        self._scores.append(score)
+        if len(self._scores) < 3:
+            return
+        sample_score = float(np.median(self._scores))
+        self._scores = []
+        self._samples_taken += 1
+        self._bo.add_sample(self._current.copy(), sample_score)
+        if self._log_path:
+            with open(self._log_path, "a") as f:
+                f.write(f"{self._samples_taken},{self._current[0]:.3f},"
+                        f"{self._current[1]:.3f},{sample_score:.6f}\n")
+        if self._samples_taken >= self._max_samples:
+            best, best_score = self._bo.best()
+            if best is not None:
+                self._current = np.asarray(best)
+            self._tuning = False
+            hlog.info(
+                f"autotune converged: fusion_threshold="
+                f"{self._current[0]:.1f} MB cycle_time="
+                f"{self._current[1]:.1f} ms (score {best_score:.3f} B/µs)")
+            return
+        self._current = np.clip(self._bo.next_sample(),
+                                [0.0, 1.0], [64.0, 100.0])

@@ -1,0 +1,275 @@
+"""The background coordination loop — heart of the runtime.
+
+Python re-architecture of the reference's ``BackgroundThreadLoop`` /
+``RunLoopOnce`` / ``PerformOperation``
+(reference: horovod/common/operations.cc:662-955, 986-1338, 450-539):
+one daemon thread per process paces a negotiation cycle every
+``HOROVOD_CYCLE_TIME`` ms; each cycle drains this rank's request queue,
+gathers all ranks' requests at the coordinator, fuses ready tensors
+under the fusion threshold, broadcasts the agreed ResponseList, and
+executes it through the backend priority list. Enqueue APIs return
+immediately; completion flows back through per-entry callbacks
+(reference: common.h:162 StatusCallback).
+
+Hot-loop notes for TPU: the data plane executed here is an XLA
+computation per fused response (see ops/xla_ops.py); this thread only
+*issues* it, so the Python cycle overhead rides in the shadow of device
+execution, like the reference's detached CUDA finalizer threads
+(reference: ops/cuda_operations.cc:148-179).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from horovod_tpu.common import logging as hlog
+from horovod_tpu.common import wire
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.controller import Controller
+from horovod_tpu.common.coordinator import (
+    MessageTable, StallInspector, construct_response, fuse_responses,
+)
+from horovod_tpu.common.message import (
+    DataType, Request, RequestList, RequestType, Response, ResponseList,
+    ResponseType,
+)
+from horovod_tpu.common.status import (
+    DUPLICATE_NAME_ERROR_FMT, SHUT_DOWN_ERROR, Status,
+)
+from horovod_tpu.common.tensor_table import (
+    HandleManager, TensorTable, TensorTableEntry,
+)
+from horovod_tpu.common.timeline import (
+    ACT_COLLECTIVE, ACT_QUEUE, NOOP_TIMELINE, create_timeline,
+)
+from horovod_tpu.ops.operation_manager import OperationManager
+
+
+class Runtime:
+    """Process-global state + background thread
+    (reference: HorovodGlobalState, common/global_state.h:33-136)."""
+
+    def __init__(self, config: Config, controller: Controller,
+                 op_manager: OperationManager,
+                 parameter_manager=None):
+        self.config = config
+        self.controller = controller
+        self.op_manager = op_manager
+        self.tensor_table = TensorTable()
+        self.handle_manager = HandleManager()
+        self.parameter_manager = parameter_manager
+        self.timeline = NOOP_TIMELINE
+        if controller.rank == 0 and config.timeline_path:
+            self.timeline = create_timeline(config.timeline_path,
+                                            config.timeline_mark_cycles)
+        self._message_table = MessageTable() if controller.rank == 0 else None
+        self._dtypes: Dict[str, DataType] = {}
+        self._stall = StallInspector(
+            controller.size,
+            warning_time=config.stall_check_time_seconds,
+            shutdown_time=config.stall_shutdown_time_seconds,
+            disabled=config.stall_check_disable)
+        self._shutdown_requested = threading.Event()
+        self._done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[Exception] = None
+        # Autotune plumbing: bytes reduced this cycle.
+        self._cycle_bytes = 0
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._background_loop,
+                                        name="hvd-background",
+                                        daemon=True)
+        self._thread.start()
+
+    def request_shutdown(self) -> None:
+        self._shutdown_requested.set()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    @property
+    def alive(self) -> bool:
+        return (self._thread is not None and self._thread.is_alive()
+                and not self._done.is_set())
+
+    # -- enqueue APIs (reference: operations.cc:1430-1549) ---------------
+    def enqueue(self, request_type: RequestType, entry: TensorTableEntry,
+                dtype: DataType, shape, prescale: float = 1.0,
+                postscale: float = 1.0) -> Status:
+        if self._done.is_set() or self._shutdown_requested.is_set():
+            return Status.Aborted(SHUT_DOWN_ERROR)
+        req = Request(request_rank=self.controller.rank,
+                      request_type=request_type,
+                      tensor_type=dtype,
+                      tensor_name=entry.tensor_name,
+                      root_rank=entry.root_rank,
+                      device=entry.device,
+                      tensor_shape=shape,
+                      prescale_factor=prescale,
+                      postscale_factor=postscale)
+        entry.request_type = request_type
+        if not self.tensor_table.add(entry, req):
+            return Status.InvalidArgument(
+                DUPLICATE_NAME_ERROR_FMT
+                % (request_type.name.lower(), entry.tensor_name))
+        if self._done.is_set():
+            # The loop exited between the liveness check and the add; the
+            # shutdown fan-out may have missed this entry — reclaim it so
+            # its handle cannot hang forever.
+            if self.tensor_table.pop_entry_if_present(entry.tensor_name):
+                return Status.Aborted(SHUT_DOWN_ERROR)
+        return Status.OK()
+
+    # -- the loop --------------------------------------------------------
+    def _background_loop(self) -> None:
+        try:
+            while self._run_loop_once():
+                pass
+        except Exception as e:  # transport failure, backend bug, ...
+            self._error = e
+            hlog.error(f"horovod_tpu background loop failed: {e!r}",
+                       rank=self.controller.rank)
+        finally:
+            self._done.set()
+            # Fail everything still pending
+            # (reference: operations.cc:898-913).
+            for entry in self.tensor_table.pop_all():
+                if entry.callback:
+                    entry.callback(Status.Aborted(SHUT_DOWN_ERROR))
+            self.timeline.shutdown()
+            try:
+                self.controller.close()
+            except Exception:
+                pass
+
+    def _run_loop_once(self) -> bool:
+        """One negotiation cycle; returns False to exit
+        (reference: operations.cc:986-1338)."""
+        t0 = time.monotonic()
+        self.timeline.mark_cycle_start()
+
+        requests = self.tensor_table.pop_messages()
+        shutting_down = self._shutdown_requested.is_set()
+        req_list = RequestList(requests, shutdown=shutting_down)
+        payload = wire.serialize_request_list(req_list)
+
+        gathered = self.controller.gather_requests(payload)
+        if self.controller.is_coordinator:
+            resp_list = self._coordinate(gathered)
+            self.controller.broadcast_responses(
+                wire.serialize_response_list(resp_list))
+        else:
+            data = self.controller.broadcast_responses(None)
+            resp_list = wire.parse_response_list(data)
+
+        self._perform_operations(resp_list)
+
+        if resp_list.shutdown:
+            return False
+
+        # Pace the cycle (reference: operations.cc:987-995). The autotuner
+        # may be steering cycle_time_ms (reference: parameter_manager.cc).
+        cycle_time_ms = self.config.cycle_time_ms
+        if self.parameter_manager is not None:
+            self.parameter_manager.apply_synced(
+                resp_list.tuned_fusion_threshold_bytes,
+                resp_list.tuned_cycle_time_ms)
+            self.parameter_manager.on_cycle(self._cycle_bytes)
+            self._cycle_bytes = 0
+            cycle_time_ms = self.parameter_manager.cycle_time_ms()
+        elapsed = time.monotonic() - t0
+        sleep_s = cycle_time_ms / 1000.0 - elapsed
+        if sleep_s > 0:
+            # Wake early if shutdown is requested so exit latency stays low.
+            self._shutdown_requested.wait(sleep_s)
+        return True
+
+    def _coordinate(self, gathered: List[bytes]) -> ResponseList:
+        """Coordinator half of the cycle
+        (reference: operations.cc:1018-1258)."""
+        table = self._message_table
+        size = self.controller.size
+        shutdown = False
+        for data in gathered:
+            rl = wire.parse_request_list(data)
+            shutdown = shutdown or rl.shutdown
+            for req in rl.requests:
+                self._dtypes[req.tensor_name] = req.tensor_type
+                table.increment_tensor_count(req, size, self.timeline)
+        ready = table.pop_ready()
+        responses = []
+        for name in ready:
+            self.timeline.negotiate_end(name)
+            responses.append(construct_response(table, name, size))
+        threshold = self.config.fusion_threshold_bytes
+        if self.parameter_manager is not None:
+            threshold = self.parameter_manager.fusion_threshold_bytes()
+        fused = fuse_responses(responses, self._dtypes, threshold)
+        for resp in fused:
+            for n in resp.tensor_names:
+                self._dtypes.pop(n, None)
+
+        if self._stall.should_check():
+            if self._stall.check(table):
+                shutdown = True
+
+        resp_list = ResponseList(fused, shutdown=shutdown)
+        if self.parameter_manager is not None:
+            resp_list.tuned_cycle_time_ms = \
+                self.parameter_manager.cycle_time_ms()
+            resp_list.tuned_fusion_threshold_bytes = \
+                self.parameter_manager.fusion_threshold_bytes()
+        return resp_list
+
+    def _perform_operations(self, resp_list: ResponseList) -> None:
+        """Execute each agreed response and fire callbacks
+        (reference: operations.cc:450-539 PerformOperation)."""
+        for response in resp_list.responses:
+            entries: List[TensorTableEntry] = []
+            for name in response.tensor_names:
+                entry = self.tensor_table.get_entry(name)
+                if entry is not None:
+                    entries.append(self.tensor_table.pop_entry(name))
+            if response.response_type == ResponseType.ERROR:
+                for e in entries:
+                    if e.callback:
+                        e.callback(
+                            Status.PreconditionError(response.error_message))
+                continue
+            if not entries and response.response_type != ResponseType.BARRIER:
+                continue
+            names = [e.tensor_name for e in entries]
+            for e in entries:
+                self.timeline.start(
+                    e.tensor_name, response.response_type.name)
+            # Wait for input readiness — the ReadyEvent poll
+            # (reference: operations.cc:507-518). On TPU this covers
+            # jax async dispatch still materializing the input.
+            self.timeline.activity_start_all(names, ACT_QUEUE)
+            for e in entries:
+                if e.ready_fn is not None:
+                    while not e.ready_fn():
+                        time.sleep(100e-9)
+            self.timeline.activity_end_all(names)
+
+            self.timeline.activity_start_all(names, ACT_COLLECTIVE)
+            try:
+                status = self.op_manager.execute(entries, response)
+            except Exception as e:
+                status = Status.UnknownError(
+                    f"collective execution failed: {e!r}")
+            self.timeline.activity_end_all(names)
+
+            for e in entries:
+                self.timeline.end(e.tensor_name)
+            self._cycle_bytes += sum(
+                getattr(e.tensor, "nbytes", 0) for e in entries)
+            if not status.in_progress():
+                for e in entries:
+                    if e.callback:
+                        e.callback(status)

@@ -1,0 +1,79 @@
+"""Operation status type.
+
+Equivalent of the reference's ``horovod::common::Status``
+(reference: horovod/common/common.h:70-121): OK / UNKNOWN_ERROR /
+PRECONDITION_ERROR / ABORTED / INVALID_ARGUMENT / IN_PROGRESS, carried
+through enqueue callbacks and the handle manager.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class StatusType(enum.IntEnum):
+    OK = 0
+    UNKNOWN_ERROR = 1
+    PRECONDITION_ERROR = 2
+    ABORTED = 3
+    INVALID_ARGUMENT = 4
+    IN_PROGRESS = 5
+
+
+class Status:
+    __slots__ = ("type", "reason")
+
+    def __init__(self, type_: StatusType = StatusType.OK, reason: str = ""):
+        self.type = type_
+        self.reason = reason
+
+    @staticmethod
+    def OK() -> "Status":
+        return Status(StatusType.OK)
+
+    @staticmethod
+    def UnknownError(msg: str) -> "Status":
+        return Status(StatusType.UNKNOWN_ERROR, msg)
+
+    @staticmethod
+    def PreconditionError(msg: str) -> "Status":
+        return Status(StatusType.PRECONDITION_ERROR, msg)
+
+    @staticmethod
+    def Aborted(msg: str) -> "Status":
+        return Status(StatusType.ABORTED, msg)
+
+    @staticmethod
+    def InvalidArgument(msg: str) -> "Status":
+        return Status(StatusType.INVALID_ARGUMENT, msg)
+
+    @staticmethod
+    def InProgress() -> "Status":
+        return Status(StatusType.IN_PROGRESS)
+
+    def ok(self) -> bool:
+        return self.type == StatusType.OK
+
+    def in_progress(self) -> bool:
+        return self.type == StatusType.IN_PROGRESS
+
+    def __repr__(self) -> str:
+        return f"Status({self.type.name}, {self.reason!r})"
+
+
+class HorovodInternalError(RuntimeError):
+    """Raised to user code when a collective fails (coordinator ERROR
+    response or shutdown; reference: message.h Response::ERROR and
+    operations.cc:898-913 SHUT_DOWN_ERROR fan-out)."""
+
+
+SHUT_DOWN_ERROR = (
+    "Horovod has been shut down. This was caused by an exception on one of "
+    "the ranks or an attempt to run a collective after shutdown was called."
+)
+
+DUPLICATE_NAME_ERROR_FMT = (
+    "Requested to %s a tensor with the same name as another tensor that is "
+    "currently being processed. If you want to request another tensor, use "
+    "a different tensor name. Tensor name: %s"
+)

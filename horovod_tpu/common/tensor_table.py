@@ -1,0 +1,147 @@
+"""Tensor table, message queue and handle manager.
+
+The tensor table holds the per-process payloads of in-flight collectives,
+keyed by name, while the message queue carries the matching Requests to
+the background loop (reference: horovod/common/global_state.h:48-57 and
+common.h:165-184 ``TensorTableEntry``/``TensorTable``). Handles mirror
+the torch binding's ``HandleManager`` (reference:
+horovod/torch/handle_manager.h:31-42) and are used by every async API.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from horovod_tpu.common.message import Request
+from horovod_tpu.common.status import Status
+
+
+class TensorTableEntry:
+    """One in-flight collective on this process
+    (reference: common.h:165-182)."""
+
+    __slots__ = ("tensor_name", "tensor", "output", "root_rank", "device",
+                 "callback", "ready_fn", "request_type", "context")
+
+    def __init__(self, tensor_name: str, tensor: Any,
+                 root_rank: int = -1, device: int = -1,
+                 callback: Optional[Callable[[Status], None]] = None,
+                 ready_fn: Optional[Callable[[], bool]] = None,
+                 request_type=None, context: Any = None):
+        self.tensor_name = tensor_name
+        self.tensor = tensor          # input payload (numpy or jax array)
+        self.output = None            # set by the executing backend
+        self.root_rank = root_rank
+        self.device = device
+        self.callback = callback
+        self.ready_fn = ready_fn      # None => ready immediately
+        self.request_type = request_type
+        self.context = context        # adapter-specific opaque state
+
+
+class TensorTable:
+    """Name-keyed table of pending entries + the per-cycle message queue,
+    guarded by one mutex like the reference's
+    (reference: operations.cc:1455 mutex usage)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table: Dict[str, TensorTableEntry] = {}
+        self._message_queue: List[Request] = []
+
+    def add(self, entry: TensorTableEntry, request: Request) -> bool:
+        """Insert entry + request atomically. Returns False on duplicate
+        name (reference: operations.cc:1459-1462 DUPLICATE_NAME_ERROR)."""
+        with self._lock:
+            if entry.tensor_name in self._table:
+                return False
+            self._table[entry.tensor_name] = entry
+            self._message_queue.append(request)
+            return True
+
+    def pop_messages(self) -> List[Request]:
+        """Drain the message queue for this cycle
+        (reference: operations.cc:1000-1012)."""
+        with self._lock:
+            msgs = self._message_queue
+            self._message_queue = []
+            return msgs
+
+    def pop_entry(self, name: str) -> TensorTableEntry:
+        with self._lock:
+            return self._table.pop(name)
+
+    def pop_entry_if_present(self, name: str):
+        with self._lock:
+            self._message_queue = [m for m in self._message_queue
+                                   if m.tensor_name != name]
+            return self._table.pop(name, None)
+
+    def get_entry(self, name: str) -> Optional[TensorTableEntry]:
+        with self._lock:
+            return self._table.get(name)
+
+    def pop_all(self) -> List[TensorTableEntry]:
+        """Remove and return every pending entry (shutdown fan-out,
+        reference: operations.cc:898-913)."""
+        with self._lock:
+            entries = list(self._table.values())
+            self._table.clear()
+            self._message_queue = []
+            return entries
+
+    def __len__(self):
+        with self._lock:
+            return len(self._table)
+
+
+class HandleManager:
+    """Integer handles for async ops; poll/wait on completion status
+    (reference: horovod/torch/handle_manager.{h,cc})."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._last = 0
+        self._results: Dict[int, Optional[Status]] = {}
+        self._outputs: Dict[int, Any] = {}
+
+    def allocate(self) -> int:
+        with self._lock:
+            self._last += 1
+            handle = self._last
+            self._results[handle] = None
+            return handle
+
+    def poll(self, handle: int) -> bool:
+        with self._lock:
+            if handle not in self._results:
+                raise ValueError(f"Invalid handle {handle}")
+            return self._results[handle] is not None
+
+    def mark_done(self, handle: int, status: Status,
+                  output: Any = None) -> None:
+        with self._cv:
+            self._results[handle] = status
+            self._outputs[handle] = output
+            self._cv.notify_all()
+
+    def wait(self, handle: int, timeout: Optional[float] = None) -> Status:
+        with self._cv:
+            if handle not in self._results:
+                raise ValueError(f"Invalid handle {handle}")
+            ok = self._cv.wait_for(
+                lambda: self._results[handle] is not None, timeout)
+            if not ok:
+                raise TimeoutError(
+                    f"Timed out waiting for handle {handle}")
+            return self._results[handle]
+
+    def release(self, handle: int) -> Any:
+        """Return the output and clear the handle
+        (reference: handle_manager.cc ReleaseHandle/WaitAndClear)."""
+        with self._lock:
+            out = self._outputs.pop(handle, None)
+            self._results.pop(handle, None)
+            return out

@@ -1,0 +1,151 @@
+"""Horovod Timeline: Chrome-tracing profile of every collective.
+
+(reference: horovod/common/timeline.{h,cc} — per-tensor state machine
+NEGOTIATING → TOP_LEVEL → ACTIVITY, timeline.h:76; rank-0-only file
+written by a dedicated thread fed from a lock-free queue,
+timeline.h:46-74; enabled by ``HOROVOD_TIMELINE`` with optional cycle
+markers via ``HOROVOD_TIMELINE_MARK_CYCLES``, operations.cc:792-798.)
+
+Event vocabulary matches the reference so existing timeline tooling and
+the reference's test greps carry over (reference:
+test/test_timeline.py:42-58 greps NEGOTIATE_ALLREDUCE / ALLREDUCE /
+CYCLE_START): one trace "process" per tensor name, ``NEGOTIATE_<OP>``
+spans with per-rank instant ticks, a top-level ``<OP>`` span, nested
+activity spans (QUEUE / MEMCPY_IN_FUSION_BUFFER / COLLECTIVE /
+MEMCPY_OUT_FUSION_BUFFER), and ``CYCLE_START`` instants.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from typing import Dict, Optional
+
+from horovod_tpu.common.message import RequestType
+
+# Activity names (reference: common.h:30-51 macros).
+ACT_QUEUE = "QUEUE"
+ACT_MEMCPY_IN_FUSION_BUFFER = "MEMCPY_IN_FUSION_BUFFER"
+ACT_COLLECTIVE = "COLLECTIVE"
+ACT_MEMCPY_OUT_FUSION_BUFFER = "MEMCPY_OUT_FUSION_BUFFER"
+
+
+class _NoOpTimeline:
+    """Disabled timeline: every hook is a cheap no-op."""
+
+    enabled = False
+
+    def negotiate_start(self, name, request_type): pass
+    def negotiate_rank_ready(self, name, rank): pass
+    def negotiate_end(self, name): pass
+    def start(self, name, op_name): pass
+    def activity_start_all(self, names, activity): pass
+    def activity_end_all(self, names): pass
+    def end(self, name): pass
+    def mark_cycle_start(self): pass
+    def shutdown(self): pass
+
+
+class Timeline(_NoOpTimeline):
+    """Enabled timeline writing Chrome-tracing JSON."""
+
+    enabled = True
+
+    def __init__(self, path: str, mark_cycles: bool = False):
+        self._path = path
+        self.mark_cycles = mark_cycles
+        self._queue: "queue.Queue[Optional[dict]]" = queue.Queue()
+        self._pids: Dict[str, int] = {}
+        self._next_pid = 1
+        self._lock = threading.Lock()
+        self._start_ts = time.monotonic()
+        self._writer = threading.Thread(target=self._write_loop,
+                                        name="hvd-timeline-writer",
+                                        daemon=True)
+        self._writer.start()
+
+    # -- writer thread (reference: timeline.h:46-74 TimelineWriter) ------
+    def _write_loop(self):
+        with open(self._path, "w") as f:
+            f.write("[\n")
+            first = True
+            while True:
+                rec = self._queue.get()
+                if rec is None:
+                    break
+                if not first:
+                    f.write(",\n")
+                f.write(json.dumps(rec))
+                first = False
+                f.flush()
+            f.write("\n]\n")
+
+    def _ts(self) -> int:
+        return int((time.monotonic() - self._start_ts) * 1e6)
+
+    def _pid(self, name: str) -> int:
+        with self._lock:
+            pid = self._pids.get(name)
+            if pid is None:
+                pid = self._next_pid
+                self._next_pid += 1
+                self._pids[name] = pid
+                self._queue.put({"name": "process_name", "ph": "M",
+                                 "pid": pid, "args": {"name": name}})
+                self._queue.put({"name": "process_sort_index", "ph": "M",
+                                 "pid": pid, "args": {"sort_index": pid}})
+            return pid
+
+    def _emit(self, ph: str, name: str, event_name: str, **kw):
+        rec = {"ph": ph, "pid": self._pid(name), "ts": self._ts()}
+        if event_name:
+            rec["name"] = event_name
+        rec.update(kw)
+        self._queue.put(rec)
+
+    # -- negotiation (reference: timeline.cc NegotiateStart/RankReady/End,
+    # called from IncrementTensorCount, operations.cc:174-186) -----------
+    def negotiate_start(self, name: str, request_type) -> None:
+        op = RequestType(request_type).name
+        self._emit("B", name, f"NEGOTIATE_{op}")
+
+    def negotiate_rank_ready(self, name: str, rank: int) -> None:
+        self._emit("X", name, f"{rank}", dur=0)
+
+    def negotiate_end(self, name: str) -> None:
+        self._emit("E", name, "")
+
+    # -- execution spans -------------------------------------------------
+    def start(self, name: str, op_name: str) -> None:
+        self._emit("B", name, op_name)
+
+    def activity_start_all(self, names, activity: str) -> None:
+        for name in names:
+            self._emit("B", name, activity)
+
+    def activity_end_all(self, names) -> None:
+        for name in names:
+            self._emit("E", name, "")
+
+    def end(self, name: str) -> None:
+        self._emit("E", name, "")
+
+    def mark_cycle_start(self) -> None:
+        if self.mark_cycles:
+            self._emit("i", "cycle", "CYCLE_START", s="g")
+
+    def shutdown(self) -> None:
+        self._queue.put(None)
+        self._writer.join(timeout=5.0)
+
+
+def create_timeline(path: str, mark_cycles: bool = False):
+    """Rank-0 only, like the reference (timeline.h:78-79)."""
+    if not path:
+        return _NoOpTimeline()
+    return Timeline(path, mark_cycles)
+
+
+NOOP_TIMELINE = _NoOpTimeline()

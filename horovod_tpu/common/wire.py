@@ -1,0 +1,194 @@
+"""Binary wire format for the coordinator control plane.
+
+Role-equivalent of the reference's FlatBuffers schema
+(reference: horovod/common/wire/message.fbs, message.cc:122-215,317-346).
+We define a compact little-endian layout instead of FlatBuffers; the
+native C++ core implements the identical encoding (native/wire.cc), so
+either side can produce/consume messages.
+
+Layout (all little-endian):
+  varless fixed ints; strings are u32 length + UTF-8 bytes;
+  vectors are u32 count + elements.
+
+  Request      := u8 request_type | i32 request_rank | u8 tensor_type
+                | i32 root_rank | i32 device | str tensor_name
+                | f64 prescale | f64 postscale | u8 ndim | i64 dims[ndim]
+  RequestList  := u8 shutdown | u32 n | Request[n]
+  Response     := u8 response_type | str error_message
+                | f64 prescale | f64 postscale
+                | u32 nnames | str names[nnames]
+                | u32 ndev | i32 devices[ndev]
+                | u32 nsz  | i64 tensor_sizes[nsz]
+  ResponseList := u8 shutdown | f64 tuned_cycle_time_ms
+                | i64 tuned_fusion_threshold_bytes | u32 n | Response[n]
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Tuple
+
+from horovod_tpu.common.message import (
+    DataType, Request, RequestList, RequestType, Response, ResponseList,
+    ResponseType,
+)
+
+_U8 = struct.Struct("<B")
+_U32 = struct.Struct("<I")
+_I32 = struct.Struct("<i")
+_I64 = struct.Struct("<q")
+_F64 = struct.Struct("<d")
+
+
+class _Writer:
+    def __init__(self):
+        self.parts = []
+
+    def u8(self, v): self.parts.append(_U8.pack(v))
+    def u32(self, v): self.parts.append(_U32.pack(v))
+    def i32(self, v): self.parts.append(_I32.pack(v))
+    def i64(self, v): self.parts.append(_I64.pack(v))
+    def f64(self, v): self.parts.append(_F64.pack(v))
+
+    def string(self, s: str):
+        b = s.encode("utf-8")
+        self.u32(len(b))
+        self.parts.append(b)
+
+    def bytes(self) -> bytes:
+        return b"".join(self.parts)
+
+
+class _Reader:
+    def __init__(self, data: bytes, offset: int = 0):
+        self.data = data
+        self.off = offset
+
+    def u8(self):
+        v = _U8.unpack_from(self.data, self.off)[0]
+        self.off += 1
+        return v
+
+    def u32(self):
+        v = _U32.unpack_from(self.data, self.off)[0]
+        self.off += 4
+        return v
+
+    def i32(self):
+        v = _I32.unpack_from(self.data, self.off)[0]
+        self.off += 4
+        return v
+
+    def i64(self):
+        v = _I64.unpack_from(self.data, self.off)[0]
+        self.off += 8
+        return v
+
+    def f64(self):
+        v = _F64.unpack_from(self.data, self.off)[0]
+        self.off += 8
+        return v
+
+    def string(self) -> str:
+        n = self.u32()
+        s = self.data[self.off:self.off + n].decode("utf-8")
+        self.off += n
+        return s
+
+
+def _write_request(w: _Writer, req: Request) -> None:
+    w.u8(int(req.request_type))
+    w.i32(req.request_rank)
+    w.u8(int(req.tensor_type))
+    w.i32(req.root_rank)
+    w.i32(req.device)
+    w.string(req.tensor_name)
+    w.f64(req.prescale_factor)
+    w.f64(req.postscale_factor)
+    w.u8(len(req.tensor_shape))
+    for d in req.tensor_shape:
+        w.i64(d)
+
+
+def _read_request(r: _Reader) -> Request:
+    req_type = RequestType(r.u8())
+    request_rank = r.i32()
+    tensor_type = DataType(r.u8())
+    root_rank = r.i32()
+    device = r.i32()
+    name = r.string()
+    prescale = r.f64()
+    postscale = r.f64()
+    ndim = r.u8()
+    shape = tuple(r.i64() for _ in range(ndim))
+    return Request(request_rank=request_rank, request_type=req_type,
+                   tensor_type=tensor_type, tensor_name=name,
+                   root_rank=root_rank, device=device, tensor_shape=shape,
+                   prescale_factor=prescale, postscale_factor=postscale)
+
+
+def serialize_request_list(rl: RequestList) -> bytes:
+    w = _Writer()
+    w.u8(1 if rl.shutdown else 0)
+    w.u32(len(rl.requests))
+    for req in rl.requests:
+        _write_request(w, req)
+    return w.bytes()
+
+
+def parse_request_list(data: bytes) -> RequestList:
+    r = _Reader(data)
+    shutdown = bool(r.u8())
+    n = r.u32()
+    return RequestList([_read_request(r) for _ in range(n)], shutdown)
+
+
+def _write_response(w: _Writer, resp: Response) -> None:
+    w.u8(int(resp.response_type))
+    w.string(resp.error_message)
+    w.f64(resp.prescale_factor)
+    w.f64(resp.postscale_factor)
+    w.u32(len(resp.tensor_names))
+    for name in resp.tensor_names:
+        w.string(name)
+    w.u32(len(resp.devices))
+    for d in resp.devices:
+        w.i32(d)
+    w.u32(len(resp.tensor_sizes))
+    for s in resp.tensor_sizes:
+        w.i64(s)
+
+
+def _read_response(r: _Reader) -> Response:
+    resp_type = ResponseType(r.u8())
+    err = r.string()
+    prescale = r.f64()
+    postscale = r.f64()
+    names = [r.string() for _ in range(r.u32())]
+    devices = [r.i32() for _ in range(r.u32())]
+    sizes = [r.i64() for _ in range(r.u32())]
+    return Response(response_type=resp_type, tensor_names=names,
+                    error_message=err, devices=devices, tensor_sizes=sizes,
+                    prescale_factor=prescale, postscale_factor=postscale)
+
+
+def serialize_response_list(rl: ResponseList) -> bytes:
+    w = _Writer()
+    w.u8(1 if rl.shutdown else 0)
+    w.f64(rl.tuned_cycle_time_ms)
+    w.i64(rl.tuned_fusion_threshold_bytes)
+    w.u32(len(rl.responses))
+    for resp in rl.responses:
+        _write_response(w, resp)
+    return w.bytes()
+
+
+def parse_response_list(data: bytes) -> ResponseList:
+    r = _Reader(data)
+    shutdown = bool(r.u8())
+    tuned_cycle = r.f64()
+    tuned_fusion = r.i64()
+    n = r.u32()
+    return ResponseList([_read_response(r) for _ in range(n)], shutdown,
+                        tuned_cycle_time_ms=tuned_cycle,
+                        tuned_fusion_threshold_bytes=tuned_fusion)

@@ -1,0 +1,205 @@
+"""Framework-neutral collective ops API (numpy / jax host tensors).
+
+Equivalent of the reference's per-framework ``mpi_ops.py`` surfaces
+(reference: horovod/torch/mpi_ops.py — sync + async + in-place variants,
+handle map, poll/synchronize; horovod/tensorflow/mpi_ops.py), minus the
+framework graph integration, which lives in horovod_tpu.jax / .torch.
+
+Every op has a sync and an ``_async`` form returning an integer handle;
+``poll`` / ``synchronize`` mirror the reference's handle protocol
+(reference: horovod/torch/handle_manager.h:31-42). Auto-generated names
+use per-op counters, which agree across ranks as long as ops are created
+in the same order — same contract as the reference's
+``allreduce.noname.<n>`` naming.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+from horovod_tpu.common import basics
+from horovod_tpu.common.message import (
+    RequestType, numpy_dtype_to_datatype,
+)
+from horovod_tpu.common.status import HorovodInternalError, Status
+from horovod_tpu.common.tensor_table import TensorTableEntry
+
+# Reduction op constants (modern-horovod compatible; the reference's
+# `average=True` flag maps onto these).
+Average = 0
+Sum = 1
+
+_counter_lock = threading.Lock()
+_counters = {}
+
+
+def _auto_name(kind: str) -> str:
+    with _counter_lock:
+        n = _counters.get(kind, 0)
+        _counters[kind] = n + 1
+    return f"{kind}.noname.{n}"
+
+
+def reset_name_counters() -> None:
+    """Called by init() so re-initialized worlds agree on auto names."""
+    with _counter_lock:
+        _counters.clear()
+
+
+def _inspect(tensor):
+    """-> (payload, context, device, np_dtype, shape, ready_fn)"""
+    if isinstance(tensor, np.ndarray) or np.isscalar(tensor):
+        arr = np.asarray(tensor)
+        return arr, None, -1, arr.dtype, arr.shape, None
+    # duck-type jax arrays without importing jax eagerly
+    mod = type(tensor).__module__
+    if mod.startswith("jax") or hasattr(tensor, "addressable_shards"):
+        try:
+            dev = sorted(d.id for d in tensor.devices())[0]
+        except Exception:
+            dev = 0
+        ready_fn = None
+        if hasattr(tensor, "is_ready"):
+            ready_fn = tensor.is_ready
+        return (tensor, "jax", dev, np.dtype(tensor.dtype),
+                tuple(tensor.shape), ready_fn)
+    arr = np.asarray(tensor)
+    return arr, None, -1, arr.dtype, arr.shape, None
+
+
+def _enqueue(kind: RequestType, tensor, name: Optional[str],
+             root_rank: int = -1, prescale: float = 1.0,
+             postscale: float = 1.0) -> int:
+    rt = basics.runtime()
+    payload, ctx, device, np_dtype, shape, ready_fn = _inspect(tensor)
+    dtype = numpy_dtype_to_datatype(np_dtype)
+    name = name or _auto_name(kind.name.lower())
+    handle = rt.handle_manager.allocate()
+
+    entry = TensorTableEntry(tensor_name=name, tensor=payload,
+                             root_rank=root_rank, device=device,
+                             ready_fn=ready_fn, context=ctx)
+
+    def callback(status: Status) -> None:
+        rt.handle_manager.mark_done(handle, status, entry.output)
+
+    entry.callback = callback
+    status = rt.enqueue(kind, entry, dtype, shape, prescale, postscale)
+    if not status.ok():
+        rt.handle_manager.mark_done(handle, status, None)
+    return handle
+
+
+def poll(handle: int) -> bool:
+    """True when the op behind ``handle`` has completed
+    (reference: horovod/torch/mpi_ops.py poll)."""
+    return basics.runtime().handle_manager.poll(handle)
+
+
+def synchronize(handle: int) -> Any:
+    """Block until completion; raise on error; return the output tensor
+    (reference: horovod/torch/mpi_ops.py synchronize + WaitAndClear)."""
+    rt = basics.runtime()
+    status = rt.handle_manager.wait(handle)
+    output = rt.handle_manager.release(handle)
+    if not status.ok():
+        raise HorovodInternalError(status.reason)
+    return output
+
+
+# -- allreduce -----------------------------------------------------------
+def _check_scalable_dtype(tensor, op, prescale, postscale, opname):
+    """Integer tensors cannot be averaged or scaled — the factor would be
+    truncated to 0 in the tensor dtype, silently corrupting results."""
+    kind = np.dtype(tensor.dtype).kind if hasattr(tensor, "dtype") \
+        else np.asarray(tensor).dtype.kind
+    if kind in "iub" and (op == Average or prescale != 1.0
+                          or postscale != 1.0):
+        raise ValueError(
+            f"Averaging or scaling during {opname} is not supported for "
+            "integer tensors; use op=Sum with unit scale factors.")
+
+
+def allreduce_async(tensor, average: Optional[bool] = None,
+                    name: Optional[str] = None, op: Optional[int] = None,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0) -> int:
+    """Sum (or average) ``tensor`` across ranks
+    (reference: horovod/torch/mpi_ops.py allreduce_async,
+    horovod/tensorflow/__init__.py:46-92)."""
+    if average is None and op is None:
+        op = Average
+    elif op is None:
+        op = Average if average else Sum
+    _check_scalable_dtype(tensor, op, prescale_factor, postscale_factor,
+                          "allreduce")
+    if op == Average:
+        postscale_factor = postscale_factor / basics.size()
+    return _enqueue(RequestType.ALLREDUCE, tensor, name,
+                    prescale=prescale_factor, postscale=postscale_factor)
+
+
+def allreduce(tensor, average: Optional[bool] = None,
+              name: Optional[str] = None, op: Optional[int] = None,
+              prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0) -> Any:
+    return synchronize(allreduce_async(tensor, average, name, op,
+                                       prescale_factor, postscale_factor))
+
+
+# -- allgather -----------------------------------------------------------
+def allgather_async(tensor, name: Optional[str] = None) -> int:
+    """Concatenate each rank's tensor along dim 0; dim 0 may differ per
+    rank (reference: horovod/common/ops/mpi_operations.cc:95-173
+    MPI_Allgatherv semantics)."""
+    return _enqueue(RequestType.ALLGATHER, tensor, name)
+
+
+def allgather(tensor, name: Optional[str] = None) -> Any:
+    return synchronize(allgather_async(tensor, name))
+
+
+# -- broadcast -----------------------------------------------------------
+def broadcast_async(tensor, root_rank: int,
+                    name: Optional[str] = None) -> int:
+    return _enqueue(RequestType.BROADCAST, tensor, name,
+                    root_rank=root_rank)
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None) -> Any:
+    return synchronize(broadcast_async(tensor, root_rank, name))
+
+
+# -- alltoall (TPU-native extension) -------------------------------------
+def alltoall_async(tensor, name: Optional[str] = None) -> int:
+    """Scatter dim-0 blocks to every rank and gather their blocks back;
+    requires dim 0 divisible by size."""
+    return _enqueue(RequestType.ALLTOALL, tensor, name)
+
+
+def alltoall(tensor, name: Optional[str] = None) -> Any:
+    return synchronize(alltoall_async(tensor, name))
+
+
+# -- reducescatter (TPU-native extension) --------------------------------
+def reducescatter_async(tensor, name: Optional[str] = None,
+                        op: int = Sum) -> int:
+    _check_scalable_dtype(tensor, op, 1.0, 1.0, "reducescatter")
+    postscale = 1.0 / basics.size() if op == Average else 1.0
+    return _enqueue(RequestType.REDUCESCATTER, tensor, name,
+                    postscale=postscale)
+
+
+def reducescatter(tensor, name: Optional[str] = None, op: int = Sum) -> Any:
+    return synchronize(reducescatter_async(tensor, name, op))
+
+
+# -- barrier -------------------------------------------------------------
+def barrier(name: Optional[str] = None) -> None:
+    """Block until every rank reaches the barrier."""
+    handle = _enqueue(RequestType.BARRIER,
+                      np.zeros((), np.uint8), name or _auto_name("barrier"))
+    synchronize(handle)

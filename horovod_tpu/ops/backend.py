@@ -1,0 +1,50 @@
+"""Collective backend interface.
+
+Equivalent of the reference's ``HorovodOp`` hierarchy and its
+``Enabled()`` protocol (reference: horovod/common/ops/
+collective_operations.h:29-117): a backend reports whether it can run a
+given batch of entries, and the OperationManager walks a priority list,
+first enabled wins (reference: ops/operation_manager.cc:32-60).
+
+Backends execute a whole (possibly fused) Response at once — the fusion
+buffer pack/collective/unpack of the reference's
+``MemcpyInFusionBuffer``/``MemcpyOutFusionBuffer``
+(reference: ops/collective_operations.cc:35-63) happens inside
+``execute_allreduce`` so each backend can fuse the way its transport
+likes (numpy concatenation for the socket path, XLA concat/slice —
+fused into the collective by the compiler — for the mesh path).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from horovod_tpu.common.message import Response
+from horovod_tpu.common.status import Status
+from horovod_tpu.common.tensor_table import TensorTableEntry
+
+
+class CollectiveBackend:
+    name = "abstract"
+
+    def enabled(self, entries: List[TensorTableEntry],
+                response: Response) -> bool:
+        raise NotImplementedError
+
+    def execute_allreduce(self, entries, response) -> Status:
+        raise NotImplementedError
+
+    def execute_allgather(self, entries, response) -> Status:
+        raise NotImplementedError
+
+    def execute_broadcast(self, entries, response) -> Status:
+        raise NotImplementedError
+
+    def execute_alltoall(self, entries, response) -> Status:
+        raise NotImplementedError
+
+    def execute_reducescatter(self, entries, response) -> Status:
+        raise NotImplementedError
+
+    def execute_barrier(self, entries, response) -> Status:
+        return Status.OK()

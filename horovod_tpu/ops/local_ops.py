@@ -1,0 +1,59 @@
+"""Size-1 world backend: collectives degenerate to local transforms.
+
+The reference has no explicit size-1 backend (MPI handles it), but a
+TPU-native framework must run single-process without any transport. All
+ops preserve the scaling contract (prescale × postscale) so a size-1 run
+is numerically identical to a size-N run divided down.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from horovod_tpu.ops.backend import CollectiveBackend
+from horovod_tpu.common.status import Status
+
+
+def _scale(arr, pre: float, post: float):
+    factor = pre * post
+    if factor == 1.0:
+        return arr
+    return arr * np.asarray(factor, dtype=arr.dtype) \
+        if isinstance(arr, np.ndarray) else arr * factor
+
+
+class LocalBackend(CollectiveBackend):
+    name = "local"
+
+    def __init__(self, size_fn):
+        self._size_fn = size_fn
+
+    def enabled(self, entries, response) -> bool:
+        return self._size_fn() == 1
+
+    def execute_allreduce(self, entries, response) -> Status:
+        for e in entries:
+            e.output = _scale(e.tensor, response.prescale_factor,
+                              response.postscale_factor)
+        return Status.OK()
+
+    def execute_allgather(self, entries, response) -> Status:
+        for e in entries:
+            e.output = e.tensor
+        return Status.OK()
+
+    def execute_broadcast(self, entries, response) -> Status:
+        for e in entries:
+            e.output = e.tensor
+        return Status.OK()
+
+    def execute_alltoall(self, entries, response) -> Status:
+        for e in entries:
+            e.output = e.tensor
+        return Status.OK()
+
+    def execute_reducescatter(self, entries, response) -> Status:
+        for e in entries:
+            e.output = _scale(e.tensor, response.prescale_factor,
+                              response.postscale_factor)
+        return Status.OK()

@@ -1,0 +1,49 @@
+"""Priority dispatch across collective backends.
+
+(reference: horovod/common/ops/operation_manager.{h,cc} — ordered op
+lists, first ``Enabled()`` op wins, operation_manager.cc:32-60; the
+priority order itself is set in ``CreateOperationManager``,
+operations.cc:125-158: accelerator ops first, host fallbacks always
+last.) Here the order is XLA-mesh (ICI/DCN) → TCP socket (host) →
+local (size-1).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from horovod_tpu.common.message import Response, ResponseType
+from horovod_tpu.common.status import Status
+from horovod_tpu.common.tensor_table import TensorTableEntry
+from horovod_tpu.ops.backend import CollectiveBackend
+
+
+class OperationManager:
+    def __init__(self, backends: List[CollectiveBackend]):
+        self._backends = backends
+
+    def _pick(self, entries, response) -> CollectiveBackend:
+        for b in self._backends:
+            if b.enabled(entries, response):
+                return b
+        raise RuntimeError(
+            f"No collective backend enabled for response "
+            f"{response.response_type.name} ({response.tensor_names})")
+
+    def execute(self, entries: List[TensorTableEntry],
+                response: Response) -> Status:
+        backend = self._pick(entries, response)
+        rt = response.response_type
+        if rt == ResponseType.ALLREDUCE:
+            return backend.execute_allreduce(entries, response)
+        if rt == ResponseType.ALLGATHER:
+            return backend.execute_allgather(entries, response)
+        if rt == ResponseType.BROADCAST:
+            return backend.execute_broadcast(entries, response)
+        if rt == ResponseType.ALLTOALL:
+            return backend.execute_alltoall(entries, response)
+        if rt == ResponseType.REDUCESCATTER:
+            return backend.execute_reducescatter(entries, response)
+        if rt == ResponseType.BARRIER:
+            return backend.execute_barrier(entries, response)
+        raise ValueError(f"Cannot execute response type {rt}")

@@ -1,0 +1,2 @@
+"""Optimization utilities for the autotuner
+(reference: horovod/common/optim/)."""

@@ -1,0 +1,84 @@
+"""Bayesian optimization over the tuning box.
+
+(reference: horovod/common/optim/bayesian_optimization.{h,cc} — GP
+surrogate + Expected Improvement acquisition, maximized with L-BFGS in
+the reference; on a 2-D box a dense random-candidate search is simpler
+and equally effective, and has no native dependency.)
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from horovod_tpu.optim.gaussian_process import GaussianProcessRegressor
+
+
+class BayesianOptimization:
+    def __init__(self, bounds: List[Tuple[float, float]],
+                 alpha: float = 1e-8, xi: float = 0.01,
+                 seed: int = 0):
+        """``bounds`` = [(lo, hi)] per dimension
+        (reference: bayesian_optimization.h:40-60)."""
+        self.bounds = np.asarray(bounds, np.float64)
+        self.dim = len(bounds)
+        self.xi = xi
+        self._gp = GaussianProcessRegressor(alpha=alpha)
+        self._xs: List[np.ndarray] = []
+        self._ys: List[float] = []
+        self._rng = np.random.RandomState(seed)
+
+    def _normalize(self, x: np.ndarray) -> np.ndarray:
+        lo, hi = self.bounds[:, 0], self.bounds[:, 1]
+        return (x - lo) / np.maximum(hi - lo, 1e-12)
+
+    def _denormalize(self, z: np.ndarray) -> np.ndarray:
+        lo, hi = self.bounds[:, 0], self.bounds[:, 1]
+        return lo + z * (hi - lo)
+
+    def add_sample(self, x, y: float) -> None:
+        """(reference: bayesian_optimization.cc AddSample)"""
+        self._xs.append(self._normalize(np.asarray(x, np.float64)))
+        self._ys.append(float(y))
+
+    def _expected_improvement(self, z: np.ndarray) -> np.ndarray:
+        """(reference: bayesian_optimization.h:85-93 ExpectedImprovement)"""
+        mean, std = self._gp.predict(z)
+        best = max(self._ys)
+        imp = mean - best - self.xi
+        zed = np.where(std > 0, imp / std, 0.0)
+        # standard normal pdf/cdf without scipy
+        pdf = np.exp(-0.5 * zed ** 2) / np.sqrt(2 * np.pi)
+        cdf = 0.5 * (1.0 + _erf(zed / np.sqrt(2.0)))
+        ei = imp * cdf + std * pdf
+        return np.where(std > 0, ei, 0.0)
+
+    def next_sample(self) -> np.ndarray:
+        """Fit the GP and return the EI-maximizing point
+        (reference: bayesian_optimization.cc NextSample)."""
+        if not self._xs:
+            return self._denormalize(self._rng.uniform(size=self.dim))
+        self._gp.fit(np.stack(self._xs), np.asarray(self._ys))
+        cand = self._rng.uniform(size=(2048, self.dim))
+        ei = self._expected_improvement(cand)
+        return self._denormalize(cand[int(np.argmax(ei))])
+
+    def best(self) -> Tuple[Optional[np.ndarray], float]:
+        if not self._ys:
+            return None, float("-inf")
+        i = int(np.argmax(self._ys))
+        return self._denormalize(self._xs[i]), self._ys[i]
+
+
+def _erf(x: np.ndarray) -> np.ndarray:
+    """Vectorized erf (Abramowitz & Stegun 7.1.26, |err| < 1.5e-7)."""
+    sign = np.sign(x)
+    x = np.abs(x)
+    a1, a2, a3, a4, a5 = (0.254829592, -0.284496736, 1.421413741,
+                          -1.453152027, 1.061405429)
+    p = 0.3275911
+    t = 1.0 / (1.0 + p * x)
+    y = 1.0 - (((((a5 * t + a4) * t) + a3) * t + a2) * t + a1) * t \
+        * np.exp(-x * x)
+    return sign * y

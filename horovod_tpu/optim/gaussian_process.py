@@ -1,0 +1,68 @@
+"""Gaussian-process regression surrogate for the autotuner.
+
+(reference: horovod/common/optim/gaussian_process.{h,cc} — the reference
+uses Eigen + Cholesky; we use numpy, same math: RBF kernel, jittered
+Cholesky solve, predictive mean/variance.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class GaussianProcessRegressor:
+    """RBF-kernel GP with observation noise alpha
+    (reference: gaussian_process.h:30-58)."""
+
+    def __init__(self, alpha: float = 1e-8, length_scale: float = 1.0,
+                 signal_variance: float = 1.0):
+        self.alpha = alpha
+        self.length_scale = length_scale
+        self.signal_variance = signal_variance
+        self._x = None
+        self._y = None
+        self._l = None       # cholesky factor
+        self._alpha_vec = None
+
+    def _kernel(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        # squared-exponential kernel (reference: gaussian_process.cc Kernel)
+        d2 = (np.sum(a ** 2, axis=1)[:, None]
+              + np.sum(b ** 2, axis=1)[None, :]
+              - 2.0 * a @ b.T)
+        return self.signal_variance * np.exp(-0.5 * np.maximum(d2, 0.0)
+                                             / self.length_scale ** 2)
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> None:
+        x = np.atleast_2d(np.asarray(x, np.float64))
+        y = np.asarray(y, np.float64).reshape(-1)
+        k = self._kernel(x, x)
+        k[np.diag_indices_from(k)] += self.alpha
+        # jittered cholesky for numerical safety
+        jitter = 0.0
+        for _ in range(6):
+            try:
+                self._l = np.linalg.cholesky(
+                    k + jitter * np.eye(len(k)))
+                break
+            except np.linalg.LinAlgError:
+                jitter = max(jitter * 10.0, 1e-10)
+        else:
+            raise np.linalg.LinAlgError("GP kernel not PD")
+        self._x = x
+        self._y = y
+        z = np.linalg.solve(self._l, y)
+        self._alpha_vec = np.linalg.solve(self._l.T, z)
+
+    def predict(self, x: np.ndarray):
+        """-> (mean, std) at query points
+        (reference: gaussian_process.cc Predict...)."""
+        x = np.atleast_2d(np.asarray(x, np.float64))
+        if self._x is None:
+            return (np.zeros(len(x)),
+                    np.sqrt(self.signal_variance) * np.ones(len(x)))
+        ks = self._kernel(x, self._x)
+        mean = ks @ self._alpha_vec
+        v = np.linalg.solve(self._l, ks.T)
+        var = (self.signal_variance + self.alpha
+               - np.sum(v ** 2, axis=0))
+        return mean, np.sqrt(np.maximum(var, 1e-12))

@@ -1,0 +1,25 @@
+"""Test configuration.
+
+Force JAX onto a virtual 8-device CPU platform *before* jax is imported
+anywhere, so SPMD/mesh tests exercise real multi-device sharding without
+TPU hardware (the driver separately dry-runs the multi-chip path; see
+__graft_entry__.py)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def hvd_world():
+    """A fresh size-1 horovod_tpu world per test."""
+    import horovod_tpu as hvd
+    hvd.init()
+    yield hvd
+    hvd.shutdown()

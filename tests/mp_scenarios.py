@@ -1,0 +1,193 @@
+"""Multi-process test scenarios, run as subprocesses by
+test_multiprocess.py — the TPU build's analog of the reference running
+its pytest suite under ``mpirun -np 2`` (reference: .travis.yml:109-122).
+
+Each scenario function runs on every rank with hvd initialized; it must
+assert its own correctness and return. Invoked as:
+
+    python -m tests.mp_scenarios <scenario> <rank> <size> <port>
+"""
+
+import os
+import sys
+
+import numpy as np
+
+
+def scenario_allreduce(hvd, rank, size):
+    x = np.full((4, 3), float(rank + 1), np.float32)
+    out = hvd.allreduce(x, average=False, name="ar")
+    expected = np.full((4, 3), sum(range(1, size + 1)), np.float32)
+    np.testing.assert_allclose(out, expected)
+    # average
+    out = hvd.allreduce(x, average=True, name="ar_avg")
+    np.testing.assert_allclose(
+        out, expected / size)
+
+
+def scenario_allreduce_fused(hvd, rank, size):
+    """Many small async allreduces in one cycle → fused execution
+    (reference analog: test_horovod_allreduce_cpu_fused,
+    test_tensorflow.py:107)."""
+    handles = [hvd.allreduce_async(
+        np.full(10, float(rank + 1) * (i + 1), np.float64),
+        average=False, name=f"f/{i}") for i in range(30)]
+    ssum = sum(range(1, size + 1))
+    for i, h in enumerate(handles):
+        np.testing.assert_allclose(
+            hvd.synchronize(h), np.full(10, ssum * (i + 1), np.float64))
+
+
+def scenario_allreduce_multi_dtype(hvd, rank, size):
+    for dt in (np.int32, np.int64, np.float16, np.float32, np.float64):
+        x = (np.arange(6) + rank).astype(dt)
+        out = hvd.allreduce(x, average=False, name=f"dt/{np.dtype(dt)}")
+        expected = (size * np.arange(6) + sum(range(size))).astype(dt)
+        np.testing.assert_allclose(np.asarray(out, np.float64),
+                                   expected.astype(np.float64))
+
+
+def scenario_allgather(hvd, rank, size):
+    # variable dim-0 per rank (reference: test_tensorflow.py:454-557)
+    x = np.full((rank + 1, 2), float(rank), np.float32)
+    out = hvd.allgather(x, name="ag")
+    assert out.shape == (sum(r + 1 for r in range(size)), 2)
+    offset = 0
+    for r in range(size):
+        np.testing.assert_allclose(out[offset:offset + r + 1],
+                                   np.full((r + 1, 2), float(r)))
+        offset += r + 1
+
+
+def scenario_broadcast(hvd, rank, size):
+    for root in range(size):
+        x = np.full((3, 3), float(rank * 10), np.float64)
+        out = hvd.broadcast(x, root_rank=root, name=f"bc/{root}")
+        np.testing.assert_allclose(out, np.full((3, 3), float(root * 10)))
+
+
+def scenario_alltoall(hvd, rank, size):
+    per = 2
+    x = np.arange(size * per, dtype=np.float32) + 100 * rank
+    out = hvd.alltoall(x, name="a2a")
+    expected = np.concatenate(
+        [np.arange(rank * per, (rank + 1) * per) + 100 * src
+         for src in range(size)]).astype(np.float32)
+    np.testing.assert_allclose(out, expected)
+
+
+def scenario_reducescatter(hvd, rank, size):
+    x = np.arange(size * 3, dtype=np.float32) * (rank + 1)
+    out = hvd.reducescatter(x, name="rs")
+    ssum = sum(range(1, size + 1))
+    expected = (np.arange(size * 3, dtype=np.float32)
+                * ssum)[rank * 3:(rank + 1) * 3]
+    np.testing.assert_allclose(out, expected)
+
+
+def scenario_barrier(hvd, rank, size):
+    import time
+    t0 = time.monotonic()
+    if rank == 0:
+        time.sleep(0.5)
+    hvd.barrier(name="b1")
+    if rank != 0:
+        assert time.monotonic() - t0 >= 0.4, "barrier did not block"
+
+
+def scenario_shape_mismatch_error(hvd, rank, size):
+    # (reference: test_horovod_allreduce_error, test_tensorflow.py:265)
+    from horovod_tpu.common.status import HorovodInternalError
+    shape = (4, 5) if rank == 0 else (4, 6)
+    try:
+        hvd.allreduce(np.ones(shape, np.float32), name="bad_shape")
+    except HorovodInternalError as e:
+        assert "shape" in str(e).lower()
+    else:
+        raise AssertionError("expected HorovodInternalError")
+    # world must still be usable after an ERROR response
+    out = hvd.allreduce(np.ones(3, np.float32), average=False,
+                        name="after_err")
+    np.testing.assert_allclose(out, size * np.ones(3))
+
+
+def scenario_dtype_mismatch_error(hvd, rank, size):
+    # (reference: test_tensorflow.py:293)
+    from horovod_tpu.common.status import HorovodInternalError
+    dt = np.float32 if rank == 0 else np.float64
+    try:
+        hvd.allreduce(np.ones(4, dt), name="bad_dtype")
+    except HorovodInternalError as e:
+        assert "data type" in str(e).lower()
+    else:
+        raise AssertionError("expected HorovodInternalError")
+
+
+def scenario_root_rank_mismatch_error(hvd, rank, size):
+    # (reference: test_tensorflow.py:708)
+    from horovod_tpu.common.status import HorovodInternalError
+    try:
+        hvd.broadcast(np.ones(4), root_rank=rank % size, name="bad_root")
+    except HorovodInternalError as e:
+        assert "root rank" in str(e).lower()
+    else:
+        raise AssertionError("expected HorovodInternalError")
+
+
+def scenario_rank_subset_order(hvd, rank, size):
+    """Out-of-order submission across ranks must still converge: rank 0
+    submits a,b; rank 1 submits b,a — negotiation totals the order."""
+    names = ["oo/a", "oo/b"] if rank == 0 else ["oo/b", "oo/a"]
+    handles = {n: hvd.allreduce_async(np.full(5, float(rank), np.float32),
+                                      average=False, name=n)
+               for n in names}
+    total = sum(range(size))
+    for n, h in handles.items():
+        np.testing.assert_allclose(hvd.synchronize(h),
+                                   np.full(5, float(total)))
+
+
+def scenario_topology(hvd, rank, size):
+    assert hvd.rank() == rank
+    assert hvd.size() == size
+    # all ranks in these tests run on one host
+    assert hvd.local_size() == size
+    assert hvd.local_rank() == rank
+    assert hvd.cross_size() == 1
+    assert hvd.is_homogeneous()
+
+
+def scenario_stall_shutdown(hvd, rank, size):
+    """Rank 1 never submits; stall inspector must shut the job down
+    (reference analog: test/test_stall.py)."""
+    from horovod_tpu.common.status import HorovodInternalError
+    if rank == 0:
+        try:
+            hvd.allreduce(np.ones(4, np.float32), name="stalled")
+        except HorovodInternalError:
+            return
+        raise AssertionError("expected stall shutdown error")
+    else:
+        import time
+        time.sleep(5.0)
+
+
+def main():
+    scenario, rank, size, port = (sys.argv[1], int(sys.argv[2]),
+                                  int(sys.argv[3]), int(sys.argv[4]))
+    os.environ["HOROVOD_RANK"] = str(rank)
+    os.environ["HOROVOD_SIZE"] = str(size)
+    os.environ["HOROVOD_CONTROLLER_ADDR"] = "127.0.0.1"
+    os.environ["HOROVOD_CONTROLLER_PORT"] = str(port)
+    os.environ.setdefault("HOROVOD_CYCLE_TIME", "1")
+    import horovod_tpu as hvd
+    hvd.init()
+    try:
+        fn = globals()[f"scenario_{scenario}"]
+        fn(hvd, rank, size)
+    finally:
+        hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""Autotuner tests (reference analog: the reference has no dedicated
+autotune tests; we cover the GP/EI machinery and the ParameterManager
+sampling loop directly — reference: horovod/common/parameter_manager.cc,
+optim/bayesian_optimization.cc)."""
+
+import numpy as np
+
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.controller import LocalController
+from horovod_tpu.common.parameter_manager import ParameterManager
+from horovod_tpu.optim.bayesian_optimization import BayesianOptimization
+from horovod_tpu.optim.gaussian_process import GaussianProcessRegressor
+
+
+class TestGaussianProcess:
+    def test_fit_predict_interpolates(self):
+        gp = GaussianProcessRegressor(alpha=1e-10)
+        x = np.array([[0.0], [0.5], [1.0]])
+        y = np.array([0.0, 1.0, 0.0])
+        gp.fit(x, y)
+        mean, std = gp.predict(x)
+        np.testing.assert_allclose(mean, y, atol=1e-4)
+        assert np.all(std < 1e-2)
+
+    def test_predict_without_fit(self):
+        gp = GaussianProcessRegressor()
+        mean, std = gp.predict(np.array([[0.3]]))
+        assert mean[0] == 0.0
+        assert std[0] > 0
+
+
+class TestBayesianOptimization:
+    def test_finds_peak_of_smooth_function(self):
+        # maximize -(x-0.7)^2 on [0, 1]
+        bo = BayesianOptimization(bounds=[(0.0, 1.0)], alpha=1e-6, seed=1)
+        x = bo.next_sample()
+        for _ in range(20):
+            y = -(float(x[0]) - 0.7) ** 2
+            bo.add_sample(x, y)
+            x = bo.next_sample()
+        best, score = bo.best()
+        assert abs(best[0] - 0.7) < 0.15
+
+    def test_respects_bounds(self):
+        bo = BayesianOptimization(bounds=[(2.0, 4.0), (10.0, 20.0)], seed=0)
+        for _ in range(5):
+            x = bo.next_sample()
+            assert 2.0 <= x[0] <= 4.0
+            assert 10.0 <= x[1] <= 20.0
+            bo.add_sample(x, float(np.sum(x)))
+
+
+class TestParameterManager:
+    def _make(self, tmp_path=None):
+        cfg = Config()
+        cfg.autotune = True
+        cfg.autotune_warmup_samples = 1
+        cfg.autotune_steps_per_sample = 2
+        cfg.autotune_bayes_opt_max_samples = 4
+        if tmp_path is not None:
+            cfg.autotune_log = str(tmp_path / "autotune.csv")
+        return ParameterManager(cfg, LocalController())
+
+    def test_tunes_then_converges(self, tmp_path):
+        pm = self._make(tmp_path)
+        initial = (pm.fusion_threshold_bytes(), pm.cycle_time_ms())
+        assert pm._tuning
+        # drive enough cycles: warmup 1 sample + 4 samples × 3 medians,
+        # 2 cycles each
+        for _ in range(2 * (1 + 4 * 3) + 4):
+            pm.on_cycle(1 << 20)
+        assert not pm._tuning
+        assert 0 <= pm.fusion_threshold_bytes() <= 64 << 20
+        assert 1.0 <= pm.cycle_time_ms() <= 100.0
+        log = (tmp_path / "autotune.csv").read_text().strip().splitlines()
+        assert log[0].startswith("sample,")
+        assert len(log) == 5  # header + 4 samples
+
+    def test_worker_applies_synced_params(self):
+        cfg = Config()
+        cfg.autotune = True
+
+        class _W:
+            rank = 1
+        pm = ParameterManager(cfg, _W())
+        pm.apply_synced(32 << 20, 7.5)
+        assert pm.fusion_threshold_bytes() == 32 << 20
+        assert pm.cycle_time_ms() == 7.5

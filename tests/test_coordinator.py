@@ -1,0 +1,169 @@
+"""Coordinator negotiation logic tests (reference analog: the 2-rank
+mismatch tests in test/test_tensorflow.py:265-332 run end-to-end; here
+we additionally unit-test the decision core the way the TPU build can,
+since it is pure logic — reference: operations.cc:163-399,1118-1234)."""
+
+import pytest
+
+from horovod_tpu.common.coordinator import (
+    MessageTable, construct_response, fuse_responses,
+)
+from horovod_tpu.common.message import (
+    DataType, Request, RequestType, Response, ResponseType,
+)
+
+
+def _req(rank, name="t", op=RequestType.ALLREDUCE,
+         dtype=DataType.FLOAT32, shape=(4, 2), root=-1, device=-1):
+    return Request(request_rank=rank, request_type=op, tensor_type=dtype,
+                   tensor_name=name, root_rank=root, device=device,
+                   tensor_shape=shape)
+
+
+class TestMessageTable:
+    def test_ready_when_all_ranks_report(self):
+        t = MessageTable()
+        assert not t.increment_tensor_count(_req(0), size=3)
+        assert not t.increment_tensor_count(_req(1), size=3)
+        assert t.increment_tensor_count(_req(2), size=3)
+        assert t.pop_ready() == ["t"]
+        assert t.pop_ready() == []
+
+    def test_readiness_order_is_fifo(self):
+        t = MessageTable()
+        for r in range(2):
+            t.increment_tensor_count(_req(r, "b"), 2)
+            t.increment_tensor_count(_req(r, "a"), 2)
+        assert t.pop_ready() == ["b", "a"]
+
+
+class TestConstructResponse:
+    def _negotiate(self, requests, size):
+        t = MessageTable()
+        for r in requests:
+            t.increment_tensor_count(r, size)
+        return construct_response(t, requests[0].tensor_name, size)
+
+    def test_allreduce_ok(self):
+        resp = self._negotiate([_req(0), _req(1)], 2)
+        assert resp.response_type == ResponseType.ALLREDUCE
+        assert resp.tensor_names == ["t"]
+        assert resp.tensor_sizes == [8]
+
+    def test_mismatched_dtype_is_error(self):
+        resp = self._negotiate(
+            [_req(0, dtype=DataType.FLOAT32),
+             _req(1, dtype=DataType.FLOAT64)], 2)
+        assert resp.response_type == ResponseType.ERROR
+        assert "data type" in resp.error_message.lower()
+
+    def test_mismatched_op_is_error(self):
+        resp = self._negotiate(
+            [_req(0, op=RequestType.ALLREDUCE),
+             _req(1, op=RequestType.ALLGATHER, shape=(3, 2))], 2)
+        assert resp.response_type == ResponseType.ERROR
+        assert "operation" in resp.error_message.lower()
+
+    def test_mismatched_allreduce_shape_is_error(self):
+        resp = self._negotiate([_req(0, shape=(4, 2)),
+                                _req(1, shape=(4, 3))], 2)
+        assert resp.response_type == ResponseType.ERROR
+        assert "shape" in resp.error_message.lower()
+
+    def test_mixed_placement_is_error(self):
+        resp = self._negotiate([_req(0, device=-1), _req(1, device=0)], 2)
+        assert resp.response_type == ResponseType.ERROR
+        assert "placement" in resp.error_message.lower()
+
+    def test_allgather_variable_dim0_ok(self):
+        resp = self._negotiate(
+            [_req(0, op=RequestType.ALLGATHER, shape=(5, 3)),
+             _req(1, op=RequestType.ALLGATHER, shape=(2, 3))], 2)
+        assert resp.response_type == ResponseType.ALLGATHER
+        assert resp.tensor_sizes == [5, 2]
+
+    def test_allgather_mismatched_higher_dim_is_error(self):
+        resp = self._negotiate(
+            [_req(0, op=RequestType.ALLGATHER, shape=(5, 3)),
+             _req(1, op=RequestType.ALLGATHER, shape=(2, 4))], 2)
+        assert resp.response_type == ResponseType.ERROR
+
+    def test_allgather_mismatched_rank_is_error(self):
+        resp = self._negotiate(
+            [_req(0, op=RequestType.ALLGATHER, shape=(5, 3)),
+             _req(1, op=RequestType.ALLGATHER, shape=(5, 3, 1))], 2)
+        assert resp.response_type == ResponseType.ERROR
+        assert "rank" in resp.error_message.lower()
+
+    def test_broadcast_mismatched_root_is_error(self):
+        resp = self._negotiate(
+            [_req(0, op=RequestType.BROADCAST, root=0),
+             _req(1, op=RequestType.BROADCAST, root=1)], 2)
+        assert resp.response_type == ResponseType.ERROR
+        assert "root rank" in resp.error_message.lower()
+
+    def test_broadcast_ok(self):
+        resp = self._negotiate(
+            [_req(0, op=RequestType.BROADCAST, root=1),
+             _req(1, op=RequestType.BROADCAST, root=1)], 2)
+        assert resp.response_type == ResponseType.BROADCAST
+
+    def test_alltoall_indivisible_dim0_is_error(self):
+        resp = self._negotiate(
+            [_req(0, op=RequestType.ALLTOALL, shape=(5, 3)),
+             _req(1, op=RequestType.ALLTOALL, shape=(5, 3))], 2)
+        assert resp.response_type == ResponseType.ERROR
+        assert "divisible" in resp.error_message
+
+
+class TestFusion:
+    def _ar(self, name, numel):
+        return Response(response_type=ResponseType.ALLREDUCE,
+                        tensor_names=[name], devices=[-1, -1],
+                        tensor_sizes=[numel])
+
+    def test_fuses_under_threshold(self):
+        dtypes = {"a": DataType.FLOAT32, "b": DataType.FLOAT32}
+        fused = fuse_responses([self._ar("a", 10), self._ar("b", 10)],
+                               dtypes, fusion_threshold_bytes=1024)
+        assert len(fused) == 1
+        assert fused[0].tensor_names == ["a", "b"]
+        assert fused[0].tensor_sizes == [10, 10]
+
+    def test_does_not_fuse_over_threshold(self):
+        dtypes = {"a": DataType.FLOAT32, "b": DataType.FLOAT32}
+        fused = fuse_responses([self._ar("a", 10), self._ar("b", 10)],
+                               dtypes, fusion_threshold_bytes=60)
+        assert len(fused) == 2
+
+    def test_does_not_fuse_mixed_dtypes(self):
+        dtypes = {"a": DataType.FLOAT32, "b": DataType.FLOAT64}
+        fused = fuse_responses([self._ar("a", 10), self._ar("b", 10)],
+                               dtypes, fusion_threshold_bytes=1 << 20)
+        assert len(fused) == 2
+
+    def test_lookahead_skip(self):
+        # a(40B) + c(40B) fuse past the incompatible b (f64), which is
+        # retried afterwards (reference: operations.cc:1118-1234).
+        dtypes = {"a": DataType.FLOAT32, "b": DataType.FLOAT64,
+                  "c": DataType.FLOAT32}
+        fused = fuse_responses(
+            [self._ar("a", 10), self._ar("b", 10), self._ar("c", 10)],
+            dtypes, fusion_threshold_bytes=100)
+        assert [f.tensor_names for f in fused] == [["a", "c"], ["b"]]
+
+    def test_non_allreduce_not_fused(self):
+        dtypes = {"a": DataType.FLOAT32, "g": DataType.FLOAT32,
+                  "b": DataType.FLOAT32}
+        ag = Response(response_type=ResponseType.ALLGATHER,
+                      tensor_names=["g"], devices=[-1, -1],
+                      tensor_sizes=[3, 4])
+        fused = fuse_responses([self._ar("a", 10), ag, self._ar("b", 10)],
+                               dtypes, fusion_threshold_bytes=1 << 20)
+        assert [f.tensor_names for f in fused] == [["a", "b"], ["g"]]
+
+    def test_error_responses_pass_through(self):
+        err = Response(response_type=ResponseType.ERROR,
+                       tensor_names=["x"], error_message="boom")
+        fused = fuse_responses([err], {}, 1 << 20)
+        assert fused == [err]

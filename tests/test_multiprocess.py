@@ -1,0 +1,112 @@
+"""Multi-process integration tests: spawn N real processes that
+negotiate through the TCP controller and move data through the socket
+backend — the TPU build's version of the reference's ``mpirun -np 2
+pytest`` legs (reference: .travis.yml:109-122, test/common.py:25-57)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_scenario(scenario: str, size: int, timeout: float = 90.0,
+                 extra_env=None):
+    port = _free_port()
+    procs = []
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if extra_env:
+        env.update(extra_env)
+    for rank in range(size):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "tests.mp_scenarios", scenario,
+             str(rank), str(size), str(port)],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    failures = []
+    for rank, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise AssertionError(
+                f"scenario {scenario} rank {rank} timed out")
+        if p.returncode != 0:
+            failures.append((rank, p.returncode, out.decode()))
+    assert not failures, "\n".join(
+        f"--- rank {r} exited {rc} ---\n{o}" for r, rc, o in failures)
+
+
+@pytest.mark.parametrize("size", [2, 4])
+def test_allreduce(size):
+    run_scenario("allreduce", size)
+
+
+def test_allreduce_fused():
+    run_scenario("allreduce_fused", 2)
+
+
+def test_allreduce_multi_dtype():
+    run_scenario("allreduce_multi_dtype", 2)
+
+
+@pytest.mark.parametrize("size", [2, 3])
+def test_allgather(size):
+    run_scenario("allgather", size)
+
+
+def test_broadcast():
+    run_scenario("broadcast", 2)
+
+
+def test_alltoall():
+    run_scenario("alltoall", 2)
+
+
+def test_reducescatter():
+    run_scenario("reducescatter", 2)
+
+
+def test_barrier():
+    run_scenario("barrier", 2)
+
+
+def test_shape_mismatch_error():
+    run_scenario("shape_mismatch_error", 2)
+
+
+def test_dtype_mismatch_error():
+    run_scenario("dtype_mismatch_error", 2)
+
+
+def test_root_rank_mismatch_error():
+    run_scenario("root_rank_mismatch_error", 2)
+
+
+def test_out_of_order_submission():
+    run_scenario("rank_subset_order", 2)
+
+
+def test_topology():
+    run_scenario("topology", 2)
+
+
+def test_stall_shutdown():
+    run_scenario(
+        "stall_shutdown", 2, timeout=60.0,
+        extra_env={"HOROVOD_STALL_CHECK_TIME_SECONDS": "1",
+                   "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "2"})

@@ -1,0 +1,153 @@
+"""Single-process (size-1) public API tests: lifecycle, sync/async ops,
+handles, duplicate-name errors (reference analog: single-process legs of
+test/test_torch.py:59-1163 / test_tensorflow.py:63-766)."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.common.status import HorovodInternalError
+
+
+class TestBasics:
+    def test_init_shutdown(self, hvd_world):
+        assert hvd.initialized()
+        assert hvd.rank() == 0
+        assert hvd.size() == 1
+        assert hvd.local_rank() == 0
+        assert hvd.local_size() == 1
+        assert hvd.cross_rank() == 0
+        assert hvd.cross_size() == 1
+        assert hvd.is_homogeneous()
+        assert hvd.mpi_threads_supported()
+
+    def test_uninitialized_raises(self):
+        hvd.shutdown()
+        with pytest.raises(ValueError):
+            hvd.rank()
+
+    def test_double_init_is_noop(self, hvd_world):
+        hvd.init()
+        assert hvd.size() == 1
+
+
+class TestOpsSize1:
+    def test_allreduce_average_identity(self, hvd_world):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        out = hvd.allreduce(x, average=True)
+        np.testing.assert_allclose(out, x)
+
+    def test_allreduce_sum_identity(self, hvd_world):
+        x = np.random.randn(5).astype(np.float64)
+        out = hvd.allreduce(x, average=False)
+        np.testing.assert_allclose(out, x)
+
+    def test_allreduce_prescale(self, hvd_world):
+        x = np.ones(4, np.float32)
+        out = hvd.allreduce(x, op=hvd.Sum, prescale_factor=2.0)
+        np.testing.assert_allclose(out, 2 * x)
+
+    def test_allgather_identity(self, hvd_world):
+        x = np.random.randn(6, 2).astype(np.float32)
+        np.testing.assert_allclose(hvd.allgather(x), x)
+
+    def test_broadcast_identity(self, hvd_world):
+        x = np.random.randn(2, 2)
+        np.testing.assert_allclose(hvd.broadcast(x, root_rank=0), x)
+
+    def test_async_poll_synchronize(self, hvd_world):
+        x = np.ones(1000, np.float32)
+        h = hvd.allreduce_async(x, average=False, name="async_t")
+        while not hvd.poll(h):
+            pass
+        out = hvd.synchronize(h)
+        np.testing.assert_allclose(out, x)
+
+    def test_many_tensors_fused(self, hvd_world):
+        handles = [hvd.allreduce_async(np.full(10, i, np.float32),
+                                       average=False, name=f"fuse/{i}")
+                   for i in range(50)]
+        for i, h in enumerate(handles):
+            np.testing.assert_allclose(hvd.synchronize(h),
+                                       np.full(10, i, np.float32))
+
+    def test_duplicate_name_raises(self, hvd_world):
+        # (reference: operations.cc:1459-1462 DUPLICATE_NAME_ERROR;
+        # test/test_torch.py:356) — two in-flight ops, same name.
+        x = np.ones(4, np.float32)
+        h1 = hvd.allreduce_async(x, name="dup")
+        h2 = hvd.allreduce_async(x, name="dup")
+        statuses = []
+        for h in (h1, h2):
+            try:
+                hvd.synchronize(h)
+                statuses.append("ok")
+            except HorovodInternalError as e:
+                statuses.append("err")
+                assert "same name" in str(e)
+        # The first generally wins, but at minimum exactly one must fail.
+        assert statuses.count("err") >= 1
+
+    def test_jax_array_roundtrip(self, hvd_world):
+        import jax.numpy as jnp
+        x = jnp.arange(8, dtype=jnp.float32)
+        out = hvd.allreduce(x, average=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+    def test_bfloat16_allreduce(self, hvd_world):
+        import ml_dtypes
+        x = np.ones(16, ml_dtypes.bfloat16)
+        out = hvd.allreduce(x, average=False)
+        assert out.dtype == x.dtype
+        np.testing.assert_allclose(np.asarray(out, np.float32), 1.0)
+
+    def test_integer_average_rejected(self, hvd_world):
+        # averaging would truncate the 1/size factor to 0 in the tensor
+        # dtype — must be a loud error, not silent zeros
+        with pytest.raises(ValueError, match="integer"):
+            hvd.allreduce(np.arange(4, dtype=np.int64), average=True)
+        with pytest.raises(ValueError, match="integer"):
+            hvd.allreduce(np.arange(4, dtype=np.int32), op=hvd.Sum,
+                          prescale_factor=0.5)
+
+    def test_alltoall_identity(self, hvd_world):
+        x = np.arange(6, dtype=np.float32)
+        np.testing.assert_allclose(hvd.alltoall(x), x)
+
+    def test_reducescatter_identity(self, hvd_world):
+        x = np.arange(6, dtype=np.float32)
+        np.testing.assert_allclose(hvd.reducescatter(x), x)
+
+
+class TestCompression:
+    def test_fp16_roundtrip(self):
+        from horovod_tpu import Compression
+        x = np.random.randn(10).astype(np.float32)
+        c, ctx = Compression.fp16.compress(x)
+        assert c.dtype == np.float16
+        d = Compression.fp16.decompress(c, ctx)
+        assert d.dtype == np.float32
+        np.testing.assert_allclose(d, x, atol=1e-2)
+
+    def test_bf16_roundtrip(self):
+        import ml_dtypes
+        from horovod_tpu import Compression
+        x = np.random.randn(10).astype(np.float32)
+        c, ctx = Compression.bf16.compress(x)
+        assert c.dtype == ml_dtypes.bfloat16
+        d = Compression.bf16.decompress(c, ctx)
+        assert d.dtype == np.float32
+        np.testing.assert_allclose(d, x, atol=1e-1)
+
+    def test_none_passthrough(self):
+        from horovod_tpu import Compression
+        x = np.random.randn(4).astype(np.float32)
+        c, ctx = Compression.none.compress(x)
+        assert c is x
+        assert Compression.none.decompress(c, ctx) is x
+
+    def test_int_not_compressed(self):
+        from horovod_tpu import Compression
+        x = np.arange(4, dtype=np.int64)
+        c, ctx = Compression.fp16.compress(x)
+        assert c.dtype == np.int64

@@ -1,0 +1,71 @@
+"""Wire protocol round-trip tests (reference analog: the FlatBuffers
+encode/decode paths in horovod/common/message.cc:122-215,317-346)."""
+
+import pytest
+
+from horovod_tpu.common.message import (
+    DataType, Request, RequestList, RequestType, Response, ResponseList,
+    ResponseType,
+)
+from horovod_tpu.common import wire
+
+
+def test_request_roundtrip():
+    req = Request(request_rank=3, request_type=RequestType.ALLREDUCE,
+                  tensor_type=DataType.FLOAT32, tensor_name="grad/conv1",
+                  root_rank=-1, device=2, tensor_shape=(32, 64, 3),
+                  prescale_factor=0.5, postscale_factor=2.0)
+    rl = RequestList([req], shutdown=False)
+    out = wire.parse_request_list(wire.serialize_request_list(rl))
+    assert out == rl
+    assert out.requests[0].tensor_shape == (32, 64, 3)
+
+
+def test_request_list_shutdown_bit():
+    rl = RequestList([], shutdown=True)
+    out = wire.parse_request_list(wire.serialize_request_list(rl))
+    assert out.shutdown is True
+    assert out.requests == []
+
+
+def test_many_requests_roundtrip():
+    reqs = [
+        Request(request_rank=r, request_type=t, tensor_type=dt,
+                tensor_name=f"t{r}.{int(t)}.{int(dt)}",
+                tensor_shape=(r + 1, 7), root_rank=r % 2, device=-1)
+        for r in range(5)
+        for t in (RequestType.ALLREDUCE, RequestType.ALLGATHER,
+                  RequestType.BROADCAST)
+        for dt in (DataType.FLOAT32, DataType.BFLOAT16, DataType.INT64)
+    ]
+    rl = RequestList(reqs)
+    out = wire.parse_request_list(wire.serialize_request_list(rl))
+    assert out == rl
+
+
+def test_response_roundtrip():
+    resp = Response(response_type=ResponseType.ALLREDUCE,
+                    tensor_names=["a", "b", "c"],
+                    devices=[-1, -1], tensor_sizes=[12, 4, 9],
+                    prescale_factor=1.0, postscale_factor=0.25)
+    rl = ResponseList([resp], shutdown=False)
+    out = wire.parse_response_list(wire.serialize_response_list(rl))
+    assert out == rl
+
+
+def test_error_response_roundtrip():
+    resp = Response(response_type=ResponseType.ERROR,
+                    tensor_names=["bad"],
+                    error_message="Mismatched allreduce tensor shapes: ...")
+    rl = ResponseList([resp], shutdown=True)
+    out = wire.parse_response_list(wire.serialize_response_list(rl))
+    assert out.shutdown
+    assert out.responses[0].response_type == ResponseType.ERROR
+    assert "Mismatched" in out.responses[0].error_message
+
+
+def test_unicode_tensor_names():
+    req = Request(tensor_name="层/グラデーション∇", tensor_shape=(1,))
+    rl = RequestList([req])
+    out = wire.parse_request_list(wire.serialize_request_list(rl))
+    assert out.requests[0].tensor_name == "层/グラデーション∇"
