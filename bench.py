@@ -1,0 +1,106 @@
+"""Synthetic ResNet-50 throughput benchmark (driver-run, real TPU).
+
+TPU-native re-founding of the reference's synthetic benchmarks
+(reference: examples/pytorch_synthetic_benchmark.py:95-110,
+examples/tensorflow_synthetic_benchmark.py; docs/benchmarks.md:12-33):
+same workload (ResNet-50, synthetic ImageNet-shaped data, SGD-momentum),
+measured as images/sec on this host's chip(s).
+
+Baseline: the reference's published example readout is 1656.82 img/s on
+16 Pascal GPUs = 103.55 img/s per device (docs/benchmarks.md:29-33).
+``vs_baseline`` is img/s-per-chip divided by that number.
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+BASELINE_IMG_PER_SEC_PER_DEVICE = 103.55
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from horovod_tpu.models import ResNet50
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    per_chip_batch = 128
+    batch = per_chip_batch * n_dev
+    image_size = 224
+    # Timed in chunks with a value fetch per chunk: on the experimental
+    # axon platform block_until_ready() can return before execution
+    # finishes, and very deep async queues measure erratically — a
+    # float() fetch is the only reliable sync point.
+    warmup_steps, chunk_steps, chunks = 5, 10, 3
+
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16,
+                     axis_name=None)
+    rng = jax.random.key(0)
+    images = jax.random.normal(
+        rng, (batch, image_size, image_size, 3), jnp.bfloat16)
+    labels = jnp.zeros((batch,), jnp.int32)
+
+    if n_dev > 1:
+        from horovod_tpu import spmd
+        mesh = spmd.create_mesh({"data": n_dev}, devices=devices)
+        images = jax.device_put(images, spmd.batch_sharding(mesh))
+        labels = jax.device_put(labels, spmd.batch_sharding(mesh))
+
+    variables = jax.jit(lambda r, x: model.init(r, x, train=True))(
+        rng, images)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    tx = optax.sgd(0.01, momentum=0.9)
+    opt_state = tx.init(params)
+
+    def loss_fn(p, bs, x, y):
+        logits, updates = model.apply(
+            {"params": p, "batch_stats": bs}, x, train=True,
+            mutable=["batch_stats"])
+        one_hot = jax.nn.one_hot(y, 1000)
+        loss = -jnp.mean(jnp.sum(
+            jax.nn.log_softmax(logits) * one_hot, axis=-1))
+        return loss, updates["batch_stats"]
+
+    @jax.jit
+    def train_step(p, bs, os_, x, y):
+        (loss, new_bs), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(p, bs, x, y)
+        updates, new_os = tx.update(grads, os_, p)
+        new_p = optax.apply_updates(p, updates)
+        return new_p, new_bs, new_os, loss
+
+    for _ in range(warmup_steps):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, images, labels)
+    float(loss)  # real sync (see note above)
+
+    t0 = time.perf_counter()
+    for _ in range(chunks):
+        for _ in range(chunk_steps):
+            params, batch_stats, opt_state, loss = train_step(
+                params, batch_stats, opt_state, images, labels)
+        float(loss)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch * chunk_steps * chunks / dt
+    per_chip = img_per_sec / n_dev
+    print(json.dumps({
+        "metric": "resnet50_synthetic_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -76,7 +76,7 @@ def init(comm=None, config: Optional[Config] = None) -> None:
                                    start_timeout=cfg.start_timeout)
 
         backends = [
-            XlaMeshBackend(lambda: controller.rank, lambda: controller.size),
+            XlaMeshBackend(controller),
             SocketBackend(controller),
             LocalBackend(lambda: controller.size),
         ]

@@ -119,6 +119,21 @@ class Controller:
         its own."""
         raise NotImplementedError
 
+    def agree(self, local_flag: bool) -> bool:
+        """World-wide AND of a per-rank boolean over the data channel.
+
+        Backend-enablement decisions must be identical on every rank or
+        the job deadlocks (some ranks inside an XLA collective, others
+        in a socket gather). Callers must invoke this at the same point
+        of the negotiated response stream on all ranks — which is
+        exactly when ``CollectiveBackend.enabled`` runs."""
+        gathered = self.gather_data(b"\x01" if local_flag else b"\x00")
+        if gathered is not None:  # coordinator
+            ok = all(g == b"\x01" for g in gathered)
+            return self.broadcast_data(
+                b"\x01" if ok else b"\x00") == b"\x01"
+        return self.broadcast_data(None) == b"\x01"
+
     def close(self) -> None:
         pass
 
@@ -189,10 +204,11 @@ class TcpCoordinator(Controller):
                     raise ConnectionError(f"unexpected tag {tag}")
                 hello = json.loads(payload.decode())
                 r = int(hello["rank"])
+                host = hello["hostname"]
                 if r <= 0 or r >= self._size or r in self._channels:
                     raise ConnectionError(f"bad or duplicate rank {r}")
             except (ConnectionError, socket.timeout, ValueError,
-                    KeyError, UnicodeDecodeError) as e:
+                    KeyError, TypeError, UnicodeDecodeError) as e:
                 hlog.warning(f"rejected connection during startup: {e}",
                              rank=0)
                 try:
@@ -201,7 +217,7 @@ class TcpCoordinator(Controller):
                     pass
                 continue
             sock.settimeout(None)
-            hostnames[r] = hello["hostname"]
+            hostnames[r] = host
             self._channels[r] = ch
         # Broadcast the full hostname list so every rank derives the same
         # topology (reference: operations.cc:729-764).

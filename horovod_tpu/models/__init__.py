@@ -1,0 +1,19 @@
+"""Model zoo for benchmarks and examples.
+
+The reference ships no model library — its examples lean on framework
+zoos (`torchvision.models.resnet50`, `keras.applications.ResNet50`,
+reference: examples/pytorch_synthetic_benchmark.py:28-30,
+examples/keras_imagenet_resnet50.py). A TPU-native framework has no
+such zoo to lean on, so the models the reference's examples and
+benchmarks require are provided here in flax, bf16-friendly and
+MXU-shaped.
+"""
+
+from horovod_tpu.models.resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101
+from horovod_tpu.models.transformer import TransformerConfig, TransformerLM
+from horovod_tpu.models.mnist import MnistConvNet
+
+__all__ = [
+    "ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101",
+    "TransformerConfig", "TransformerLM", "MnistConvNet",
+]

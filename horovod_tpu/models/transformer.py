@@ -1,0 +1,152 @@
+"""Decoder-only Transformer LM — the flagship long-context model.
+
+The reference has no model code of its own; this model exists so the
+framework's parallelism extensions (tensor parallelism, sequence/ring
+attention — horovod_tpu.parallel) have a first-class workload, and it
+is the model behind ``__graft_entry__.py``.
+
+TPU-first choices:
+- bf16 activations/weights with fp32 softmax and layernorm statistics;
+- pre-norm blocks, GELU MLP at 4x width (MXU-friendly 128-multiples);
+- rotary position embeddings (no learned position table to shard);
+- a pluggable ``attention_fn`` so sequence parallelism can substitute
+  ring attention (horovod_tpu/parallel/ring_attention.py) without
+  touching the module tree;
+- no python-level control flow on data — the whole step jits to one
+  XLA program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    num_layers: int = 12
+    num_heads: int = 12
+    head_dim: int = 64
+    mlp_ratio: int = 4
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    rope_theta: float = 10000.0
+    # attention_fn(q, k, v, causal) -> out; None = local causal attention.
+    attention_fn: Optional[Callable] = None
+
+    @property
+    def embed_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+
+def apply_rope(x, positions, theta: float = 10000.0):
+    """Rotary embeddings. x: [B, S, H, D]; positions: [B, S]."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = jnp.exp(
+        -jnp.log(theta) * jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,half]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B,S,1,half]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+def causal_attention(q, k, v, causal: bool = True):
+    """Plain fused-softmax causal attention. q,k,v: [B, S, H, D].
+    fp32 logits/softmax, bf16 everywhere else."""
+    d = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    logits = logits / jnp.sqrt(jnp.float32(d))
+    if causal:
+        s = q.shape[1]
+        mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        dense = lambda feats, name: nn.DenseGeneral(
+            feats, axis=-1, use_bias=False, dtype=cfg.dtype, name=name)
+        q = dense((cfg.num_heads, cfg.head_dim), "q")(x)
+        k = dense((cfg.num_heads, cfg.head_dim), "k")(x)
+        v = dense((cfg.num_heads, cfg.head_dim), "v")(x)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+        attn = cfg.attention_fn or causal_attention
+        out = attn(q, k, v, True)
+        return nn.DenseGeneral(cfg.embed_dim, axis=(-2, -1), use_bias=False,
+                               dtype=cfg.dtype, name="o")(out)
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        hidden = cfg.mlp_ratio * cfg.embed_dim
+        h = nn.Dense(hidden, use_bias=False, dtype=cfg.dtype, name="up")(x)
+        h = nn.gelu(h)
+        return nn.Dense(cfg.embed_dim, use_bias=False, dtype=cfg.dtype,
+                        name="down")(h)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        ln = lambda name: nn.LayerNorm(use_bias=False, use_scale=True,
+                                       dtype=cfg.dtype, name=name,
+                                       param_dtype=jnp.float32)
+        x = x + Attention(cfg, name="attn")(ln("ln1")(x), positions)
+        x = x + MLP(cfg, name="mlp")(ln("ln2")(x))
+        return x
+
+
+class TransformerLM(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, positions=None):
+        """tokens: [B, S] int32 → logits [B, S, vocab] fp32."""
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1], dtype=jnp.int32)[None],
+                tokens.shape)
+        x = nn.Embed(cfg.vocab_size, cfg.embed_dim, dtype=cfg.dtype,
+                     name="embed")(tokens)
+        for i in range(cfg.num_layers):
+            x = Block(cfg, name=f"block_{i}")(x, positions)
+        x = nn.LayerNorm(use_bias=False, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="ln_f")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False,
+                          dtype=jnp.float32, name="lm_head")(
+                              x.astype(jnp.float32))
+        return logits
+
+
+def lm_loss(logits, tokens):
+    """Next-token cross-entropy, mean over all predicted positions."""
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)

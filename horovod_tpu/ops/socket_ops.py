@@ -40,6 +40,13 @@ def _to_numpy(tensor) -> np.ndarray:
     return np.asarray(tensor)
 
 
+def _np_from_bytes(data: bytes, dtype) -> np.ndarray:
+    """Writable array over received bytes. A bare ``np.frombuffer`` over
+    ``bytes`` is read-only and would poison outputs (callers expect
+    writable tensors, like the reference's allocated outputs)."""
+    return np.frombuffer(bytearray(data), dtype=dtype)
+
+
 def _restore(entry, host_result: np.ndarray):
     """Return the result in the entry's native flavor (jax in → jax out)."""
     if entry.context == "jax":
@@ -76,10 +83,10 @@ class SocketBackend(CollectiveBackend):
             acc = np.frombuffer(bytearray(gathered[0]), dtype=dtype)
             for data in gathered[1:]:
                 acc += np.frombuffer(data, dtype=dtype)
-            result = np.frombuffer(
-                ctl.broadcast_data(acc.tobytes()), dtype=dtype)
+            result = _np_from_bytes(
+                ctl.broadcast_data(acc.tobytes()), dtype)
         else:
-            result = np.frombuffer(ctl.broadcast_data(None), dtype=dtype)
+            result = _np_from_bytes(ctl.broadcast_data(None), dtype)
 
         if response.postscale_factor != 1.0:
             result = result * np.asarray(response.postscale_factor, dtype)
@@ -100,9 +107,9 @@ class SocketBackend(CollectiveBackend):
         gathered = ctl.gather_data(arr.tobytes())
         if gathered is not None:
             blob = b"".join(gathered)
-            result = np.frombuffer(ctl.broadcast_data(blob), dtype=arr.dtype)
+            result = _np_from_bytes(ctl.broadcast_data(blob), arr.dtype)
         else:
-            result = np.frombuffer(ctl.broadcast_data(None), dtype=arr.dtype)
+            result = _np_from_bytes(ctl.broadcast_data(None), arr.dtype)
         out_shape = (sum(response.tensor_sizes),) + arr.shape[1:]
         entry.output = _restore(entry, result.reshape(out_shape))
         return Status.OK()
@@ -117,7 +124,7 @@ class SocketBackend(CollectiveBackend):
                                       root_rank=entry.root_rank)
         else:
             data = ctl.broadcast_data(None, root_rank=entry.root_rank)
-        result = np.frombuffer(data, dtype=arr.dtype).reshape(arr.shape)
+        result = _np_from_bytes(data, arr.dtype).reshape(arr.shape)
         entry.output = _restore(entry, result)
         return Status.OK()
 
@@ -141,7 +148,7 @@ class SocketBackend(CollectiveBackend):
             data = ctl.scatter_data(payloads)
         else:
             data = ctl.scatter_data(None)
-        result = np.frombuffer(data, dtype=arr.dtype).reshape(arr.shape)
+        result = _np_from_bytes(data, arr.dtype).reshape(arr.shape)
         entry.output = _restore(entry, result)
         return Status.OK()
 
@@ -165,7 +172,7 @@ class SocketBackend(CollectiveBackend):
             data = ctl.scatter_data(payloads)
         else:
             data = ctl.scatter_data(None)
-        result = np.frombuffer(data, dtype=arr.dtype).reshape(
+        result = _np_from_bytes(data, arr.dtype).reshape(
             (per_rank,) + arr.shape[1:])
         if response.postscale_factor != 1.0:
             result = result * np.asarray(response.postscale_factor,

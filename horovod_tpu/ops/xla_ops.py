@@ -42,29 +42,42 @@ _AXIS = "hvd_proc"
 class XlaMeshBackend(CollectiveBackend):
     name = "xla_mesh"
 
-    def __init__(self, rank_fn, size_fn):
-        self._rank_fn = rank_fn
-        self._size_fn = size_fn
+    def __init__(self, controller):
+        self._ctl = controller
         self._lock = threading.Lock()
         self._mesh = None
         self._my_device = None
         self._cache: Dict[Tuple, object] = {}
         self._available = None
 
-    def _ensure_mesh(self) -> bool:
-        if self._available is not None:
-            return self._available
+    def _rank_fn(self):
+        return self._ctl.rank
+
+    def _size_fn(self):
+        return self._ctl.size
+
+    def _probe_local(self) -> bool:
+        """This rank's view of mesh availability (may be wrong on other
+        ranks — never act on it alone)."""
         try:
             import jax
             if jax.process_count() <= 1:
-                self._available = False
                 return False
             if jax.process_count() != self._size_fn():
                 hlog.warning(
                     f"JAX world has {jax.process_count()} processes but "
                     f"horovod world has {self._size_fn()}; disabling the "
                     "XLA mesh backend.")
-                self._available = False
+                return False
+            if jax.process_index() != self._rank_fn():
+                # Mesh slot r is interpreted as horovod rank r (broadcast
+                # roots, allgather slots, alltoall blocks); if the
+                # launcher numbered ranks differently from JAX process
+                # indices, results would be silently permuted.
+                hlog.warning(
+                    f"horovod rank {self._rank_fn()} != jax process index "
+                    f"{jax.process_index()}; disabling the XLA mesh "
+                    "backend (collectives fall back to the socket path).")
                 return False
             from jax.sharding import Mesh
             # One representative device per process, ordered by the
@@ -75,23 +88,29 @@ class XlaMeshBackend(CollectiveBackend):
                 by_proc.setdefault(d.process_index, []).append(d)
             reps = [sorted(by_proc[p], key=lambda d: d.id)[0]
                     for p in sorted(by_proc)]
-            if jax.process_index() != self._rank_fn():
-                # Mesh slot r is interpreted as horovod rank r (broadcast
-                # roots, allgather slots, alltoall blocks); if the
-                # launcher numbered ranks differently from JAX process
-                # indices, results would be silently permuted.
-                hlog.warning(
-                    f"horovod rank {self._rank_fn()} != jax process index "
-                    f"{jax.process_index()}; disabling the XLA mesh "
-                    "backend (collectives fall back to the socket path).")
-                self._available = False
-                return False
             self._mesh = Mesh(np.array(reps), (_AXIS,))
             self._my_device = reps[jax.process_index()]
-            self._available = True
+            return True
         except Exception as e:  # jax missing / not distributed
             hlog.debug(f"XLA mesh backend unavailable: {e}")
-            self._available = False
+            return False
+
+    def _ensure_mesh(self) -> bool:
+        if self._available is not None:
+            return self._available
+        # The decision must be world-consistent: if any rank can't join
+        # the mesh (jax init failed, rank permutation, device mismatch),
+        # EVERY rank must take the socket path or the job deadlocks with
+        # some ranks inside a psum and others in a TCP gather. All ranks
+        # reach this point at the same position of the negotiated
+        # response stream, so the agreement round is ordered identically
+        # everywhere.
+        local_ok = self._probe_local()
+        self._available = self._ctl.agree(local_ok)
+        if local_ok and not self._available:
+            hlog.warning("XLA mesh backend disabled: another rank "
+                         "cannot join the device mesh; all collectives "
+                         "take the socket path.")
         return self._available
 
     def enabled(self, entries, response) -> bool:

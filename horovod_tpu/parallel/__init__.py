@@ -1,0 +1,25 @@
+"""Parallelism beyond the reference's data parallelism.
+
+The reference implements exactly one strategy — synchronous data
+parallelism via allreduce (reference: horovod/tensorflow/__init__.py:151
+DistributedOptimizer; SURVEY §2.3) — and no sequence/long-context
+support at all. These are first-class here:
+
+- ``sharding``        — rule-based parameter sharding (tensor parallelism)
+- ``ring_attention``  — sequence/context parallelism for long sequences
+- ``trainer``         — composes dp x tp x sp into one jitted train step
+"""
+
+from horovod_tpu.parallel.sharding import (
+    ShardingRules, infer_sharding, transformer_tp_rules,
+)
+from horovod_tpu.parallel.ring_attention import (
+    ring_attention, make_ring_attention,
+)
+from horovod_tpu.parallel.trainer import Trainer, TrainerConfig
+
+__all__ = [
+    "ShardingRules", "infer_sharding", "transformer_tp_rules",
+    "ring_attention", "make_ring_attention",
+    "Trainer", "TrainerConfig",
+]

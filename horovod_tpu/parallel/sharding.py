@@ -1,0 +1,84 @@
+"""Rule-based parameter sharding — tensor parallelism without touching
+model code.
+
+Not present in the reference (data parallelism only, SURVEY §2.3); the
+TPU-native mechanism is GSPMD: annotate parameter shardings, jit the
+step under a mesh, and XLA inserts the all-reduces that NCCL-based
+frameworks hand-code. Rules are (path-regex → PartitionSpec) pairs
+applied to the flattened parameter pytree, the same shape as t5x/maxtext
+partitioning rules — the public-domain idiom for this.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Sequence, Tuple
+
+import jax
+import numpy as np
+
+
+class ShardingRules:
+    """Ordered (regex, spec) rules; first match wins, default replicated."""
+
+    def __init__(self, rules: Sequence[Tuple[str, "jax.sharding.PartitionSpec"]]):
+        self._rules = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_for(self, path: str, shape=None):
+        from jax.sharding import PartitionSpec as P
+        for pat, spec in self._rules:
+            if pat.search(path):
+                return spec
+        return P()
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def infer_sharding(params, rules: ShardingRules, mesh):
+    """Map a parameter pytree to a pytree of NamedShardings."""
+    from jax.sharding import NamedSharding
+
+    def one(path, leaf):
+        spec = rules.spec_for(_path_str(path), getattr(leaf, "shape", None))
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def shard_params(params, rules: ShardingRules, mesh):
+    """device_put the parameter tree according to the rules."""
+    shardings = infer_sharding(params, rules, mesh)
+    return jax.tree_util.tree_map(jax.device_put, params, shardings)
+
+
+def transformer_tp_rules(model_axis: str = "model") -> ShardingRules:
+    """Megatron-style sharding for models/transformer.py: column-split
+    the fan-out matmuls (qkv, mlp up), row-split the fan-in matmuls
+    (attn out, mlp down) so each block needs one psum on exit; XLA
+    inserts it from these annotations."""
+    from jax.sharding import PartitionSpec as P
+    m = model_axis
+    return ShardingRules([
+        (r"embed/embedding$",        P(None, m)),
+        (r"attn/(q|k|v)/kernel$",    P(None, m, None)),
+        (r"attn/o/kernel$",          P(m, None, None)),
+        (r"mlp/up/kernel$",          P(None, m)),
+        (r"mlp/down/kernel$",        P(m, None)),
+        (r"lm_head/kernel$",         P(None, m)),
+        # layernorms and everything else: replicated (default)
+    ])
+
+
+def resnet_dp_rules() -> ShardingRules:
+    """ResNet is pure data-parallel: every parameter replicated."""
+    return ShardingRules([])

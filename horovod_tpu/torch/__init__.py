@@ -1,0 +1,320 @@
+"""PyTorch adapter — hook-driven async gradient allreduce.
+
+Re-architecture of the reference's torch binding
+(reference: horovod/torch/__init__.py, horovod/torch/mpi_ops.py) for
+TPU hosts: torch stays on CPU (the TPU compute path is JAX), gradients
+are staged zero-copy through numpy into the background runtime, and the
+collective itself rides whichever backend the negotiated response
+selects (XLA mesh / socket / local). The async-handle protocol, the
+per-parameter hooks that fire as soon as each gradient is accumulated,
+``backward_passes_per_step`` accumulation, and the broadcast helpers
+keep the reference's exact contract.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Tuple
+
+import numpy as np
+
+from horovod_tpu.common.basics import (  # noqa: F401
+    init, shutdown, initialized, rank, size, local_rank, local_size,
+    cross_rank, cross_size, is_homogeneous,
+)
+from horovod_tpu.common.compression import Compression  # noqa: F401
+from horovod_tpu import ops as _ops
+from horovod_tpu.ops import (  # noqa: F401
+    Average, Sum, poll, synchronize as _synchronize_handle, barrier,
+)
+
+
+def _to_numpy(t):
+    """torch CPU tensor -> numpy view (no copy when contiguous)."""
+    t = t.detach()
+    if not t.is_contiguous():
+        t = t.contiguous()
+    return t.numpy()
+
+
+def _from_numpy(arr, like):
+    import torch
+    out = torch.from_numpy(np.ascontiguousarray(arr))
+    return out.to(dtype=like.dtype).reshape(like.shape)
+
+
+# -- tensor-level ops (reference: horovod/torch/mpi_ops.py) -------------
+
+def allreduce(tensor, op: int = Average, name: Optional[str] = None,
+              compression=Compression.none,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    comp, ctx = compression.compress(_to_numpy(tensor))
+    out = _ops.allreduce(comp, op=op, name=name,
+                         prescale_factor=prescale_factor,
+                         postscale_factor=postscale_factor)
+    return _from_numpy(np.asarray(compression.decompress(out, ctx)), tensor)
+
+
+def allreduce_(tensor, op: int = Average, name: Optional[str] = None):
+    """In-place variant (reference: horovod/torch/mpi_ops.py
+    allreduce_)."""
+    result = allreduce(tensor, op=op, name=name)
+    tensor.copy_(result)
+    return tensor
+
+
+def allreduce_async(tensor, op: int = Average,
+                    name: Optional[str] = None) -> int:
+    return _ops.allreduce_async(_to_numpy(tensor), op=op, name=name)
+
+
+def allgather(tensor, name: Optional[str] = None):
+    out = _ops.allgather(_to_numpy(tensor), name=name)
+    import torch
+    return torch.from_numpy(np.ascontiguousarray(out)).to(tensor.dtype)
+
+
+def allgather_async(tensor, name: Optional[str] = None) -> int:
+    return _ops.allgather_async(_to_numpy(tensor), name=name)
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
+    out = _ops.broadcast(_to_numpy(tensor), root_rank=root_rank, name=name)
+    return _from_numpy(np.asarray(out), tensor)
+
+
+def broadcast_(tensor, root_rank: int = 0, name: Optional[str] = None):
+    tensor.copy_(broadcast(tensor, root_rank=root_rank, name=name))
+    return tensor
+
+
+def broadcast_async(tensor, root_rank: int = 0,
+                    name: Optional[str] = None) -> int:
+    return _ops.broadcast_async(_to_numpy(tensor), root_rank=root_rank,
+                                name=name)
+
+
+def alltoall(tensor, name: Optional[str] = None):
+    out = _ops.alltoall(_to_numpy(tensor), name=name)
+    import torch
+    return torch.from_numpy(np.ascontiguousarray(out)).to(tensor.dtype)
+
+
+def synchronize(handle: int):
+    """Wait on an async handle, returning a torch tensor."""
+    import torch
+    out = _synchronize_handle(handle)
+    return torch.from_numpy(np.ascontiguousarray(np.asarray(out)))
+
+
+# -- DistributedOptimizer (reference: horovod/torch/__init__.py:42-197) --
+
+class _DistributedOptimizer:
+    def __init__(self, optimizer, named_parameters=None,
+                 compression=Compression.none,
+                 backward_passes_per_step: int = 1, op: int = Average):
+        self._opt = optimizer
+        self._compression = compression
+        self._op = op
+        self.backward_passes_per_step = backward_passes_per_step
+
+        if named_parameters is not None:
+            named = list(named_parameters)
+        else:
+            named = [(f"param.{i}.{j}", p)
+                     for i, group in enumerate(optimizer.param_groups)
+                     for j, p in enumerate(group["params"])]
+        # Duplicate-name guard (reference: torch/__init__.py:60-68).
+        names = [n for n, _ in named]
+        if len(set(names)) != len(names):
+            raise ValueError("parameter names must be unique for "
+                             "DistributedOptimizer")
+        self._param_names = {p: n for n, p in named}
+        self._handles = {}          # param -> (handle, ctx)
+        self._grad_counts = {}      # param -> backward passes seen
+        self._hook_handles = []
+        self._register_hooks()
+
+    def _register_hooks(self):
+        # post-accumulate-grad hooks: fire the async allreduce the
+        # moment each gradient is final, overlapping communication with
+        # the rest of the backward pass (reference:
+        # torch/__init__.py:95-130 grad-accumulator hooks).
+        for group in self._opt.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    self._hook_handles.append(
+                        p.register_post_accumulate_grad_hook(
+                            self._make_hook(p)))
+
+    def _make_hook(self, p):
+        def hook(param):
+            self._grad_counts[p] = self._grad_counts.get(p, 0) + 1
+            if self._grad_counts[p] == self.backward_passes_per_step:
+                self._allreduce_grad(p)
+        return hook
+
+    def _allreduce_grad(self, p):
+        name = self._param_names.get(p) or f"param.{id(p)}"
+        grad = _to_numpy(p.grad)
+        if self.backward_passes_per_step > 1:
+            grad = grad / self.backward_passes_per_step
+        comp, ctx = self._compression.compress(grad)
+        handle = _ops.allreduce_async(comp, op=self._op,
+                                      name=f"allreduce.{name}")
+        self._handles[p] = (handle, ctx)
+
+    def synchronize(self):
+        """Drain all in-flight gradient reductions into p.grad
+        (reference: torch/__init__.py:132-147)."""
+        import torch
+        missing = [p for p in self._grad_counts
+                   if p not in self._handles
+                   and self._grad_counts.get(p, 0) > 0]
+        for p in missing:
+            # forced sync before enough backward passes (reference:
+            # test_force_allreduce pattern): reduce what we have.
+            self._allreduce_grad(p)
+        for p, (handle, ctx) in list(self._handles.items()):
+            out = _synchronize_handle(handle)
+            out = self._compression.decompress(np.asarray(out), ctx)
+            with torch.no_grad():
+                p.grad.copy_(_from_numpy(out, p.grad))
+        self._handles.clear()
+        self._grad_counts.clear()
+
+    def step(self, closure=None):
+        self.synchronize()
+        return self._opt.step(closure)
+
+    def zero_grad(self, *a, **kw):
+        if self._handles:
+            raise AssertionError(
+                "zero_grad called with allreduces in flight; call "
+                "optimizer.synchronize() first "
+                "(reference: torch/__init__.py zero_grad guard)")
+        return self._opt.zero_grad(*a, **kw)
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         op: int = Average):
+    """Wrap a torch optimizer: async per-parameter gradient allreduce
+    via hooks + synchronize-on-step
+    (reference: horovod/torch/__init__.py:160-197)."""
+    return _DistributedOptimizer(optimizer, named_parameters, compression,
+                                 backward_passes_per_step, op)
+
+
+# -- state broadcast (reference: horovod/torch/__init__.py:200-348) ------
+
+def broadcast_parameters(params, root_rank: int = 0):
+    """params: state_dict or iterable of (name, tensor)."""
+    if hasattr(params, "items"):
+        items = [(k, v) for k, v in params.items()]
+    else:
+        items = list(params)
+    handles = []
+    for name, t in items:
+        if t is None or not hasattr(t, "numpy"):
+            continue
+        handles.append((t, _ops.broadcast_async(
+            _to_numpy(t), root_rank=root_rank, name=f"bcast.{name}")))
+    import torch
+    for t, h in handles:
+        out = _synchronize_handle(h)
+        with torch.no_grad():
+            t.copy_(_from_numpy(np.asarray(out), t))
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0):
+    """Broadcast optimizer.state_dict() tensors and scalars from root
+    (reference: horovod/torch/__init__.py:232-348 incl. the
+    scalar-wrapping + recursive type restoration)."""
+    import torch
+    state_dict = optimizer.state_dict()
+
+    # Newly constructed optimizers have empty state on all ranks; the
+    # reference forces a zero-grad step to materialize it. We broadcast
+    # whatever exists, keyed deterministically.
+    scalars = {}
+    handles = []
+
+    def visit(path, value):
+        if isinstance(value, torch.Tensor):
+            handles.append((value, _ops.broadcast_async(
+                _to_numpy(value), root_rank=root_rank,
+                name=f"bcast.os.{path}")))
+        elif isinstance(value, (int, float)):
+            scalars[path] = value
+        elif isinstance(value, dict):
+            for k in sorted(value, key=str):
+                visit(f"{path}/{k}", value[k])
+        elif isinstance(value, (list, tuple)):
+            for i, v in enumerate(value):
+                visit(f"{path}/{i}", v)
+
+    visit("state", state_dict["state"])
+    visit("param_groups", state_dict["param_groups"])
+
+    for t, h in handles:
+        out = _synchronize_handle(h)
+        with torch.no_grad():
+            t.copy_(_from_numpy(np.asarray(out), t))
+
+    # Scalars (lr, momentum, step counters) ride one fused broadcast.
+    if scalars:
+        keys = sorted(scalars)
+        vec = np.asarray([float(scalars[k]) for k in keys], np.float64)
+        out = np.asarray(_ops.broadcast(vec, root_rank=root_rank,
+                                        name="bcast.os.scalars"))
+        it = iter(out)
+
+        def restore(path, container, key, value):
+            # Every scalar recorded by visit() was packed into the vec,
+            # so every one must consume a slot here — a skipped next()
+            # would shift all later scalars by one. bool is a subclass
+            # of int; restore it as bool, not 0.0/1.0.
+            broadcasted = next(it)
+            if isinstance(value, bool):
+                container[key] = bool(broadcasted)
+            elif isinstance(value, int):
+                container[key] = int(broadcasted)
+            elif isinstance(value, float):
+                container[key] = float(broadcasted)
+
+        def revisit(path, value):
+            if isinstance(value, dict):
+                for k in sorted(value, key=str):
+                    p = f"{path}/{k}"
+                    if p in scalars:
+                        restore(p, value, k, value[k])
+                    else:
+                        revisit(p, value[k])
+            elif isinstance(value, (list, tuple)):
+                for i, v in enumerate(value):
+                    p = f"{path}/{i}"
+                    if p in scalars:
+                        restore(p, value, i, v)
+                    else:
+                        revisit(p, v)
+
+        revisit("state", state_dict["state"])
+        revisit("param_groups", state_dict["param_groups"])
+        optimizer.load_state_dict(state_dict)
+
+
+__all__ = [
+    "init", "shutdown", "initialized", "rank", "size", "local_rank",
+    "local_size", "cross_rank", "cross_size", "is_homogeneous",
+    "Average", "Sum", "Compression",
+    "allreduce", "allreduce_", "allreduce_async",
+    "allgather", "allgather_async",
+    "broadcast", "broadcast_", "broadcast_async", "alltoall",
+    "poll", "synchronize", "barrier",
+    "DistributedOptimizer", "broadcast_parameters",
+    "broadcast_optimizer_state",
+]

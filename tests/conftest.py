@@ -15,6 +15,18 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import pytest  # noqa: E402
 
+# The container's sitecustomize may already have imported jax to register
+# the TPU PJRT plugin, in which case the env var above is too late;
+# jax.config still wins as long as no backend has been initialized.
+# (Guarded: the core runtime is importable without jax, and the
+# numpy-only tests must stay runnable on jax-less hosts.)
+try:
+    import jax  # noqa: E402
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
+
 
 @pytest.fixture()
 def hvd_world():

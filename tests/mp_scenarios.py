@@ -172,6 +172,74 @@ def scenario_stall_shutdown(hvd, rank, size):
         time.sleep(5.0)
 
 
+
+
+def scenario_torch_optimizer(hvd_mod, rank, size):
+    """torch adapter end-to-end: broadcast params, hook-driven async
+    grad allreduce, optimizer-state broadcast (reference analog:
+    test_torch.py:802-1003 + the DistributedOptimizer flow)."""
+    import torch
+    import horovod_tpu.torch as hvd
+
+    torch.manual_seed(100 + rank)  # rank-divergent init on purpose
+    model = torch.nn.Sequential(
+        torch.nn.Linear(6, 4), torch.nn.ReLU(), torch.nn.Linear(4, 2))
+    opt = torch.optim.SGD(model.parameters(), lr=0.05, momentum=0.9,
+                          weight_decay=1e-4)
+
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    # after broadcast all ranks agree parameter-wise
+    flat = torch.cat([p.detach().reshape(-1) for p in model.parameters()])
+    gathered = hvd.allgather(flat.reshape(1, -1), name="check.init")
+    for r in range(size):
+        assert torch.allclose(gathered[r], gathered[0]), "params diverged"
+
+    dopt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+    torch.manual_seed(1234 + rank)
+    for step in range(3):
+        x = torch.randn(8, 6)
+        y = torch.randn(8, 2)
+        dopt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        dopt.step()
+    flat = torch.cat([p.detach().reshape(-1) for p in model.parameters()])
+    gathered = hvd.allgather(flat.reshape(1, -1), name="check.final")
+    for r in range(size):
+        assert torch.allclose(gathered[r], gathered[0], atol=1e-6), \
+            "rank-divergent data should still yield identical params"
+
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+    g = opt.param_groups[0]
+    assert g["lr"] == 0.05 and g["momentum"] == 0.9
+    assert abs(g["weight_decay"] - 1e-4) < 1e-12
+    assert isinstance(g.get("nesterov", False), bool)
+
+
+def scenario_jax_adapter(hvd_mod, rank, size):
+    """jax adapter host path: pytree gradient allreduce + parameter
+    broadcast through the background runtime."""
+    import horovod_tpu.jax as hvd
+
+    grads = {"w": np.full((3, 2), float(rank + 1), np.float32),
+             "b": np.full((2,), float(rank + 1), np.float32)}
+    out = hvd.allreduce_gradients(grads, op=hvd.Average)
+    mean = sum(range(1, size + 1)) / size
+    np.testing.assert_allclose(out["w"], mean)
+    np.testing.assert_allclose(out["b"], mean)
+
+    params = {"w": np.full((4,), float(rank), np.float32)}
+    out = hvd.broadcast_parameters(params, root_rank=1)
+    np.testing.assert_allclose(out["w"], 1.0)
+
+    comp = hvd.allreduce_gradients(
+        {"g": np.full((8,), float(rank + 1), np.float32)},
+        op=hvd.Average, compression=hvd.Compression.fp16)
+    np.testing.assert_allclose(comp["g"], mean, rtol=1e-3)
+
+
+
 def main():
     scenario, rank, size, port = (sys.argv[1], int(sys.argv[2]),
                                   int(sys.argv[3]), int(sys.argv[4]))

@@ -110,3 +110,11 @@ def test_stall_shutdown():
         "stall_shutdown", 2, timeout=60.0,
         extra_env={"HOROVOD_STALL_CHECK_TIME_SECONDS": "1",
                    "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "2"})
+
+
+def test_torch_distributed_optimizer():
+    run_scenario("torch_optimizer", 2, timeout=120.0)
+
+
+def test_jax_adapter_host_path():
+    run_scenario("jax_adapter", 2)
