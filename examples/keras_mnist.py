@@ -1,0 +1,48 @@
+"""MNIST with the Keras adapter (reference: examples/keras_mnist.py).
+
+Run:  python -m horovod_tpu.run -np 2 python examples/keras_mnist.py
+"""
+
+import numpy as np
+import keras
+
+import horovod_tpu.keras as hvd
+
+
+def main():
+    hvd.init()
+    keras.utils.set_random_seed(42)
+
+    model = keras.Sequential([
+        keras.layers.Input((28, 28, 1)),
+        keras.layers.Conv2D(32, (3, 3), activation="relu"),
+        keras.layers.MaxPooling2D((2, 2)),
+        keras.layers.Flatten(),
+        keras.layers.Dense(128, activation="relu"),
+        keras.layers.Dense(10, activation="softmax"),
+    ])
+
+    # lr scaled by size + distributed optimizer (reference:
+    # examples/keras_mnist.py opt scaling + hvd.DistributedOptimizer)
+    opt = keras.optimizers.Adadelta(1.0 * hvd.size())
+    model.compile(loss="sparse_categorical_crossentropy",
+                  optimizer=hvd.DistributedOptimizer(opt),
+                  metrics=["accuracy"])
+
+    callbacks = [
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+        hvd.callbacks.LearningRateWarmupCallback(warmup_epochs=1),
+    ]
+
+    rng = np.random.RandomState(hvd.rank())
+    x = rng.rand(512, 28, 28, 1).astype(np.float32)
+    y = rng.randint(0, 10, 512)
+
+    model.fit(x, y, batch_size=64, epochs=2, callbacks=callbacks,
+              verbose=1 if hvd.rank() == 0 else 0)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""MNIST with the torch adapter (reference: examples/pytorch_mnist.py).
+
+Run:  python -m horovod_tpu.run -np 2 python examples/torch_mnist.py
+"""
+
+import argparse
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Net(nn.Module):
+    """(reference: examples/pytorch_mnist.py:42-60)"""
+
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(1, 10, kernel_size=5)
+        self.conv2 = nn.Conv2d(10, 20, kernel_size=5)
+        self.fc1 = nn.Linear(320, 50)
+        self.fc2 = nn.Linear(50, 10)
+
+    def forward(self, x):
+        x = F.relu(F.max_pool2d(self.conv1(x), 2))
+        x = F.relu(F.max_pool2d(self.conv2(x), 2))
+        x = x.view(-1, 320)
+        x = F.relu(self.fc1(x))
+        return F.log_softmax(self.fc2(x), dim=1)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--fp16-allreduce", action="store_true")
+    args = parser.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42)
+
+    model = Net()
+    # lr scaled by world size (reference: pytorch_mnist.py:*lr scaling)
+    optimizer = torch.optim.SGD(model.parameters(),
+                                lr=args.lr * hvd.size(), momentum=0.5)
+
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    compression = (hvd.Compression.fp16 if args.fp16_allreduce
+                   else hvd.Compression.none)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        compression=compression)
+
+    rng = np.random.RandomState(100 + hvd.rank())
+    x = torch.from_numpy(rng.rand(512, 1, 28, 28).astype(np.float32))
+    y = torch.from_numpy(rng.randint(0, 10, 512))
+
+    model.train()
+    steps = len(x) // args.batch_size
+    for epoch in range(args.epochs):
+        for i in range(steps):
+            sl = slice(i * args.batch_size, (i + 1) * args.batch_size)
+            optimizer.zero_grad()
+            loss = F.nll_loss(model(x[sl]), y[sl])
+            loss.backward()
+            optimizer.step()
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss {loss.item():.4f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Long-context Transformer training: dp x tp x sp on one mesh —
+capability the reference does not have (SURVEY §5: no sequence
+parallelism anywhere).
+
+Single process, all visible devices:
+    python examples/transformer_long_context.py --seq-len 8192
+"""
+
+import argparse
+import time
+
+import numpy as np
+import jax
+import optax
+
+from horovod_tpu import spmd
+from horovod_tpu.models.transformer import TransformerConfig, TransformerLM
+from horovod_tpu.parallel import Trainer, TrainerConfig, make_ring_attention
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq-len", type=int, default=4096)
+    p.add_argument("--batch-size", type=int, default=4)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=64)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--data", type=int, default=None, help="dp axis size")
+    p.add_argument("--seq", type=int, default=None, help="sp axis size")
+    p.add_argument("--model-par", type=int, default=None,
+                   help="tp axis size")
+    args = p.parse_args()
+
+    n = len(jax.devices())
+    # default: all devices on the sequence axis (pure long-context)
+    dp = args.data or 1
+    tp = args.model_par or 1
+    sp = args.seq or (n // (dp * tp))
+    mesh = spmd.create_mesh({"data": dp, "seq": sp, "model": tp})
+    print(f"mesh: data={dp} seq={sp} model={tp}")
+
+    attn = make_ring_attention(mesh, data_axis="data", seq_axis="seq",
+                               model_axis="model" if tp > 1 else None)
+    cfg = TransformerConfig(
+        vocab_size=32000, num_layers=args.layers, num_heads=args.heads,
+        head_dim=args.head_dim, max_seq_len=args.seq_len,
+        attention_fn=attn)
+    trainer = Trainer(
+        TransformerLM(cfg), mesh, optax.adamw(3e-4),
+        TrainerConfig(data_axis="data",
+                      model_axis="model" if tp > 1 else None,
+                      seq_axis="seq"))
+
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, 32000,
+                         (args.batch_size, args.seq_len)).astype(np.int32)
+    batch = {"tokens": tokens}
+    state = trainer.init(jax.random.key(0), batch)
+
+    state, loss = trainer.train_step(state, batch)  # compile
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, loss = trainer.train_step(state, batch)
+    loss = float(loss)
+    dt = time.perf_counter() - t0
+    tok_s = args.batch_size * args.seq_len * args.steps / dt
+    print(f"loss {loss:.4f}; {tok_s:,.0f} tokens/sec "
+          f"@ seq_len {args.seq_len}")
+
+
+if __name__ == "__main__":
+    main()

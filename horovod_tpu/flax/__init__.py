@@ -1,0 +1,80 @@
+"""Flax adapter — TrainState helpers and training callbacks.
+
+Role-equivalent of the reference's Keras facade layer
+(reference: horovod/keras/__init__.py, horovod/_keras/__init__.py and
+callbacks.py): state broadcast at start, metric averaging at epoch end,
+and the linear-scaling + warmup learning-rate policy, restated for
+flax/optax training loops.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from horovod_tpu.common.basics import (  # noqa: F401
+    init, shutdown, initialized, rank, size, local_rank, local_size,
+)
+from horovod_tpu.common.compression import Compression  # noqa: F401
+from horovod_tpu import ops as _ops
+from horovod_tpu import spmd as _spmd
+from horovod_tpu.ops import Average, Sum  # noqa: F401
+
+
+def create_distributed_train_state(apply_fn, params, tx,
+                                   op: int = Average, axis="data"):
+    """flax TrainState whose ``tx`` averages gradients over the mesh
+    axis inside jit (reference contract:
+    _keras/__init__.py:20-70 create_distributed_optimizer)."""
+    from flax.training import train_state
+    from horovod_tpu.jax import DistributedOptimizer
+
+    return train_state.TrainState.create(
+        apply_fn=apply_fn, params=params,
+        tx=DistributedOptimizer(tx, op=op, axis=axis))
+
+
+def broadcast_train_state(state, root_rank: int = 0):
+    """Broadcast every array leaf of a TrainState (params + opt state +
+    step) from root via the background runtime — run once after restore
+    (reference: _keras/callbacks.py:20-30
+    BroadcastGlobalVariablesCallback)."""
+    from horovod_tpu.jax import broadcast_parameters
+    return broadcast_parameters(state, root_rank=root_rank)
+
+
+def average_metrics(metrics: Dict[str, Any],
+                    prefix: str = "metric") -> Dict[str, Any]:
+    """Allreduce-average scalar metrics across workers at epoch end
+    (reference: _keras/callbacks.py:33-67 MetricAverageCallback)."""
+    out = {}
+    for i, key in enumerate(sorted(metrics)):
+        v = np.asarray(metrics[key], np.float64).reshape(())
+        out[key] = float(_ops.allreduce(v, op=Average,
+                                        name=f"{prefix}.{key}"))
+    return out
+
+
+def scaled_lr_schedule(base_lr: float, warmup_steps: int = 0,
+                       world_size: Optional[int] = None,
+                       staircase: bool = True):
+    """The linear-scaling rule + gradual warmup as an optax schedule
+    (reference: _keras/callbacks.py:70-168
+    LearningRateWarmupCallback: ramp from base_lr to base_lr*size over
+    warmup, the Goyal et al. recipe the reference implements)."""
+    import optax
+    n = world_size if world_size is not None else max(size(), 1)
+    target = base_lr * n
+    if warmup_steps <= 0:
+        return optax.constant_schedule(target)
+    return optax.linear_schedule(init_value=base_lr, end_value=target,
+                                 transition_steps=warmup_steps)
+
+
+__all__ = [
+    "init", "shutdown", "initialized", "rank", "size", "local_rank",
+    "local_size", "Average", "Sum", "Compression",
+    "create_distributed_train_state", "broadcast_train_state",
+    "average_metrics", "scaled_lr_schedule",
+]

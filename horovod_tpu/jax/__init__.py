@@ -59,15 +59,30 @@ def DistributedOptimizer(tx, op: int = _spmd.Average,
         return tx.init(params)
 
     def update_fn(grads, state, params=None, **extra):
-        pre = 1.0 / gradient_predivide_factor \
-            if gradient_predivide_factor != 1.0 else 1.0
-        if pre != 1.0:
+        if gradient_predivide_factor != 1.0 and op == _spmd.Average:
+            # Reference semantics (horovod allreduce prescale/postscale):
+            # prescale by 1/f before the sum, postscale by f/size after —
+            # net effect is still the mean, but intermediate magnitudes
+            # shrink for numerical headroom.
+            f = gradient_predivide_factor
+
+            def averaged(g):
+                n = _spmd.mesh_size(axis)
+                return _spmd.allreduce(g, op=_spmd.Sum, axis=axis,
+                                       prescale_factor=1.0 / f,
+                                       postscale_factor=f / n)
+
             import jax
-            grads = jax.tree_util.tree_map(
-                lambda g: g * np.asarray(pre, dtype=np.result_type(g)),
-                grads)
-        grads = _spmd.allreduce_gradients(grads, op=op, axis=axis,
-                                          compression=compression)
+            if compression is not Compression.none:
+                def one(g):
+                    c, ctx = compression.compress(g)
+                    return compression.decompress(averaged(c), ctx)
+            else:
+                one = averaged
+            grads = jax.tree_util.tree_map(one, grads)
+        else:
+            grads = _spmd.allreduce_gradients(grads, op=op, axis=axis,
+                                              compression=compression)
         return tx.update(grads, state, params, **extra)
 
     return optax.GradientTransformationExtraArgs(init_fn, update_fn)

@@ -1,0 +1,133 @@
+"""Keras adapter (Keras 3, any backend — JAX recommended on TPU).
+
+Role-equivalent of the reference's Keras facades
+(reference: horovod/keras/__init__.py:1-148,
+horovod/tensorflow/keras/__init__.py, shared impl horovod/_keras/):
+``DistributedOptimizer`` averaging gradients across workers,
+``broadcast_global_variables``, ``load_model``, and the callback suite
+in ``horovod_tpu.keras.callbacks``. Tensors are staged through numpy,
+so the adapter is backend-agnostic; the collective itself runs on
+whichever backend the negotiated response selects.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from horovod_tpu.common.basics import (  # noqa: F401
+    init, shutdown, initialized, rank, size, local_rank, local_size,
+)
+from horovod_tpu.common.compression import Compression  # noqa: F401
+from horovod_tpu import ops as _ops
+from horovod_tpu.ops import Average, Sum  # noqa: F401
+
+from horovod_tpu.keras import callbacks  # noqa: F401
+
+
+def DistributedOptimizer(optimizer, compression=Compression.none,
+                         op: int = Average, name: Optional[str] = None):
+    """Wrap a Keras-3 optimizer so ``apply_gradients`` first averages
+    gradients across workers (reference:
+    _keras/__init__.py:20-70 create_distributed_optimizer, which
+    overrides get_gradients; Keras 3's seam is apply_gradients)."""
+    import keras
+
+    cls = optimizer.__class__
+
+    def _host_allreduce(host: np.ndarray, idx: int) -> np.ndarray:
+        comp, ctx = compression.compress(host)
+        out = _ops.allreduce(comp, op=op, name=f"keras.grad.{idx}")
+        return np.asarray(compression.decompress(np.asarray(out), ctx),
+                          dtype=host.dtype)
+
+    def _reduce_tensor(g, idx: int):
+        """Average one gradient. ``model.fit`` traces apply_gradients
+        inside the backend's jit (tf.function / jax.jit), so the host
+        round-trip must be a callback op, not an eager conversion —
+        every rank's compiled step hits the callback at the same
+        point, preserving negotiation order."""
+        backend = keras.backend.backend()
+        if backend == "tensorflow":
+            import tensorflow as tf
+            if not tf.executing_eagerly():
+                out = tf.py_function(
+                    lambda t: _host_allreduce(t.numpy(), idx), [g],
+                    Tout=g.dtype)
+                out.set_shape(g.shape)
+                return out
+        elif backend == "jax":
+            import jax
+            if isinstance(g, jax.core.Tracer):
+                # io_callback(ordered=True): the collective is a
+                # blocking side effect; pure_callback could be
+                # reordered/deduped/elided by XLA, desynchronizing the
+                # ranks' submission order.
+                from jax.experimental import io_callback
+                return io_callback(
+                    lambda t: _host_allreduce(np.asarray(t), idx),
+                    jax.ShapeDtypeStruct(g.shape, g.dtype), g,
+                    ordered=True)
+        host = np.asarray(keras.ops.convert_to_numpy(g))
+        return keras.ops.convert_to_tensor(_host_allreduce(host, idx))
+
+    class _Distributed(cls):
+        _hvd_wrapped = True
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            grads_and_vars = list(grads_and_vars)
+            reduced = [
+                None if g is None else _reduce_tensor(g, i)
+                for i, (g, _) in enumerate(grads_and_vars)]
+            variables = [v for _, v in grads_and_vars]
+            return super().apply_gradients(
+                zip(reduced, variables), *args, **kwargs)
+
+    # Re-class the live instance instead of rebuilding from config:
+    # a from_config round-trip would silently drop accumulated slot
+    # variables / iteration count on load_model-restored optimizers.
+    _Distributed.__name__ = cls.__name__
+    optimizer.__class__ = _Distributed
+    return optimizer
+
+
+def broadcast_global_variables(model, root_rank: int = 0) -> None:
+    """Broadcast model (+ optimizer) weights from root
+    (reference: horovod/keras/__init__.py broadcast_global_variables)."""
+    weights = model.get_weights()
+    new_weights = []
+    for i, w in enumerate(weights):
+        out = _ops.broadcast(np.asarray(w), root_rank=root_rank,
+                             name=f"keras.bcast.{i}")
+        new_weights.append(np.asarray(out).astype(w.dtype))
+    model.set_weights(new_weights)
+    opt = getattr(model, "optimizer", None)
+    if opt is not None and getattr(opt, "variables", None):
+        for j, var in enumerate(opt.variables):
+            host = np.asarray(var)
+            out = _ops.broadcast(host, root_rank=root_rank,
+                                 name=f"keras.bcast.opt.{j}")
+            var.assign(np.asarray(out).astype(host.dtype)
+                       .reshape(host.shape))
+
+
+def load_model(filepath, custom_objects=None, compression=Compression.none):
+    """Load a Keras model and wrap its optimizer in DistributedOptimizer
+    (reference: _keras/__init__.py:93-109 load_model)."""
+    import keras
+
+    model = keras.models.load_model(filepath,
+                                    custom_objects=custom_objects)
+    if getattr(model, "optimizer", None) is not None and \
+            not getattr(model.optimizer, "_hvd_wrapped", False):
+        model.optimizer = DistributedOptimizer(model.optimizer,
+                                               compression=compression)
+    return model
+
+
+__all__ = [
+    "init", "shutdown", "initialized", "rank", "size", "local_rank",
+    "local_size", "Average", "Sum", "Compression", "callbacks",
+    "DistributedOptimizer", "broadcast_global_variables", "load_model",
+]

@@ -1,0 +1,145 @@
+"""Keras callbacks (reference: horovod/_keras/callbacks.py:1-168,
+re-exported by horovod/keras/callbacks.py and
+horovod/tensorflow/keras/callbacks.py)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import keras
+
+from horovod_tpu import ops as _ops
+from horovod_tpu.common import basics
+from horovod_tpu.ops import Average
+
+
+class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
+    """Broadcast initial weights from root at train start
+    (reference: _keras/callbacks.py:20-30)."""
+
+    def __init__(self, root_rank: int = 0):
+        super().__init__()
+        self.root_rank = root_rank
+        self.broadcast_done = False
+
+    def on_batch_begin(self, batch, logs=None):
+        if self.broadcast_done:
+            return
+        from horovod_tpu.keras import broadcast_global_variables
+        broadcast_global_variables(self.model, self.root_rank)
+        self.broadcast_done = True
+
+
+class MetricAverageCallback(keras.callbacks.Callback):
+    """Average epoch metrics over ranks before other callbacks
+    (checkpointers, early stopping) read them
+    (reference: _keras/callbacks.py:33-67)."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs:
+            for key in sorted(logs):
+                try:
+                    v = np.asarray(float(logs[key]), np.float64)
+                except (TypeError, ValueError):
+                    continue
+                logs[key] = float(np.asarray(_ops.allreduce(
+                    v, op=Average, name=f"metric.{epoch}.{key}")))
+
+
+class LearningRateScheduleCallback(keras.callbacks.Callback):
+    """lr = initial_lr * multiplier(epoch) over [start_epoch, end_epoch)
+    (reference: _keras/callbacks.py:70-117)."""
+
+    def __init__(self, multiplier, start_epoch: int = 0,
+                 end_epoch: Optional[int] = None, staircase: bool = True,
+                 momentum_correction: bool = True,
+                 initial_lr: Optional[float] = None):
+        super().__init__()
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.initial_lr = initial_lr
+        self.current_epoch = 0
+        self.restore_momentum = None
+        if not callable(multiplier):
+            self.multiplier = lambda epoch: multiplier
+        else:
+            self.multiplier = multiplier
+
+    def _autodetect_initial_lr(self):
+        if self.initial_lr is None:
+            lr = self.model.optimizer.learning_rate
+            self.initial_lr = float(np.asarray(lr))
+
+    def _in_range(self, epoch):
+        if epoch < self.start_epoch:
+            return False
+        return self.end_epoch is None or epoch < self.end_epoch
+
+    def _adjust(self, epoch):
+        if not self._in_range(epoch):
+            return
+        self._autodetect_initial_lr()
+        new_lr = self.initial_lr * self.multiplier(epoch)
+        # Momentum correction: scale momentum-carried velocity when lr
+        # jumps (reference: _keras/callbacks.py:108-117 restore/adjust).
+        opt = self.model.optimizer
+        if self.momentum_correction and hasattr(opt, "momentum"):
+            old_lr = float(np.asarray(opt.learning_rate))
+            if old_lr > 0 and new_lr != old_lr:
+                mom = float(np.asarray(opt.momentum))
+                self.restore_momentum = mom
+                opt.momentum = mom * new_lr / old_lr
+        self.model.optimizer.learning_rate = new_lr
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+        if self.staircase:
+            self._adjust(epoch)
+
+    def on_batch_begin(self, batch, logs=None):
+        if not self.staircase and self.params.get("steps"):
+            frac = batch / float(self.params["steps"])
+            self._adjust(self.current_epoch + frac)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.restore_momentum is not None:
+            self.model.optimizer.momentum = self.restore_momentum
+            self.restore_momentum = None
+        if logs is not None and getattr(self.model, "optimizer", None) \
+                is not None:
+            logs["lr"] = float(np.asarray(
+                self.model.optimizer.learning_rate))
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual warmup from lr to lr*size over warmup_epochs
+    (Goyal et al.; reference: _keras/callbacks.py:120-168)."""
+
+    def __init__(self, warmup_epochs: int = 5,
+                 momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None, verbose: int = 0,
+                 initial_lr: Optional[float] = None):
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+
+        def multiplier(epoch):
+            # epoch may be fractional (per-batch); ramp 1 → size
+            n = max(basics.size(), 1)
+            progress = min(max(epoch / float(warmup_epochs), 0.0), 1.0)
+            return 1.0 + progress * (n - 1.0)
+
+        super().__init__(multiplier=multiplier, start_epoch=0,
+                         end_epoch=warmup_epochs, staircase=False,
+                         momentum_correction=momentum_correction,
+                         initial_lr=initial_lr)
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if epoch == self.warmup_epochs - 1 and self.verbose and \
+                basics.rank() == 0:
+            print(f"Epoch {epoch + 1}: finished gradual learning rate "
+                  f"warmup to "
+                  f"{np.asarray(self.model.optimizer.learning_rate)}.")

@@ -26,6 +26,7 @@ from typing import List
 
 import numpy as np
 
+from horovod_tpu import native as _native
 from horovod_tpu.common.controller import Controller
 from horovod_tpu.common.message import (
     Response, datatype_to_numpy_dtype, numpy_dtype_to_datatype,
@@ -82,7 +83,9 @@ class SocketBackend(CollectiveBackend):
         if gathered is not None:  # coordinator
             acc = np.frombuffer(bytearray(gathered[0]), dtype=dtype)
             for data in gathered[1:]:
-                acc += np.frombuffer(data, dtype=dtype)
+                src = np.frombuffer(data, dtype=dtype)
+                if not _native.sum_into(acc, src):
+                    acc += src
             result = _np_from_bytes(
                 ctl.broadcast_data(acc.tobytes()), dtype)
         else:
@@ -118,13 +121,16 @@ class SocketBackend(CollectiveBackend):
     def execute_broadcast(self, entries, response: Response) -> Status:
         ctl = self._ctl
         (entry,) = entries
-        arr = np.ascontiguousarray(_to_numpy(entry.tensor))
+        orig = _to_numpy(entry.tensor)
+        # ascontiguousarray promotes 0-d to (1,); keep the true shape —
+        # broadcast is the one collective defined on scalars.
+        arr = np.ascontiguousarray(orig)
         if ctl.rank == entry.root_rank:
             data = ctl.broadcast_data(arr.tobytes(),
                                       root_rank=entry.root_rank)
         else:
             data = ctl.broadcast_data(None, root_rank=entry.root_rank)
-        result = _np_from_bytes(data, arr.dtype).reshape(arr.shape)
+        result = _np_from_bytes(data, arr.dtype).reshape(orig.shape)
         entry.output = _restore(entry, result)
         return Status.OK()
 
@@ -165,7 +171,9 @@ class SocketBackend(CollectiveBackend):
         if gathered is not None:
             acc = np.frombuffer(bytearray(gathered[0]), dtype=arr.dtype)
             for data in gathered[1:]:
-                acc += np.frombuffer(data, dtype=arr.dtype)
+                src = np.frombuffer(data, dtype=arr.dtype)
+                if not _native.sum_into(acc, src):
+                    acc += src
             acc = acc.reshape(arr.shape)
             payloads = [acc[d * per_rank:(d + 1) * per_rank].tobytes()
                         for d in range(size)]

@@ -31,7 +31,9 @@ class TrainerConfig:
     data_axis: str = "data"
     model_axis: Optional[str] = "model"   # None = no tensor parallelism
     seq_axis: Optional[str] = None        # None = no sequence parallelism
-    use_ring_attention: bool = False
+    # Sequence parallelism needs a ring attention_fn in the model config
+    # (parallel.make_ring_attention) — injected there, not a flag here,
+    # because the attention implementation lives in the module tree.
     donate_state: bool = True
 
 

@@ -1,0 +1,16 @@
+"""hvdtpurun — the launcher (reference: horovod/run/ ``horovodrun``).
+
+``python -m horovod_tpu.run -np N [-H host1:slots,host2:slots] cmd...``
+
+Local worlds (no ``-H``, or only localhost) spawn N processes directly.
+Multi-host worlds start a driver TCP service, launch one task server
+per host (over ssh), let tasks register their routable addresses,
+assign ranks grouped by host (rank 0 on the first host, like the
+reference's host ordering), and remote-exec the command with the
+controller coordinates in the environment
+(reference: horovod/run/run.py:193-264 _driver_fn + task_fn.py).
+"""
+
+from horovod_tpu.run.launch import main, run_local
+
+__all__ = ["main", "run_local"]

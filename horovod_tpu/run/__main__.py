@@ -1,0 +1,4 @@
+from horovod_tpu.run.launch import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Programmatic function launcher: run a Python function on N ranks
+and collect per-rank results.
+
+Role-equivalent of ``horovod.spark.run(fn, ...)``
+(reference: horovod/spark/__init__.py:82-199) without the Spark
+dependency: the function is pickled, executed in N launched processes
+(local by default), and the return values come back ordered by rank —
+the same contract Spark users rely on. ``horovod_tpu.spark`` layers the
+actual Spark scheduling on top when pyspark is present.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+from typing import Any, Callable, List, Optional
+
+from horovod_tpu.run.launch import run_local
+
+_RUNNER = r"""
+import pickle, sys
+fn_path, out_path = sys.argv[1], sys.argv[2]
+with open(fn_path, "rb") as f:
+    fn, args, kwargs = pickle.load(f)
+import horovod_tpu as hvd
+hvd.init()
+result = fn(*args, **kwargs)
+rank = hvd.rank()
+with open(out_path + f".{rank}", "wb") as f:
+    pickle.dump(result, f)
+hvd.shutdown()
+"""
+
+
+def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
+        num_proc: int = 1, env: Optional[dict] = None,
+        start_timeout: float = 30.0) -> List[Any]:
+    """Execute ``fn(*args, **kwargs)`` on ``num_proc`` ranks; returns
+    the per-rank results ordered by rank
+    (reference: horovod.spark.run result ordering,
+    spark/__init__.py:195-199)."""
+    kwargs = kwargs or {}
+    with tempfile.TemporaryDirectory() as tmp:
+        fn_path = os.path.join(tmp, "fn.pkl")
+        out_path = os.path.join(tmp, "result")
+        runner_path = os.path.join(tmp, "runner.py")
+        with open(fn_path, "wb") as f:
+            pickle.dump((fn, args, kwargs), f)
+        with open(runner_path, "w") as f:
+            f.write(_RUNNER)
+        penv = dict(env or {})
+        penv.setdefault("PYTHONPATH", os.pathsep.join(
+            [p for p in ([os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))]
+                + sys.path) if p]))
+        code = run_local(
+            num_proc,
+            [sys.executable, runner_path, fn_path, out_path],
+            env=penv, start_timeout=start_timeout)
+        if code != 0:
+            raise RuntimeError(f"horovod_tpu.run.api.run failed with "
+                               f"exit code {code}")
+        results = []
+        for rank in range(num_proc):
+            with open(f"{out_path}.{rank}", "rb") as f:
+                results.append(pickle.load(f))
+        return results

@@ -1,0 +1,216 @@
+"""hvdtpurun CLI + local/ssh launch drivers
+(reference: horovod/run/run.py:295-483 + bin/horovodrun).
+
+Unlike the reference, there is no mpirun at the bottom: the task
+servers spawn the training processes directly and the controller
+coordinates, so the whole stack is ours.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import secrets as _secrets
+import shlex
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from horovod_tpu.common import logging as hlog
+from horovod_tpu.run.services import DriverService, local_addresses
+
+
+def parse_hosts(spec: str) -> List[Tuple[str, int]]:
+    """'a:4,b:4' -> [('a', 4), ('b', 4)]
+    (reference: run/run.py -H format)."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            host, slots = part.rsplit(":", 1)
+            out.append((host, int(slots)))
+        else:
+            out.append((part, 1))
+    return out
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_local(np_: int, command: List[str],
+              env: Optional[Dict[str, str]] = None,
+              start_timeout: float = 30.0) -> int:
+    """Spawn np_ ranks on this host (the ``-H`` -less fast path; the
+    reference always shells out to mpirun even locally — we don't
+    need to)."""
+    port = _free_port()
+    procs = []
+    for rank in range(np_):
+        penv = dict(os.environ)
+        if env:
+            penv.update(env)
+        penv["HOROVOD_RANK"] = str(rank)
+        penv["HOROVOD_SIZE"] = str(np_)
+        penv["HOROVOD_CONTROLLER_ADDR"] = "127.0.0.1"
+        penv["HOROVOD_CONTROLLER_PORT"] = str(port)
+        penv.setdefault("HOROVOD_START_TIMEOUT", str(start_timeout))
+        procs.append(subprocess.Popen(command, env=penv))
+
+    exit_code = 0
+    try:
+        # Poll our own children only — a bare os.wait() would reap
+        # unrelated subprocesses of the embedding process.
+        pending = list(procs)
+        while pending:
+            still = []
+            for p in pending:
+                rc = p.poll()
+                if rc is None:
+                    still.append(p)
+                elif rc != 0:
+                    exit_code = exit_code or rc
+                    # One rank failing → tear the world down like
+                    # mpirun does (kill-on-first-exit).
+                    for q in still + [x for x in pending
+                                      if x is not p and x.poll() is None]:
+                        q.terminate()
+            pending = [p for p in still if p.poll() is None]
+            if pending:
+                time.sleep(0.05)
+    except KeyboardInterrupt:
+        for p in procs:
+            p.terminate()
+        exit_code = 130
+    finally:
+        deadline = time.monotonic() + 10.0
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+    return exit_code
+
+
+def _ssh_spawn(host: str, ssh_port: Optional[int], remote_cmd: str,
+               env_to_forward: Dict[str, str]) -> subprocess.Popen:
+    """ssh-launch a task server on ``host``
+    (reference: run/run.py:103-190 _launch_task_servers)."""
+    exports = " ".join(
+        f"{k}={shlex.quote(v)}" for k, v in env_to_forward.items())
+    cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
+    if ssh_port:
+        cmd += ["-p", str(ssh_port)]
+    cmd += [host, f"{exports} {remote_cmd}"]
+    return subprocess.Popen(cmd)
+
+
+def run_multihost(hosts: List[Tuple[str, int]], command: List[str],
+                  ssh_port: Optional[int] = None,
+                  env: Optional[Dict[str, str]] = None,
+                  start_timeout: float = 60.0,
+                  spawn_fn=None) -> int:
+    """Driver flow: start DriverService → launch task servers (ssh by
+    default; ``spawn_fn(host_index, driver_addr, driver_port, env)``
+    is injectable for tests) → registration → ring probe → rank
+    assignment → launch → collect exits
+    (reference: run/run.py:193-264 _driver_fn)."""
+    secret = os.environ.get("HOROVOD_SECRET_KEY") or \
+        _secrets.token_hex(16)
+    driver = DriverService(len(hosts), secret=secret.encode())
+    driver_addr = local_addresses()[0]
+
+    forward_env = {"HOROVOD_SECRET_KEY": secret}
+    if env:
+        forward_env.update(env)
+
+    spawned = []
+    try:
+        for i, (host, _slots) in enumerate(hosts):
+            if spawn_fn is not None:
+                spawned.append(spawn_fn(i, driver_addr, driver.port,
+                                        forward_env))
+            else:
+                remote = (f"{shlex.quote(sys.executable)} -m "
+                          f"horovod_tpu.run.services {i} {driver_addr} "
+                          f"{driver.port}")
+                spawned.append(_ssh_spawn(host, ssh_port, remote,
+                                          forward_env))
+
+        driver.wait_for_registration(timeout=start_timeout)
+        driver.ring_probe()
+        slots = [s for _, s in hosts]
+        assignments = driver.assign_ranks(slots)
+        controller = driver.controller_endpoint()
+        driver.launch(assignments, command, forward_env, controller)
+        codes = driver.wait_for_exit()
+        return max(codes)
+    finally:
+        driver.shutdown()
+        for p in spawned:
+            if hasattr(p, "poll") and p.poll() is None:
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    parser = argparse.ArgumentParser(
+        prog="hvdtpurun",
+        description="Launch a horovod_tpu training job "
+                    "(reference: horovodrun).")
+    parser.add_argument("-np", "--num-proc", type=int, required=True,
+                        help="total number of training processes")
+    parser.add_argument("-H", "--hosts", default=None,
+                        help="host1:slots,host2:slots (default: local)")
+    parser.add_argument("-p", "--ssh-port", type=int, default=None)
+    parser.add_argument("--start-timeout", type=float, default=None,
+                        help="seconds to wait for ranks/hosts to start "
+                             "(env HOROVOD_START_TIMEOUT)")
+    parser.add_argument("--verbose", action="store_true")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="training command")
+    args = parser.parse_args(argv)
+
+    if not args.command:
+        parser.error("no training command given")
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+
+    if args.verbose:
+        os.environ.setdefault("HOROVOD_LOG_LEVEL", "debug")
+    start_timeout = args.start_timeout or float(
+        os.environ.get("HOROVOD_START_TIMEOUT", "30"))
+
+    if not args.hosts or all(
+            h in ("localhost", "127.0.0.1", socket.gethostname())
+            for h, _ in parse_hosts(args.hosts)):
+        if args.hosts:
+            total = sum(s for _, s in parse_hosts(args.hosts))
+            if total != args.num_proc:
+                parser.error(f"-np {args.num_proc} != total slots {total}")
+        sys.exit(run_local(args.num_proc, command,
+                           start_timeout=start_timeout))
+
+    hosts = parse_hosts(args.hosts)
+    total = sum(s for _, s in hosts)
+    if total != args.num_proc:
+        parser.error(f"-np {args.num_proc} != total slots {total}")
+    sys.exit(run_multihost(hosts, command, ssh_port=args.ssh_port,
+                           start_timeout=start_timeout))
+
+
+if __name__ == "__main__":
+    main()

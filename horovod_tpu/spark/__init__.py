@@ -1,0 +1,86 @@
+"""Spark integration (reference: horovod/spark/__init__.py:82-199).
+
+``horovod_tpu.spark.run(fn, ...)`` runs ``fn`` on ``num_proc`` Spark
+tasks with the horovod_tpu world wired up, returning results ordered
+by rank. Requires pyspark; without it, ``horovod_tpu.run.api.run``
+provides the identical contract on local processes.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Any, Callable, List, Optional
+
+
+def _require_pyspark():
+    try:
+        import pyspark  # noqa: F401
+        return pyspark
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.spark requires pyspark. For the same "
+            "contract without Spark use horovod_tpu.run.api.run(fn, "
+            "num_proc=N).") from e
+
+
+def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
+        num_proc: Optional[int] = None,
+        start_timeout: float = 60.0, verbose: int = 1) -> List[Any]:
+    """Run ``fn`` on ``num_proc`` Spark tasks
+    (reference: spark/__init__.py:82-199). Each task initializes a
+    horovod_tpu world whose rank order follows Spark partition ids,
+    rank 0's host carrying the coordinator — the reference's host-hash
+    grouping with rank 0 first (spark/__init__.py:144-154)."""
+    pyspark = _require_pyspark()
+    from pyspark.sql import SparkSession
+
+    kwargs = kwargs or {}
+    spark = SparkSession.builder.getOrCreate()
+    sc = spark.sparkContext
+    if num_proc is None:
+        num_proc = max(sc.defaultParallelism, 1)
+
+    # Stage 1: elect the coordinator — partition 0 reports a reachable
+    # address and a reserved port through the driver.
+    from horovod_tpu.run.services import local_addresses
+    from horovod_tpu.common import network
+
+    def _elect(index, _it):
+        if index == 0:
+            srv = network.listen(0)
+            port = srv.getsockname()[1]
+            addr = local_addresses()[0]
+            srv.close()  # released; rank 0 rebinds at init
+            yield (addr, port)
+
+    coord_addr, coord_port = sc.parallelize(
+        range(num_proc), num_proc).mapPartitionsWithIndex(
+            _elect).collect()[0]
+
+    secret = os.environ.get("HOROVOD_SECRET_KEY", "")
+
+    # Stage 2: run fn on every partition with the world wired up.
+    def _task(index, _it):
+        os.environ["HOROVOD_RANK"] = str(index)
+        os.environ["HOROVOD_SIZE"] = str(num_proc)
+        os.environ["HOROVOD_CONTROLLER_ADDR"] = coord_addr
+        os.environ["HOROVOD_CONTROLLER_PORT"] = str(coord_port)
+        os.environ["HOROVOD_START_TIMEOUT"] = str(start_timeout)
+        if secret:
+            os.environ["HOROVOD_SECRET_KEY"] = secret
+        import horovod_tpu as hvd
+        hvd.init()
+        try:
+            result = fn(*args, **kwargs)
+        finally:
+            hvd.shutdown()
+        yield (index, result)
+
+    results = sc.parallelize(range(num_proc), num_proc) \
+        .mapPartitionsWithIndex(_task).collect()
+    # ordered by rank (reference: spark/__init__.py:195-199)
+    return [r for _, r in sorted(results)]
+
+
+__all__ = ["run"]

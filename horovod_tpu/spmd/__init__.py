@@ -187,7 +187,9 @@ def reducescatter(x, op: int = Average, axis: AxisName = "data"):
     (reference: the reduce-scatter stage of NCCLHierarchicalAllreduce,
     horovod/common/ops/nccl_operations.cc:222-236)."""
     import jax
-    import jax.numpy as jnp
+    if op not in (Average, Sum):
+        raise ValueError("reducescatter supports Average/Sum only "
+                         f"(got op={op}); XLA's reduce-scatter is a sum")
     y = jax.lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
     if op == Average:
         y = y / mesh_size(axis)

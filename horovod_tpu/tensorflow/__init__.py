@@ -1,0 +1,180 @@
+"""TensorFlow adapter (TF2 eager / tf.function-free host path).
+
+Role-equivalent of the reference's TF binding + Python API
+(reference: horovod/tensorflow/__init__.py:1-326,
+horovod/tensorflow/mpi_ops.py). On a TPU host the compute path is JAX;
+TF participates the way torch does — tensors staged through numpy into
+the background runtime, with ``DistributedGradientTape`` and
+``DistributedOptimizer`` providing the reference's gradient-averaging
+contract for TF training loops. The TF1 graph-mode custom-op path
+(AsyncOpKernel, reference: horovod/tensorflow/mpi_ops.cc:276-433) is
+intentionally not reproduced: there is no TF runtime on TPU here, and
+eager numpy staging covers the behavioral contract.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from horovod_tpu.common.basics import (  # noqa: F401
+    init, shutdown, initialized, rank, size, local_rank, local_size,
+    cross_rank, cross_size, is_homogeneous,
+)
+from horovod_tpu.common.compression import Compression  # noqa: F401
+from horovod_tpu import ops as _ops
+from horovod_tpu.ops import Average, Sum, poll  # noqa: F401
+
+
+def _to_numpy(t) -> np.ndarray:
+    if isinstance(t, np.ndarray):
+        return t
+    return t.numpy() if hasattr(t, "numpy") else np.asarray(t)
+
+
+def _to_tf(arr, like):
+    import tensorflow as tf
+    return tf.constant(np.ascontiguousarray(arr), dtype=like.dtype)
+
+
+def allreduce(tensor, op: int = Average, name: Optional[str] = None,
+              compression=Compression.none):
+    """Sparse tensors (tf.IndexedSlices) take the allgather path like
+    the reference (reference: horovod/tensorflow/__init__.py:46-92)."""
+    import tensorflow as tf
+    if isinstance(tensor, tf.IndexedSlices):
+        values = allgather(tensor.values, name=f"{name}.values"
+                           if name else None)
+        indices = allgather(tensor.indices, name=f"{name}.indices"
+                            if name else None)
+        if op == Average:
+            values = values / size()
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
+    host = _to_numpy(tensor)
+    comp, ctx = compression.compress(host)
+    out = _ops.allreduce(comp, op=op, name=name)
+    return _to_tf(np.asarray(compression.decompress(np.asarray(out), ctx),
+                             dtype=host.dtype), tensor)
+
+
+def allgather(tensor, name: Optional[str] = None):
+    out = _ops.allgather(_to_numpy(tensor), name=name)
+    import tensorflow as tf
+    return tf.constant(np.ascontiguousarray(out))
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
+    out = _ops.broadcast(_to_numpy(tensor), root_rank=root_rank, name=name)
+    return _to_tf(np.asarray(out), tensor)
+
+
+def alltoall(tensor, name: Optional[str] = None):
+    out = _ops.alltoall(_to_numpy(tensor), name=name)
+    import tensorflow as tf
+    return tf.constant(np.ascontiguousarray(out))
+
+
+def broadcast_variables(variables, root_rank: int = 0) -> None:
+    """Assign root's values into ``variables``
+    (reference: horovod/tensorflow/__init__.py:95-103)."""
+    for i, var in enumerate(variables):
+        host = _to_numpy(var)
+        out = _ops.broadcast(host, root_rank=root_rank,
+                             name=f"tf.bcast.{i}")
+        var.assign(np.asarray(out).astype(host.dtype)
+                   .reshape(host.shape))
+
+
+def broadcast_global_variables(root_rank: int = 0) -> None:
+    """TF1-compat global-variable broadcast
+    (reference: horovod/tensorflow/__init__.py:106-114)."""
+    import tensorflow as tf
+    broadcast_variables(tf.compat.v1.global_variables(), root_rank)
+
+
+class DistributedGradientTape:
+    """Wrap tf.GradientTape so ``gradient()`` returns allreduced grads
+    (reference: horovod/tensorflow/__init__.py:252-326)."""
+
+    def __init__(self, tape, compression=Compression.none,
+                 op: int = Average):
+        self._tape = tape
+        self._compression = compression
+        self._op = op
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def gradient(self, target, sources, output_gradients=None):
+        import tensorflow as tf
+        grads = self._tape.gradient(target, sources, output_gradients)
+        # Mirror the sources' structure (bare variable in → bare tensor
+        # out), like the reference's tf.nest handling.
+        flat = tf.nest.flatten(grads)
+        out = []
+        for i, g in enumerate(flat):
+            if g is None:
+                out.append(None)
+                continue
+            out.append(allreduce(g, op=self._op, name=f"tape.grad.{i}",
+                                 compression=self._compression))
+        return tf.nest.pack_sequence_as(grads, out)
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+
+def DistributedOptimizer(optimizer, compression=Compression.none,
+                         op: int = Average):
+    """Wrap a tf.keras optimizer: apply_gradients averages first
+    (reference: horovod/tensorflow/__init__.py:151-249)."""
+    cls = optimizer.__class__
+
+    class _Distributed(cls):
+        _hvd_wrapped = True
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            gv = list(grads_and_vars)
+            reduced = []
+            for i, (g, v) in enumerate(gv):
+                if g is None:
+                    reduced.append((None, v))
+                    continue
+                reduced.append((allreduce(g, op=op,
+                                          name=f"tfopt.grad.{i}",
+                                          compression=compression), v))
+            return super().apply_gradients(reduced, *args, **kwargs)
+
+    config = optimizer.get_config()
+    dist = _Distributed.from_config(config)
+    _Distributed.__name__ = cls.__name__
+    return dist
+
+
+class BroadcastGlobalVariablesHook:
+    """TF1 SessionRunHook stub kept for API parity; eager TF2 should
+    call broadcast_variables instead (reference:
+    horovod/tensorflow/__init__.py:117-148)."""
+
+    def __init__(self, root_rank: int = 0, device: str = ""):
+        self.root_rank = root_rank
+
+    def after_create_session(self, session, coord):
+        broadcast_global_variables(self.root_rank)
+
+
+__all__ = [
+    "init", "shutdown", "initialized", "rank", "size", "local_rank",
+    "local_size", "cross_rank", "cross_size", "is_homogeneous",
+    "Average", "Sum", "Compression", "poll",
+    "allreduce", "allgather", "broadcast", "alltoall",
+    "broadcast_variables", "broadcast_global_variables",
+    "DistributedGradientTape", "DistributedOptimizer",
+    "BroadcastGlobalVariablesHook",
+]

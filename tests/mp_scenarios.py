@@ -240,6 +240,61 @@ def scenario_jax_adapter(hvd_mod, rank, size):
 
 
 
+def scenario_keras_optimizer(hvd_mod, rank, size):
+    """keras DistributedOptimizer: rank-divergent data, identical
+    weights after fit (reference analog: test_keras.py:62-186 +
+    test_tensorflow_keras.py:46 test_train_model)."""
+    import os
+    os.environ.setdefault("KERAS_BACKEND", "tensorflow")
+    import keras
+    import horovod_tpu.keras as hvd
+
+    keras.utils.set_random_seed(42)  # same init everywhere
+    model = keras.Sequential([
+        keras.layers.Input((4,)),
+        keras.layers.Dense(3, activation="relu"),
+        keras.layers.Dense(2),
+    ])
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.05))
+    model.compile(optimizer=opt, loss="mse")
+    rng = np.random.RandomState(rank)  # different data per rank
+    x = rng.randn(16, 4).astype(np.float32)
+    y = rng.randn(16, 2).astype(np.float32)
+    model.fit(x, y, epochs=1, batch_size=8, verbose=0)
+
+    flat = np.concatenate([w.reshape(-1) for w in model.get_weights()])
+    gathered = hvd_mod.allgather(flat.reshape(1, -1), name="keras.check")
+    for r in range(size):
+        np.testing.assert_allclose(gathered[r], gathered[0], atol=1e-6)
+
+
+def scenario_tf_tape(hvd_mod, rank, size):
+    """DistributedGradientTape averages grads across ranks
+    (reference analog: test_tensorflow.py:334 allreduce_grad)."""
+    import tensorflow as tf
+    import horovod_tpu.tensorflow as hvd
+
+    v = tf.Variable([1.0, 2.0, 3.0])
+    with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+        loss = tf.reduce_sum(v * float(rank + 1))
+    grads = tape.gradient(loss, [v])
+    mean = sum(range(1, size + 1)) / size
+    np.testing.assert_allclose(grads[0].numpy(), [mean] * 3, rtol=1e-6)
+
+    bcast = tf.Variable([float(rank)] * 4)
+    hvd.broadcast_variables([bcast], root_rank=1)
+    np.testing.assert_allclose(bcast.numpy(), [1.0] * 4)
+
+
+def scenario_scalar_broadcast(hvd_mod, rank, size):
+    """0-d tensors must round-trip broadcast with shape intact
+    (regression: ascontiguousarray promotes 0-d to (1,))."""
+    out = hvd_mod.broadcast(np.asarray(float(rank)), root_rank=1,
+                            name="scalar")
+    assert np.asarray(out).shape == (), np.asarray(out).shape
+    assert float(np.asarray(out)) == 1.0
+
+
 def main():
     scenario, rank, size, port = (sys.argv[1], int(sys.argv[2]),
                                   int(sys.argv[3]), int(sys.argv[4]))

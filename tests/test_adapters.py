@@ -1,0 +1,199 @@
+"""Framework adapter tests (flax in-jit; keras/TF size-1 host path).
+
+Multi-rank adapter behavior is covered by mp_scenarios
+(torch_optimizer, jax_adapter, keras_optimizer, tf_tape)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+# ---------------------------------------------------------------------------
+# flax
+# ---------------------------------------------------------------------------
+
+def test_flax_distributed_train_state_syncs_grads(hvd_world):
+    """Two different per-device batches, replicated params: the wrapped
+    tx must produce identical (averaged) updates on every device."""
+    import optax
+    from horovod_tpu import spmd
+    import horovod_tpu.flax as hvd_flax
+    from horovod_tpu.models import MnistConvNet
+
+    mesh = spmd.create_mesh({"data": 8})
+    model = MnistConvNet()
+    x0 = jnp.zeros((8, 28, 28, 1))
+    params = model.init(jax.random.key(0), x0)["params"]
+
+    state = hvd_flax.create_distributed_train_state(
+        model.apply, params, optax.sgd(0.1))
+
+    def step(s, batch, labels):
+        def loss_fn(p):
+            logits = s.apply_fn({"params": p}, batch)
+            oh = jax.nn.one_hot(labels, 10)
+            return -jnp.mean(jnp.sum(
+                jax.nn.log_softmax(logits) * oh, axis=-1))
+        grads = jax.grad(loss_fn)(s.params)
+        return s.apply_gradients(grads=grads)
+
+    smap = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P("data"), P("data")), out_specs=P(),
+        check_vma=False))
+
+    rng = np.random.RandomState(0)
+    batch = jnp.asarray(rng.randn(16, 28, 28, 1), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, 10, 16), jnp.int32)
+    new_state = smap(state, batch, labels)
+    # out_specs=P() asserts the updated params are identical across
+    # devices — that only holds if the tx averaged the per-device grads.
+    leaves = jax.tree_util.tree_leaves(new_state.params)
+    assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+    # and the params actually moved
+    moved = jax.tree_util.tree_map(
+        lambda a, b: float(jnp.abs(a - b).max()),
+        state.params, new_state.params)
+    assert max(jax.tree_util.tree_leaves(moved)) > 0
+
+
+def test_flax_average_metrics_size1(hvd_world):
+    import horovod_tpu.flax as hvd_flax
+    out = hvd_flax.average_metrics({"loss": 2.0, "acc": 0.5})
+    assert out == {"loss": 2.0, "acc": 0.5}
+
+
+def test_flax_scaled_lr_schedule():
+    import horovod_tpu.flax as hvd_flax
+    sched = hvd_flax.scaled_lr_schedule(0.1, warmup_steps=10,
+                                        world_size=4)
+    assert float(sched(0)) == pytest.approx(0.1)
+    assert float(sched(10)) == pytest.approx(0.4)
+    flat = hvd_flax.scaled_lr_schedule(0.1, warmup_steps=0, world_size=8)
+    assert float(flat(123)) == pytest.approx(0.8)
+
+
+# ---------------------------------------------------------------------------
+# keras
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def keras_mod():
+    keras = pytest.importorskip("keras")
+    return keras
+
+
+def _tiny_keras_model(keras):
+    model = keras.Sequential([
+        keras.layers.Input((4,)),
+        keras.layers.Dense(3, activation="relu"),
+        keras.layers.Dense(2),
+    ])
+    return model
+
+
+def test_keras_distributed_optimizer_trains(hvd_world, keras_mod):
+    import horovod_tpu.keras as hvd_keras
+    keras = keras_mod
+    model = _tiny_keras_model(keras)
+    opt = hvd_keras.DistributedOptimizer(keras.optimizers.SGD(0.05))
+    assert opt.__class__.__name__ == "SGD"
+    model.compile(optimizer=opt, loss="mse")
+    x = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+    y = np.random.RandomState(1).randn(16, 2).astype(np.float32)
+    h = model.fit(x, y, epochs=2, batch_size=8, verbose=0)
+    assert h.history["loss"][1] < h.history["loss"][0] * 1.5
+
+
+def test_keras_broadcast_and_callbacks(hvd_world, keras_mod):
+    import horovod_tpu.keras as hvd_keras
+    keras = keras_mod
+    model = _tiny_keras_model(keras)
+    model.compile(optimizer=keras.optimizers.SGD(0.1), loss="mse")
+    w0 = model.get_weights()
+    hvd_keras.broadcast_global_variables(model, root_rank=0)
+    for a, b in zip(w0, model.get_weights()):
+        np.testing.assert_allclose(a, b)
+
+    cb = hvd_keras.callbacks.MetricAverageCallback()
+    cb.set_model(model)
+    logs = {"loss": 3.0}
+    cb.on_epoch_end(0, logs)
+    assert logs["loss"] == pytest.approx(3.0)  # size-1 world
+
+    bcast = hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0)
+    bcast.set_model(model)
+    bcast.on_batch_begin(0)
+    assert bcast.broadcast_done
+
+
+def test_keras_warmup_callback_ramps(hvd_world, keras_mod):
+    import horovod_tpu.keras as hvd_keras
+    keras = keras_mod
+    model = _tiny_keras_model(keras)
+    model.compile(optimizer=keras.optimizers.SGD(0.1), loss="mse")
+    cb = hvd_keras.callbacks.LearningRateWarmupCallback(warmup_epochs=5)
+    cb.set_model(model)
+    cb.set_params({"steps": 2})
+    # size-1 world: multiplier is identically 1.0 → lr unchanged
+    cb.on_epoch_begin(0)
+    cb.on_batch_begin(0)
+    assert float(np.asarray(model.optimizer.learning_rate)) == \
+        pytest.approx(0.1)
+    # the multiplier math itself ramps 1 → size
+    assert cb.multiplier(0) == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# tensorflow
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tf_mod():
+    tf = pytest.importorskip("tensorflow")
+    return tf
+
+
+def test_tf_ops_size1(hvd_world, tf_mod):
+    import horovod_tpu.tensorflow as hvd_tf
+    tf = tf_mod
+    x = tf.constant([[1.0, 2.0], [3.0, 4.0]])
+    out = hvd_tf.allreduce(x, op=hvd_tf.Sum)
+    np.testing.assert_allclose(out.numpy(), x.numpy())
+    out = hvd_tf.broadcast(x, root_rank=0)
+    np.testing.assert_allclose(out.numpy(), x.numpy())
+    out = hvd_tf.allgather(x)
+    np.testing.assert_allclose(out.numpy(), x.numpy())
+
+
+def test_tf_indexed_slices_sparse_path(hvd_world, tf_mod):
+    import horovod_tpu.tensorflow as hvd_tf
+    tf = tf_mod
+    slices = tf.IndexedSlices(
+        values=tf.constant([[1.0, 2.0]]), indices=tf.constant([3]),
+        dense_shape=tf.constant([8, 2]))
+    out = hvd_tf.allreduce(slices, op=hvd_tf.Average)
+    assert isinstance(out, tf.IndexedSlices)
+    np.testing.assert_allclose(out.values.numpy(), [[1.0, 2.0]])
+    np.testing.assert_allclose(out.indices.numpy(), [3])
+
+
+def test_tf_distributed_gradient_tape(hvd_world, tf_mod):
+    import horovod_tpu.tensorflow as hvd_tf
+    tf = tf_mod
+    v = tf.Variable([1.0, 2.0])
+    with hvd_tf.DistributedGradientTape(tf.GradientTape()) as tape:
+        loss = tf.reduce_sum(v * v)
+    grads = tape.gradient(loss, [v])
+    np.testing.assert_allclose(grads[0].numpy(), [2.0, 4.0])
+
+
+def test_tf_broadcast_variables(hvd_world, tf_mod):
+    import horovod_tpu.tensorflow as hvd_tf
+    tf = tf_mod
+    v = tf.Variable([5.0, 6.0])
+    hvd_tf.broadcast_variables([v], root_rank=0)
+    np.testing.assert_allclose(v.numpy(), [5.0, 6.0])

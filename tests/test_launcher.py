@@ -1,0 +1,133 @@
+"""Launcher tests: local spawn, multi-host driver/task protocol (task
+servers run in threads standing in for ssh-reached hosts), failure
+propagation. The reference leaves its host-discovery machinery
+untested (SURVEY §4); we do better."""
+
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+
+import pytest
+
+from horovod_tpu.run.launch import (
+    parse_hosts, run_local, run_multihost,
+)
+from horovod_tpu.run.services import TaskServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCRIPT_OK = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import numpy as np
+import horovod_tpu as hvd
+hvd.init()
+out = hvd.allreduce(np.full(3, float(hvd.rank() + 1), np.float32),
+                    op=hvd.Sum)
+expected = sum(range(1, hvd.size() + 1))
+assert np.allclose(out, expected), (out, expected)
+with open(os.path.join({tmp!r}, f"rank{{hvd.rank()}}.ok"), "w") as f:
+    f.write(str(hvd.size()))
+hvd.shutdown()
+"""
+
+SCRIPT_FAIL = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import horovod_tpu as hvd
+hvd.init()
+rank = hvd.rank()
+hvd.shutdown()
+sys.exit(3 if rank == 1 else 0)
+"""
+
+
+def _env():
+    return {"JAX_PLATFORMS": "cpu", "HOROVOD_CYCLE_TIME": "1",
+            "PYTHONPATH": REPO}
+
+
+def test_parse_hosts():
+    assert parse_hosts("a:4,b:2") == [("a", 4), ("b", 2)]
+    assert parse_hosts("single") == [("single", 1)]
+    assert parse_hosts("h:1, g:3") == [("h", 1), ("g", 3)]
+
+
+def test_run_local_world():
+    with tempfile.TemporaryDirectory() as tmp:
+        script = os.path.join(tmp, "train.py")
+        with open(script, "w") as f:
+            f.write(SCRIPT_OK.format(repo=REPO, tmp=tmp))
+        code = run_local(3, [sys.executable, script], env=_env())
+        assert code == 0
+        for r in range(3):
+            assert os.path.exists(os.path.join(tmp, f"rank{r}.ok"))
+
+
+def test_run_local_propagates_failure():
+    with tempfile.TemporaryDirectory() as tmp:
+        script = os.path.join(tmp, "train.py")
+        with open(script, "w") as f:
+            f.write(SCRIPT_FAIL.format(repo=REPO))
+        code = run_local(2, [sys.executable, script], env=_env())
+        assert code == 3
+
+
+def test_multihost_driver_protocol():
+    """Two simulated hosts x two slots: the full driver flow
+    (registration, ring probe, rank assignment, launch, exit
+    collection) over real TCP, with task servers in threads."""
+    with tempfile.TemporaryDirectory() as tmp:
+        script = os.path.join(tmp, "train.py")
+        with open(script, "w") as f:
+            f.write(SCRIPT_OK.format(repo=REPO, tmp=tmp))
+
+        threads = []
+
+        def spawn(host_index, driver_addr, driver_port, env):
+            os.environ["HOROVOD_SECRET_KEY"] = env["HOROVOD_SECRET_KEY"]
+            server = TaskServer(host_index, driver_addr, driver_port,
+                                env["HOROVOD_SECRET_KEY"].encode())
+            t = threading.Thread(target=server.serve_forever, daemon=True)
+            t.start()
+            threads.append(t)
+            return t
+
+        code = run_multihost(
+            [("hostA", 2), ("hostB", 2)],
+            [sys.executable, script],
+            env=_env(), spawn_fn=spawn, start_timeout=30.0)
+        assert code == 0
+        for r in range(4):
+            assert os.path.exists(os.path.join(tmp, f"rank{r}.ok")), \
+                f"rank {r} never ran"
+
+
+def test_cli_local():
+    with tempfile.TemporaryDirectory() as tmp:
+        script = os.path.join(tmp, "train.py")
+        with open(script, "w") as f:
+            f.write(SCRIPT_OK.format(repo=REPO, tmp=tmp))
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+             sys.executable, script],
+            env={**os.environ, **_env()}, cwd=REPO,
+            capture_output=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr.decode()
+        assert os.path.exists(os.path.join(tmp, "rank0.ok"))
+        assert os.path.exists(os.path.join(tmp, "rank1.ok"))
+
+
+def _fn_for_api_run(scale):
+    import horovod_tpu as hvd
+    return (hvd.rank() * scale, hvd.size())
+
+
+def test_api_run_collects_ordered_results():
+    """(reference contract: horovod.spark.run returns per-rank results
+    ordered by rank, spark/__init__.py:195-199)"""
+    from horovod_tpu.run.api import run
+    results = run(_fn_for_api_run, args=(10,), num_proc=3, env=_env())
+    assert results == [(0, 3), (10, 3), (20, 3)]

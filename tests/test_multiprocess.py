@@ -118,3 +118,15 @@ def test_torch_distributed_optimizer():
 
 def test_jax_adapter_host_path():
     run_scenario("jax_adapter", 2)
+
+
+def test_keras_distributed_optimizer():
+    run_scenario("keras_optimizer", 2, timeout=180.0)
+
+
+def test_tf_distributed_gradient_tape():
+    run_scenario("tf_tape", 2, timeout=180.0)
+
+
+def test_scalar_broadcast():
+    run_scenario("scalar_broadcast", 2)
