@@ -165,7 +165,12 @@ class LocalController(Controller):
 
 
 class TcpCoordinator(Controller):
-    """Rank 0: accepts one persistent connection per worker."""
+    """Rank 0: accepts one persistent connection per worker.
+
+    Per-cycle gather/broadcast hot paths go through the native core
+    (native/hvdtpu.cc hvd_gather_frames: one poll(2) loop servicing all
+    workers with the GIL released) when the library is available; the
+    Python per-channel loop is the fallback."""
 
     def __init__(self, size: int, port: int = 0, secret: bytes = b"",
                  start_timeout: float = 30.0):
@@ -177,6 +182,8 @@ class TcpCoordinator(Controller):
         self._size = size
         self._start_timeout = start_timeout
         self.topology = None  # set by accept_workers
+        self._native = None
+        self._worker_fds = None  # ranks 1..size-1 in rank order
 
     def accept_workers(self) -> None:
         deadline = time.monotonic() + self._start_timeout
@@ -225,10 +232,93 @@ class TcpCoordinator(Controller):
         for r, ch in self._channels.items():
             ch.send(blob, TAG_HANDSHAKE)
         self.topology = compute_topology(0, hostnames)
+        self._init_native()
         hlog.debug(f"coordinator up: {self._size} ranks, "
                    f"{self.topology.cross_size} hosts", rank=0)
 
+    def _init_native(self) -> None:
+        from horovod_tpu import native
+        lib = native.get()
+        if lib is None or self._size <= 1:
+            return
+        import ctypes
+        ranks = sorted(self._channels)
+        fds = [self._channels[r].sock.fileno() for r in ranks]
+        self._native = (lib, ctypes)
+        self._worker_ranks = ranks
+        self._worker_fds = (ctypes.c_int * len(fds))(*fds)
+        self._native_secret = (ctypes.c_uint8 * max(
+            1, len(self._secret))).from_buffer_copy(
+                self._secret or b"\x00")
+
+    @staticmethod
+    def _as_u8(ctypes, data: bytes):
+        """bytes → ctypes u8 array at memcpy speed (never a per-byte
+        Python loop — these sit on the per-cycle hot path)."""
+        return (ctypes.c_uint8 * max(1, len(data))).from_buffer_copy(
+            data or b"\x00")
+
+    def _native_gather(self, payload: bytes, expect_tag: int):
+        lib, ctypes = self._native
+        n = len(self._worker_ranks)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        bufs = (u8p * n)()
+        lens = (ctypes.c_int64 * n)()
+        tags = (ctypes.c_uint8 * n)()
+        try:
+            rc = lib.hvd_gather_frames(self._worker_fds, n,
+                                       self._native_secret,
+                                       len(self._secret), bufs, lens,
+                                       tags, -1)
+            if rc != 0:
+                # partial frames may already be malloc'd; the finally
+                # block frees them.
+                raise ConnectionError(
+                    f"native gather failed: errno {-rc}")
+            out: List[bytes] = [b""] * self._size
+            out[0] = payload
+            for i, r in enumerate(self._worker_ranks):
+                if tags[i] != expect_tag:
+                    raise ConnectionError(
+                        f"expected tag {expect_tag} from rank {r}, got "
+                        f"{tags[i]}")
+                out[r] = ctypes.string_at(bufs[i], lens[i])
+        finally:
+            for i in range(n):
+                if bufs[i]:
+                    lib.hvd_free(bufs[i])
+        return out
+
+    def _native_send_all(self, payload: bytes, tag: int) -> bool:
+        lib, ctypes = self._native
+        n = len(self._worker_ranks)
+        buf = self._as_u8(ctypes, payload)
+        rc = lib.hvd_broadcast_frame(self._worker_fds, n, tag, buf,
+                                     len(payload), self._native_secret,
+                                     len(self._secret))
+        if rc != 0:
+            raise ConnectionError(f"native broadcast failed: errno {-rc}")
+        return True
+
+    def _native_scatter(self, payloads: List[bytes]) -> None:
+        """Scatter payloads[r] to worker rank r (payloads[0] is local)."""
+        lib, ctypes = self._native
+        n = len(self._worker_ranks)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        arrs = [self._as_u8(ctypes, payloads[r])
+                for r in self._worker_ranks]
+        ptrs = (u8p * n)(*[ctypes.cast(a, u8p) for a in arrs])
+        lens = (ctypes.c_int64 * n)(
+            *[len(payloads[r]) for r in self._worker_ranks])
+        rc = lib.hvd_scatter_frames(self._worker_fds, n, TAG_DATA, ptrs,
+                                    lens, self._native_secret,
+                                    len(self._secret))
+        if rc != 0:
+            raise ConnectionError(f"native scatter failed: errno {-rc}")
+
     def gather_requests(self, payload: bytes) -> Optional[List[bytes]]:
+        if self._native is not None:
+            return self._native_gather(payload, TAG_REQUESTS)
         out: List[bytes] = [b""] * self._size
         out[0] = payload
         for r, ch in self._channels.items():
@@ -241,11 +331,16 @@ class TcpCoordinator(Controller):
 
     def broadcast_responses(self, payload: Optional[bytes]) -> bytes:
         assert payload is not None
+        if self._native is not None:
+            self._native_send_all(payload, TAG_RESPONSES)
+            return payload
         for ch in self._channels.values():
             ch.send(payload, TAG_RESPONSES)
         return payload
 
     def gather_data(self, payload: bytes) -> Optional[List[bytes]]:
+        if self._native is not None:
+            return self._native_gather(payload, TAG_DATA)
         out: List[bytes] = [b""] * self._size
         out[0] = payload
         for r, ch in self._channels.items():
@@ -264,12 +359,18 @@ class TcpCoordinator(Controller):
             if tag != TAG_DATA:
                 raise ConnectionError("expected TAG_DATA from root")
         assert payload is not None
+        if self._native is not None:
+            self._native_send_all(payload, TAG_DATA)
+            return payload
         for ch in self._channels.values():
             ch.send(payload, TAG_DATA)
         return payload
 
     def scatter_data(self, payloads: Optional[List[bytes]]) -> bytes:
         assert payloads is not None and len(payloads) == self._size
+        if self._native is not None:
+            self._native_scatter(payloads)
+            return payloads[0]
         for r, ch in self._channels.items():
             ch.send(payloads[r], TAG_DATA)
         return payloads[0]

@@ -40,8 +40,12 @@ class Channel:
     def __init__(self, sock: socket.socket, secret: bytes = b""):
         self.sock = sock
         self.secret = secret
-        # Batch small frames; collectives are latency-sensitive.
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Don't batch small frames; collectives are latency-sensitive.
+        # (No-op on non-TCP sockets, e.g. AF_UNIX socketpairs in tests.)
+        try:
+            self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
 
     def send(self, payload: bytes, tag: int = 0) -> None:
         hdr = _HDR.pack(len(payload), tag)

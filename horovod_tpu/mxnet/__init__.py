@@ -1,0 +1,134 @@
+"""MXNet adapter (reference: horovod/mxnet/__init__.py:1-140,
+horovod/mxnet/mpi_ops.py).
+
+Provided for API parity; requires mxnet (not bundled on TPU images).
+NDArrays are staged through numpy into the background runtime, like
+the torch adapter — the reference's MXTempBufferShared CudaOnCPU
+staging path (reference: horovod/mxnet/adapter.cc), which is the only
+mode that makes sense on a TPU host.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from horovod_tpu.common.basics import (  # noqa: F401
+    init, shutdown, initialized, rank, size, local_rank, local_size,
+)
+from horovod_tpu.common.compression import Compression  # noqa: F401
+from horovod_tpu import ops as _ops
+from horovod_tpu.ops import Average, Sum  # noqa: F401
+
+
+def _require_mx():
+    try:
+        import mxnet
+        return mxnet
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.mxnet requires mxnet; on TPU hosts prefer "
+            "horovod_tpu.jax.") from e
+
+
+def allreduce(tensor, average: bool = True, name: Optional[str] = None):
+    mx = _require_mx()
+    out = _ops.allreduce(tensor.asnumpy(),
+                         op=Average if average else Sum, name=name)
+    return mx.nd.array(np.asarray(out), dtype=tensor.dtype)
+
+
+def allreduce_(tensor, average: bool = True, name: Optional[str] = None):
+    result = allreduce(tensor, average=average, name=name)
+    tensor[:] = result
+    return tensor
+
+
+def allgather(tensor, name: Optional[str] = None):
+    mx = _require_mx()
+    out = _ops.allgather(tensor.asnumpy(), name=name)
+    return mx.nd.array(np.asarray(out), dtype=tensor.dtype)
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
+    mx = _require_mx()
+    out = _ops.broadcast(tensor.asnumpy(), root_rank=root_rank, name=name)
+    return mx.nd.array(np.asarray(out), dtype=tensor.dtype)
+
+
+def broadcast_(tensor, root_rank: int = 0, name: Optional[str] = None):
+    tensor[:] = broadcast(tensor, root_rank=root_rank, name=name)
+    return tensor
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Broadcast a gluon ParameterDict / dict of NDArrays from root
+    (reference: horovod/mxnet/__init__.py:96-140 incl. deferred-init
+    handling: parameters not yet initialized are skipped here — call
+    again after ``net.initialize()``)."""
+    if hasattr(params, "items"):
+        items = sorted(params.items())
+    else:
+        items = list(enumerate(params))
+    for name, p in items:
+        try:
+            data = p.data() if hasattr(p, "data") else p
+        except Exception:
+            continue  # deferred init
+        broadcast_(data, root_rank=root_rank, name=f"bcast.{name}")
+
+
+class DistributedOptimizer:
+    """Wrap an mxnet optimizer: allreduce grads in update()
+    (reference: horovod/mxnet/__init__.py:38-70)."""
+
+    def __init__(self, optimizer):
+        self._opt = optimizer
+
+    def _do(self, index, weight, grad, state, update_fn):
+        if size() > 1:
+            allreduce_(grad, average=True,
+                       name=f"grad.{index}")
+        update_fn(index, weight, grad, state)
+
+    def update(self, index, weight, grad, state):
+        self._do(index, weight, grad, state, self._opt.update)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self._do(index, weight, grad, state,
+                 self._opt.update_multi_precision)
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+
+class DistributedTrainer:
+    """gluon Trainer whose _allreduce_grads averages over ranks
+    (reference: horovod/mxnet/__init__.py:79-92)."""
+
+    def __new__(cls, params, optimizer, optimizer_params=None):
+        mx = _require_mx()
+
+        class _Trainer(mx.gluon.Trainer):
+            def __init__(self, params, optimizer, optimizer_params):
+                super().__init__(params, optimizer, optimizer_params,
+                                 kvstore=None)
+                self._scale /= size()
+
+            def _allreduce_grads(self):
+                for i, param in enumerate(self._params):
+                    if param.grad_req != "null":
+                        for g in param.list_grad():
+                            allreduce_(g, average=False,
+                                       name=f"grad.{i}")
+
+        return _Trainer(params, optimizer, optimizer_params)
+
+
+__all__ = [
+    "init", "shutdown", "initialized", "rank", "size", "local_rank",
+    "local_size", "Average", "Sum", "Compression",
+    "allreduce", "allreduce_", "allgather", "broadcast", "broadcast_",
+    "broadcast_parameters", "DistributedOptimizer", "DistributedTrainer",
+]

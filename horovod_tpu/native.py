@@ -1,0 +1,122 @@
+"""ctypes loader for the native runtime core (native/libhvdtpu.so).
+
+Role-equivalent of the reference's ``HorovodBasics`` shared-library
+loading (reference: horovod/common/__init__.py:51-63 ctypes CDLL with
+RTLD_GLOBAL), with one twist: if the library has not been built yet and
+a compiler is available, it is built on first import (the reference
+front-loads this into its 1,012-line setup.py; we have one make rule).
+
+Set ``HOROVOD_NATIVE=0`` to force the pure-Python paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from horovod_tpu.common import logging as hlog
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libhvdtpu.so")
+
+
+def _build() -> bool:
+    if not os.path.isdir(_NATIVE_DIR):
+        return False
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR, "-s"],
+                       check=True, capture_output=True, timeout=120)
+        return os.path.exists(_SO_PATH)
+    except (subprocess.SubprocessError, FileNotFoundError) as e:
+        hlog.debug(f"native build failed: {e}")
+        return False
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.hvd_gather_frames.restype = ctypes.c_int
+    lib.hvd_gather_frames.argtypes = [
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int, u8p, ctypes.c_int,
+        ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_int64), u8p,
+        ctypes.c_int]
+    lib.hvd_broadcast_frame.restype = ctypes.c_int
+    lib.hvd_broadcast_frame.argtypes = [
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_uint8,
+        u8p, ctypes.c_int64, u8p, ctypes.c_int]
+    lib.hvd_scatter_frames.restype = ctypes.c_int
+    lib.hvd_scatter_frames.argtypes = [
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_uint8,
+        ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_int64), u8p,
+        ctypes.c_int]
+    lib.hvd_free.restype = None
+    lib.hvd_free.argtypes = [u8p]
+    lib.hvd_pack.restype = None
+    lib.hvd_pack.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int, ctypes.c_void_p]
+    lib.hvd_unpack.restype = None
+    lib.hvd_unpack.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p)]
+    lib.hvd_sum_into.restype = ctypes.c_int
+    lib.hvd_sum_into.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int]
+    lib.hvd_hmac_sha256.restype = None
+    lib.hvd_hmac_sha256.argtypes = [
+        u8p, ctypes.c_int, ctypes.c_uint8, u8p, ctypes.c_int64, u8p]
+
+
+def get() -> Optional[ctypes.CDLL]:
+    """The loaded native library, or None (pure-Python fallback)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("HOROVOD_NATIVE", "1") == "0":
+            return None
+        if not os.path.exists(_SO_PATH) and not _build():
+            hlog.debug("native core unavailable; using Python paths")
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+            _configure(lib)
+            _lib = lib
+            hlog.debug(f"native core loaded from {_SO_PATH}")
+        except OSError as e:
+            hlog.warning(f"failed to load native core: {e}")
+    return _lib
+
+
+# -- numpy-facing wrappers ----------------------------------------------
+
+_DTYPE_CODES = {"float32": 0, "float64": 1, "int32": 2, "int64": 3,
+                "uint8": 4, "float16": 5}
+
+
+def sum_into(acc, src) -> bool:
+    """acc += src elementwise via the native kernel. Returns False if
+    the native path is unavailable for this dtype (caller falls back)."""
+    lib = get()
+    if lib is None:
+        return False
+    import numpy as np
+    code = _DTYPE_CODES.get(str(acc.dtype))
+    if code is None or not acc.flags["C_CONTIGUOUS"] \
+            or not src.flags["C_CONTIGUOUS"]:
+        return False
+    rc = lib.hvd_sum_into(
+        acc.ctypes.data_as(ctypes.c_void_p),
+        src.ctypes.data_as(ctypes.c_void_p),
+        acc.size, code)
+    return rc == 0

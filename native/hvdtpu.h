@@ -1,0 +1,63 @@
+// C API of the native runtime core, loaded from Python via ctypes.
+//
+// TPU-native counterpart of the reference's C++ core surface
+// (reference: horovod/common/operations.cc C API 1371-1426 and the
+// transport/fusion internals behind it). The Python runtime calls
+// these for the per-cycle hot paths; every entry point has a
+// pure-Python fallback so the framework runs without the library.
+//
+// Frame format (must match horovod_tpu/common/network.py Channel):
+//   u32le payload_len | u8 tag | [32-byte HMAC-SHA256(tag|payload)] |
+//   payload
+#pragma once
+
+#include <cstdint>
+#include <cstddef>
+
+extern "C" {
+
+// ---- frame transport (control plane batch ops) -----------------------
+// All functions return 0 on success, negative errno-style codes on
+// failure. Sockets are plain connected fds owned by Python.
+
+// Read one frame from each of n fds (poll-driven, GIL released on the
+// Python side). For fd i: *(bufs+i) receives a malloc'd payload whose
+// length is written to lens[i]; tags[i] receives the frame tag.
+// Caller frees each buffer with hvd_free.
+int hvd_gather_frames(const int* fds, int n, const uint8_t* secret,
+                      int secret_len, uint8_t** bufs, int64_t* lens,
+                      uint8_t* tags, int timeout_ms);
+
+// Write the same frame to each of n fds.
+int hvd_broadcast_frame(const int* fds, int n, uint8_t tag,
+                        const uint8_t* payload, int64_t len,
+                        const uint8_t* secret, int secret_len);
+
+// Write a distinct frame to each fd (scatter).
+int hvd_scatter_frames(const int* fds, int n, uint8_t tag,
+                       const uint8_t* const* payloads,
+                       const int64_t* lens, const uint8_t* secret,
+                       int secret_len);
+
+void hvd_free(uint8_t* buf);
+
+// ---- fusion buffer pack/unpack ---------------------------------------
+// (reference: horovod/common/ops/collective_operations.cc:35-63
+//  MemcpyInFusionBuffer / MemcpyOutFusionBuffer)
+void hvd_pack(const void* const* srcs, const int64_t* nbytes, int n,
+              void* dst);
+void hvd_unpack(const void* src, const int64_t* nbytes, int n,
+                void* const* dsts);
+
+// Elementwise sum into acc (the coordinator-side reduction of the
+// socket backend). dtype codes: 0=f32 1=f64 2=i32 3=i64 4=u8 5=f16raw
+// (f16 summed via f32 conversion; reference: common/half.cc:42-77).
+int hvd_sum_into(void* acc, const void* src, int64_t count, int dtype);
+
+// ---- self-test helpers ----------------------------------------------
+// HMAC-SHA256 of (tag|payload) into out[32] — lets Python verify the
+// embedded SHA implementation against hashlib.
+void hvd_hmac_sha256(const uint8_t* key, int key_len, uint8_t tag,
+                     const uint8_t* payload, int64_t len, uint8_t* out);
+
+}  // extern "C"
