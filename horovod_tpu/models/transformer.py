@@ -74,6 +74,17 @@ def causal_attention(q, k, v, causal: bool = True):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def best_attention(q, k, v, causal: bool = True):
+    """Default attention: the pallas flash kernel on TPU (O(S²) logits
+    never touch HBM — horovod_tpu/parallel/flash_attention.py), dense
+    fused-softmax elsewhere. Both produce identical math."""
+    import jax
+    if jax.default_backend() in ("tpu", "axon") and causal:
+        from horovod_tpu.parallel.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal)
+    return causal_attention(q, k, v, causal)
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
 
@@ -87,7 +98,7 @@ class Attention(nn.Module):
         v = dense((cfg.num_heads, cfg.head_dim), "v")(x)
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
-        attn = cfg.attention_fn or causal_attention
+        attn = cfg.attention_fn or best_attention
         out = attn(q, k, v, True)
         return nn.DenseGeneral(cfg.embed_dim, axis=(-2, -1), use_bias=False,
                                dtype=cfg.dtype, name="o")(out)

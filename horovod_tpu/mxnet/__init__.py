@@ -88,8 +88,13 @@ class DistributedOptimizer:
 
     def _do(self, index, weight, grad, state, update_fn):
         if size() > 1:
-            allreduce_(grad, average=True,
-                       name=f"grad.{index}")
+            # Aggregated updates pass lists (reference:
+            # horovod/mxnet/__init__.py _do_allreduce list branch).
+            if isinstance(index, (tuple, list)):
+                for i, g in zip(index, grad):
+                    allreduce_(g, average=True, name=f"grad.{i}")
+            else:
+                allreduce_(grad, average=True, name=f"grad.{index}")
         update_fn(index, weight, grad, state)
 
     def update(self, index, weight, grad, state):
@@ -109,6 +114,11 @@ class DistributedTrainer:
 
     def __new__(cls, params, optimizer, optimizer_params=None):
         mx = _require_mx()
+        if isinstance(optimizer, DistributedOptimizer):
+            # Unwrap: the trainer already averages in _allreduce_grads;
+            # a wrapped optimizer would reduce twice (reference:
+            # horovod/mxnet/__init__.py:81-84).
+            optimizer = optimizer._opt
 
         class _Trainer(mx.gluon.Trainer):
             def __init__(self, params, optimizer, optimizer_params):

@@ -31,11 +31,24 @@ _SO_PATH = os.path.join(_NATIVE_DIR, "libhvdtpu.so")
 def _build() -> bool:
     if not os.path.isdir(_NATIVE_DIR):
         return False
+    # Multiple local ranks may race the first build. Serialize with an
+    # flock'd lockfile and have make produce the .so atomically enough
+    # (each rank re-checks existence under the lock before building).
+    lock_path = os.path.join(_NATIVE_DIR, ".build.lock")
     try:
-        subprocess.run(["make", "-C", _NATIVE_DIR, "-s"],
-                       check=True, capture_output=True, timeout=120)
-        return os.path.exists(_SO_PATH)
-    except (subprocess.SubprocessError, FileNotFoundError) as e:
+        import fcntl
+        with open(lock_path, "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            if os.path.exists(_SO_PATH):
+                return True
+            tmp_target = f"libhvdtpu.build{os.getpid()}.so"
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR, "-s",
+                 f"TARGET={tmp_target}"],
+                check=True, capture_output=True, timeout=120)
+            os.replace(os.path.join(_NATIVE_DIR, tmp_target), _SO_PATH)
+            return True
+    except (subprocess.SubprocessError, FileNotFoundError, OSError) as e:
         hlog.debug(f"native build failed: {e}")
         return False
 
@@ -83,7 +96,10 @@ def get() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if os.environ.get("HOROVOD_NATIVE", "1") == "0":
+        # Two spellings for compatibility: HOROVOD_NATIVE (docs) and
+        # HOROVOD_TPU_NATIVE (Config.native_core, common/config.py:140).
+        if os.environ.get("HOROVOD_NATIVE", "1") == "0" or \
+                os.environ.get("HOROVOD_TPU_NATIVE", "1") in ("0", "false"):
             return None
         if not os.path.exists(_SO_PATH) and not _build():
             hlog.debug("native core unavailable; using Python paths")

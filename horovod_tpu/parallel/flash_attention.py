@@ -1,0 +1,266 @@
+"""Pallas flash attention — the TPU kernel for the attention hot op.
+
+No reference analog (the reference has no model compute at all); this
+is the pallas-native realization of blockwise attention so the
+flagship Transformer keeps the MXU busy instead of materializing
+O(S²) logits in HBM.
+
+Kernel shape (the canonical TPU flash structure):
+- 3D grid (batch*heads, q blocks, kv blocks); the kv-block dimension
+  is innermost, so each program sees one [BLOCK_Q, D] query tile and
+  one [BLOCK_K, D] kv tile in VMEM — kv streams through, nothing
+  holds the whole sequence on-chip;
+- running max / normalizer / accumulator live in fp32 VMEM scratch,
+  initialized at kv step 0 and flushed to HBM at the last kv step;
+- causal block-skip: kv tiles entirely in the future are predicated
+  off with `pl.when`, saving ~half the FLOPs of causal attention;
+- `offsets` is a runtime int32[2] (scalar-prefetch, SMEM): the global
+  positions of q[0] and k[0]. Ring attention passes traced offsets for
+  its rotated kv blocks — no retrace per ring step.
+
+``flash_attention``: differentiable (custom VJP; backward recomputes
+through the dense formulation — flash backward's usual trade of FLOPs
+for memory holds only for the forward; a pallas backward kernel is
+future work, so training peak memory is still O(S²) in the backward).
+``flash_attention_stats``: forward-only variant also returning the
+(m, l) softmax statistics, which ring attention merges across shards
+(horovod_tpu/parallel/ring_attention.py).
+
+Falls back to interpreter mode off-TPU (tests run it on CPU with tiny
+shapes) and to the dense implementation when shapes don't meet block
+constraints.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+            m_scr, l_scr, acc_scr, *, block_q: int, block_k: int,
+            num_k: int, causal: bool, scale: float):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_start = offs_ref[0] + qi * block_q
+    k_start = offs_ref[1] + j * block_k
+    # Causal block-skip: the whole kv tile is in the future of the
+    # whole q tile -> nothing to do.
+    visible = jnp.logical_or(
+        jnp.logical_not(causal),
+        k_start <= q_start + block_q - 1)
+
+    @pl.when(visible)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [BQ, BK]
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            allowed = q_pos >= k_pos
+            s = jnp.where(allowed, s, _NEG_INF)
+        m_prev = m_scr[:]
+        block_max = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, block_max)
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(allowed, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_k - 1)
+    def _():
+        l = l_scr[:]
+        denom = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+        m_ref[0] = m_scr[:].reshape(m_ref.shape[1:])
+        l_ref[0] = l.reshape(l_ref.shape[1:])
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
+def _flash_bhsd(q, k, v, offsets, causal: bool, block_q: int,
+                block_k: int, interpret: bool):
+    """q: [BH, Sq, D]; k, v: [BH, Sk, D]; offsets: int32[2].
+    Returns (o [BH,Sq,D], m [BH,Sq], l [BH,Sq])."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, seq_q, d = q.shape
+    seq_k = k.shape[1]
+    scale = 1.0 / (d ** 0.5)
+    num_k = seq_k // block_k
+
+    kernel = functools.partial(
+        _kernel, block_q=block_q, block_k=block_k, num_k=num_k,
+        causal=causal, scale=scale)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh, seq_q // block_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j, offs: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j, offs: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j, offs: (b, j, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda b, i, j, offs: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j, offs: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, j, offs: (b, i)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, seq_q), jnp.float32),
+            jax.ShapeDtypeStruct((bh, seq_q), jnp.float32),
+        ),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bh * seq_q * seq_k * d // (2 if causal else 1),
+            bytes_accessed=(2 * q.size + k.size + v.size)
+            * q.dtype.itemsize,
+            transcendentals=bh * seq_q * seq_k,
+        ),
+    )(offsets, q, k, v)
+
+
+def _dense_reference(q, k, v, causal: bool, q_offset, k_offset):
+    """Mathematically identical dense formulation (fp32 softmax) — the
+    differentiation target for the custom VJP and the shape-fallback."""
+    d = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    logits = logits / jnp.sqrt(jnp.float32(d))
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = k_offset + jnp.arange(k.shape[1])
+        allowed = q_pos[:, None] >= k_pos[None, :]
+        logits = jnp.where(allowed[None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if causal:
+        probs = jnp.where(allowed[None, None], probs, 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
+
+
+def _shapes_ok(seq_q, seq_k, block_q, block_k):
+    return seq_q % block_q == 0 and seq_k % block_k == 0
+
+
+def _run(q, k, v, offsets, causal, block_q, block_k, interpret):
+    b, seq_q, h, d = q.shape
+
+    def to_bhsd(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    o, m, l = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), offsets,
+                          causal, block_q, block_k, bool(interpret))
+    o = o.reshape(b, h, seq_q, d).transpose(0, 2, 1, 3)
+    m = m.reshape(b, h, seq_q)
+    l = l.reshape(b, h, seq_q)
+    return o, m, l
+
+
+def flash_attention_stats(q, k, v, causal: bool = True,
+                          q_offset=0, k_offset=0,
+                          block_q: int = 128, block_k: int = 128,
+                          interpret: Optional[bool] = None
+                          ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Forward-only flash attention that also returns the softmax
+    statistics: (o [B,Sq,H,D], m [B,H,Sq] running max, l [B,H,Sq]
+    normalizer). Ring attention merges these across rotated kv shards.
+    Offsets may be traced values (one compilation serves every ring
+    step)."""
+    seq_q, seq_k = q.shape[1], k.shape[1]
+    block_q = min(block_q, seq_q)
+    block_k = min(block_k, seq_k)
+    if not _shapes_ok(seq_q, seq_k, block_q, block_k):
+        raise ValueError(
+            f"sequence lengths ({seq_q}, {seq_k}) must be divisible by "
+            f"blocks ({block_q}, {block_k})")
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                         jnp.asarray(k_offset, jnp.int32)])
+    return _run(q, k, v, offsets, causal, block_q, block_k, interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, offsets, causal, block_q, block_k, interpret):
+    return _run(q, k, v, offsets, causal, block_q, block_k, interpret)[0]
+
+
+def _flash_fwd(q, k, v, offsets, causal, block_q, block_k, interpret):
+    out = _flash(q, k, v, offsets, causal, block_q, block_k, interpret)
+    return out, (q, k, v, offsets)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
+    import numpy as np
+    q, k, v, offsets = residuals
+    _, vjp = jax.vjp(
+        lambda q, k, v: _dense_reference(q, k, v, causal, offsets[0],
+                                         offsets[1]), q, k, v)
+    dq, dk, dv = vjp(g)
+    d_offsets = np.zeros(offsets.shape, jax.dtypes.float0)
+    return dq, dk, dv, d_offsets
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    q_offset=0, k_offset=0,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Blockwise-softmax attention. q, k, v: [B, S, H, D] (the module
+    layout of models/transformer.py); returns [B, Sq, H, D] in q.dtype.
+
+    ``q_offset``/``k_offset`` (python ints or traced scalars) are the
+    global positions of element 0, shifting the causal mask — ring
+    attention's rotated kv blocks use this."""
+    seq_q, seq_k = q.shape[1], k.shape[1]
+    bq = min(block_q, seq_q)
+    bk = min(block_k, seq_k)
+    if not _shapes_ok(seq_q, seq_k, bq, bk):
+        if not causal:
+            raise ValueError("non-causal path requires block-divisible "
+                             "sequence lengths")
+        return _dense_reference(q, k, v, causal, q_offset, k_offset)
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                         jnp.asarray(k_offset, jnp.int32)])
+    return _flash(q, k, v, offsets, bool(causal), bq, bk,
+                  bool(interpret))

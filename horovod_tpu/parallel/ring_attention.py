@@ -17,6 +17,7 @@ result is bit-for-bit the same math as single-device causal attention
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 from typing import Optional
 
@@ -53,14 +54,10 @@ def _block_attend(q, k, v, q_pos, k_pos, o, m, l, causal):
     return o_new, m_new, l_new
 
 
-def ring_attention(q, k, v, causal: bool = True,
-                   axis: str = "seq"):
-    """Sequence-parallel causal attention. Call inside ``shard_map``
-    with the sequence dimension sharded over ``axis``.
-
-    q, k, v: [B, S_local, H, D] — this device's sequence shard.
-    Returns [B, S_local, H, D] in q.dtype.
-    """
+def _ring_einsum(q, k, v, causal: bool, axis: str):
+    """Reference ring implementation: jax-level blockwise online
+    softmax. Exact; also the differentiation target for the flash
+    path's custom VJP."""
     p = jax.lax.axis_size(axis)
     idx = jax.lax.axis_index(axis)
     b, s_local, h, d = q.shape
@@ -90,6 +87,96 @@ def ring_attention(q, k, v, causal: bool = True,
             0, p, step, (k, v, o, m, l))
     denom = jnp.where(l == 0.0, 1.0, l).transpose(0, 2, 1)[..., None]
     return (o / denom).astype(q.dtype)
+
+
+def _ring_flash_fwd_impl(q, k, v, axis: str, block: int):
+    """Ring forward where each local block runs the pallas flash
+    kernel (flash_attention_stats) and the per-shard (o, m, l) softmax
+    statistics are merged across ring steps. kv rotation and merge
+    live at the jax level (ppermute on ICI); the O(S_local²) inner
+    work never leaves VMEM."""
+    from horovod_tpu.parallel.flash_attention import flash_attention_stats
+
+    p = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    b, s_local, h, d = q.shape
+    q_off = idx * s_local
+
+    o_num = jnp.zeros((b, s_local, h, d), jnp.float32)
+    m_run = jnp.full((b, h, s_local), -1e30, jnp.float32)
+    l_run = jnp.zeros((b, h, s_local), jnp.float32)
+
+    perm = [(i, (i - 1) % p) for i in range(p)]
+
+    def step(t, carry):
+        k_t, v_t, o_num, m_run, l_run = carry
+        src = (idx + t) % p
+        o_i, m_i, l_i = flash_attention_stats(
+            q, k_t, v_t, causal=True, q_offset=q_off,
+            k_offset=src * s_local, block_q=block, block_k=block)
+        m_new = jnp.maximum(m_run, m_i)
+        a = jnp.exp(m_run - m_new)
+        c = jnp.exp(m_i - m_new)
+        w = (l_i * c).transpose(0, 2, 1)[..., None]     # [B,S,H,1]
+        o_num = o_num * a.transpose(0, 2, 1)[..., None] \
+            + o_i.astype(jnp.float32) * w
+        l_run = l_run * a + l_i * c
+        k_n = jax.lax.ppermute(k_t, axis, perm)
+        v_n = jax.lax.ppermute(v_t, axis, perm)
+        return k_n, v_n, o_num, m_new, l_run
+
+    if p == 1:
+        _, _, o_num, m_run, l_run = step(0, (k, v, o_num, m_run, l_run))
+    else:
+        _, _, o_num, m_run, l_run = jax.lax.fori_loop(
+            0, p, step, (k, v, o_num, m_run, l_run))
+    denom = jnp.where(l_run == 0.0, 1.0,
+                      l_run).transpose(0, 2, 1)[..., None]
+    return (o_num / denom).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ring_flash(q, k, v, axis, block):
+    return _ring_flash_fwd_impl(q, k, v, axis, block)
+
+
+def _ring_flash_fwd(q, k, v, axis, block):
+    return _ring_flash(q, k, v, axis, block), (q, k, v)
+
+
+def _ring_flash_bwd(axis, block, residuals, g):
+    # Backward recomputes through the einsum ring (exact same math);
+    # its vjp transposes the ppermutes correctly.
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q, k, v: _ring_einsum(q, k, v, True, axis), q, k, v)
+    return vjp(g)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_attention(q, k, v, causal: bool = True, axis: str = "seq",
+                   use_flash: Optional[bool] = None):
+    """Sequence-parallel causal attention. Call inside ``shard_map``
+    with the sequence dimension sharded over ``axis``.
+
+    q, k, v: [B, S_local, H, D] — this device's sequence shard.
+    Returns [B, S_local, H, D] in q.dtype.
+
+    ``use_flash`` (default: auto — on TPU with block-divisible local
+    sequences) runs each per-shard block through the pallas flash
+    kernel and merges softmax statistics across ring steps; gradients
+    flow through a custom VJP that recomputes via the jax-level ring.
+    """
+    s_local = q.shape[1]
+    block = min(128, s_local)
+    if use_flash is None:
+        use_flash = (causal and s_local % block == 0
+                     and jax.default_backend() in ("tpu", "axon"))
+    if use_flash:
+        return _ring_flash(q, k, v, axis, block)
+    return _ring_einsum(q, k, v, causal, axis)
 
 
 def make_ring_attention(mesh, data_axis: str = "data",

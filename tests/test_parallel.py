@@ -132,3 +132,109 @@ def test_sp_matches_dense_attention_loss():
     _, l0 = dense.train_step(s0, batch)
     _, l1 = ringy.train_step(s1, batch)
     np.testing.assert_allclose(float(l0), float(l1), rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# pallas flash attention (interpret mode on CPU)
+# ---------------------------------------------------------------------------
+
+def test_flash_attention_matches_dense():
+    from horovod_tpu.parallel.flash_attention import flash_attention
+    rng = np.random.RandomState(3)
+    b, s, h, d = 2, 128, 2, 32
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32,
+                          interpret=True)
+    ref = causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5)
+
+
+def test_flash_attention_offsets_match_ring_semantics():
+    """With q_offset/k_offset the kernel must reproduce the masked
+    cross-block attention ring attention needs: a kv block entirely in
+    the past attends fully; entirely in the future contributes zero."""
+    from horovod_tpu.parallel.flash_attention import flash_attention
+    rng = np.random.RandomState(4)
+    b, s, h, d = 1, 64, 1, 16
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+
+    # q block at global [64,128), kv block at [0,64): fully visible
+    out = flash_attention(q, k, v, causal=True, q_offset=64, k_offset=0,
+                          block_q=32, block_k=32, interpret=True)
+    # equivalent dense: no mask at all (all k_pos < q_pos)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    probs = jax.nn.softmax(logits, axis=-1)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5)
+
+    # kv block fully in the future: all masked -> zeros (guarded denom)
+    out = flash_attention(q, k, v, causal=True, q_offset=0, k_offset=64,
+                          block_q=32, block_k=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-6)
+
+
+def test_flash_attention_indivisible_falls_back():
+    from horovod_tpu.parallel.flash_attention import flash_attention
+    rng = np.random.RandomState(5)
+    b, s, h, d = 1, 50, 1, 8  # 50 not divisible by any pow2 block
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32,
+                          interpret=True)
+    ref = causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5)
+
+
+def test_ring_attention_flash_path_matches_dense():
+    """Forced flash path (pallas interpret on CPU): forward and grad
+    must match dense causal attention exactly."""
+    from functools import partial
+    mesh = spmd.create_mesh({"seq": 4}, devices=jax.devices()[:4])
+    b, s, h, d = 1, 64, 2, 16
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    f = jax.jit(jax.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis="seq",
+                                       use_flash=True),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"), check_vma=False))
+    np.testing.assert_allclose(np.asarray(f(q, k, v)),
+                               np.asarray(causal_attention(q, k, v)),
+                               atol=2e-5)
+    g1 = jax.grad(lambda q, k, v: (f(q, k, v) ** 2).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(
+        lambda q, k, v: (causal_attention(q, k, v) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=1e-4)
+
+
+def test_flash_attention_stats_values():
+    from horovod_tpu.parallel.flash_attention import flash_attention_stats
+    rng = np.random.RandomState(8)
+    b, s, h, d = 1, 64, 1, 8
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    o, m, l = flash_attention_stats(q, k, v, causal=True, block_q=32,
+                                    block_k=32, interpret=True)
+    logits = np.einsum("bqhd,bkhd->bhqk", np.asarray(q),
+                       np.asarray(k)) / np.sqrt(d)
+    mask = np.tril(np.ones((s, s), bool))
+    logits = np.where(mask[None, None], logits, -1e30)
+    m_ref = logits.max(-1)
+    l_ref = np.exp(logits - m_ref[..., None]).sum(-1)
+    np.testing.assert_allclose(np.asarray(m), m_ref, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(l), l_ref, rtol=1e-5)
