@@ -76,7 +76,7 @@ def init(comm=None, config: Optional[Config] = None) -> None:
                                    start_timeout=cfg.start_timeout)
 
         backends = [
-            XlaMeshBackend(controller),
+            XlaMeshBackend(controller, config=cfg),
             SocketBackend(controller),
             LocalBackend(lambda: controller.size),
         ]

@@ -42,10 +42,12 @@ _AXIS = "hvd_proc"
 class XlaMeshBackend(CollectiveBackend):
     name = "xla_mesh"
 
-    def __init__(self, controller):
+    def __init__(self, controller, config=None):
         self._ctl = controller
+        self._config = config
         self._lock = threading.Lock()
         self._mesh = None
+        self._mesh2d = None   # (cross, local) factored mesh, see below
         self._my_device = None
         self._cache: Dict[Tuple, object] = {}
         self._available = None
@@ -90,10 +92,39 @@ class XlaMeshBackend(CollectiveBackend):
                     for p in sorted(by_proc)]
             self._mesh = Mesh(np.array(reps), (_AXIS,))
             self._my_device = reps[jax.process_index()]
+            self._maybe_build_hierarchical_mesh(reps)
             return True
         except Exception as e:  # jax missing / not distributed
             hlog.debug(f"XLA mesh backend unavailable: {e}")
             return False
+
+    def _maybe_build_hierarchical_mesh(self, reps) -> None:
+        """HOROVOD_HIERARCHICAL_ALLREDUCE: factor the flat proc mesh
+        into (cross, local) axes so psum decomposes into an intra-host
+        reduction riding ICI and a cross-host stage riding DCN — the
+        XLA rendering of NCCLHierarchicalAllreduce's reduce-scatter →
+        cross allreduce → allgather (reference:
+        horovod/common/ops/nccl_operations.cc:167-372). Only the
+        reduction ops use this mesh; rank-ordered ops (allgather,
+        alltoall, broadcast roots) stay on the flat mesh where slot r
+        is unambiguously rank r."""
+        from jax.sharding import Mesh
+        cfg = self._config
+        topo = self._ctl.topology
+        if cfg is None or topo is None or \
+                not getattr(cfg, "hierarchical_allreduce", False):
+            return
+        if not topo.is_homogeneous or topo.local_size <= 1:
+            return
+        # Requires the launcher's contiguous per-host rank layout
+        # (rank == cross_rank * local_size + local_rank).
+        if topo.rank != topo.cross_rank * topo.local_size + \
+                topo.local_rank:
+            hlog.warning("hierarchical allreduce disabled: ranks are "
+                         "not grouped contiguously per host")
+            return
+        grid = np.array(reps).reshape(topo.cross_size, topo.local_size)
+        self._mesh2d = Mesh(grid, ("cross", "local"))
 
     def _ensure_mesh(self) -> bool:
         if self._available is not None:
@@ -125,16 +156,16 @@ class XlaMeshBackend(CollectiveBackend):
         return self._ensure_mesh()
 
     # ------------------------------------------------------------------
-    def _global_input(self, flat):
+    def _global_input(self, flat, mesh=None, axes=_AXIS):
         """Wrap this process's flat buffer as one shard of a global array
-        over the proc axis."""
+        over the proc axis (or the factored (cross, local) axes)."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
         size = self._size_fn()
         local = jax.device_put(flat, self._my_device)
         return jax.make_array_from_single_device_arrays(
             (size * flat.shape[0],) + flat.shape[1:],
-            NamedSharding(self._mesh, P(_AXIS)), [local])
+            NamedSharding(mesh or self._mesh, P(axes)), [local])
 
     def _compiled(self, key, builder):
         with self._lock:
@@ -144,19 +175,25 @@ class XlaMeshBackend(CollectiveBackend):
                 self._cache[key] = fn
         return fn
 
-    def _run_shard_op(self, kind: str, flat, out_specs, body, extra=()):
+    def _run_shard_op(self, kind: str, flat, out_specs, body, extra=(),
+                      mesh=None, axes=_AXIS):
         """jit(shard_map(body)) over the proc mesh, one shard per rank."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
-        key = (kind, flat.shape, str(flat.dtype), extra)
+        mesh = mesh or self._mesh
+        key = (kind, flat.shape, str(flat.dtype), extra, axes)
 
         def build():
-            m = jax.shard_map(body, mesh=self._mesh,
-                              in_specs=P(_AXIS), out_specs=out_specs)
+            # check_vma off: the replication checker can't statically
+            # infer all_gather/psum results are replicated; semantics
+            # are guaranteed by the collective itself.
+            m = jax.shard_map(body, mesh=mesh,
+                              in_specs=P(axes), out_specs=out_specs,
+                              check_vma=False)
             return jax.jit(m)
 
         fn = self._compiled(key, build)
-        garr = self._global_input(flat)
+        garr = self._global_input(flat, mesh=mesh, axes=axes)
         out = fn(garr)
         return out
 
@@ -172,17 +209,24 @@ class XlaMeshBackend(CollectiveBackend):
         flat = (jnp.concatenate([jnp.ravel(a) for a in arrays])
                 if len(arrays) > 1 else jnp.ravel(arrays[0]))
         pre, post = response.prescale_factor, response.postscale_factor
+        # Factored (cross, local) psum when hierarchical allreduce is
+        # on: XLA emits the intra-host stage on ICI and the cross-host
+        # stage on DCN.
+        if self._mesh2d is not None:
+            mesh, axes = self._mesh2d, ("cross", "local")
+        else:
+            mesh, axes = self._mesh, _AXIS
 
         def body(x):
             if pre != 1.0:
                 x = x * jnp.asarray(pre, x.dtype)
-            y = jax.lax.psum(x, _AXIS)
+            y = jax.lax.psum(x, axes)
             if post != 1.0:
                 y = y * jnp.asarray(post, y.dtype)
             return y
 
         out = self._run_shard_op("allreduce", flat, P(), body,
-                                 extra=(pre, post))
+                                 extra=(pre, post), mesh=mesh, axes=axes)
         fused = out.addressable_data(0)
         offset = 0
         for e, a, n in zip(entries, arrays, sizes):

@@ -1,0 +1,7 @@
+"""Utilities: checkpoint/resume, seeded data sharding."""
+
+from horovod_tpu.utils.checkpoint import (
+    save_checkpoint, restore_checkpoint, latest_checkpoint,
+)
+
+__all__ = ["save_checkpoint", "restore_checkpoint", "latest_checkpoint"]

@@ -1,0 +1,117 @@
+"""Checkpoint / resume.
+
+The reference has no checkpoint subsystem; its supported pattern is
+"framework checkpoint on rank 0 + state broadcast at start"
+(SURVEY §5; reference: horovod/torch/__init__.py:200-348
+broadcast_parameters/broadcast_optimizer_state,
+examples/tensorflow_mnist.py rank-0 checkpoint_dir). This module makes
+that pattern first-class: rank 0 persists the pytree (orbax when
+available, msgpack via flax otherwise), every rank restores through a
+broadcast so the world starts bit-identical.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Optional
+
+from horovod_tpu.common import basics
+from horovod_tpu.common import logging as hlog
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _save_tree(path: str, tree: Any) -> None:
+    try:
+        import orbax.checkpoint as ocp
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(path, tree, force=True)
+        return
+    except ImportError:
+        pass
+    from flax import serialization
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(serialization.to_bytes(tree))
+
+
+def _load_tree(path: str, target: Optional[Any]) -> Any:
+    if os.path.isdir(path):
+        import orbax.checkpoint as ocp
+        ckptr = ocp.PyTreeCheckpointer()
+        return ckptr.restore(path, item=target)
+    from flax import serialization
+    with open(path, "rb") as f:
+        data = f.read()
+    if target is None:
+        return serialization.msgpack_restore(data)
+    return serialization.from_bytes(target, data)
+
+
+def save_checkpoint(directory: str, state: Any, step: int,
+                    keep: int = 3) -> Optional[str]:
+    """Rank 0 writes ``state`` under ``directory/step_<step>``; other
+    ranks no-op (reference pattern: checkpoint only on rank 0 —
+    examples/keras_imagenet_resnet50.py callbacks gating). Returns the
+    checkpoint path on rank 0, None elsewhere. Prunes to the newest
+    ``keep`` checkpoints."""
+    if basics.rank() != 0:
+        return None
+    path = os.path.join(directory, f"step_{step}")
+    _save_tree(path, state)
+    steps = sorted(
+        (int(m.group(1)) for m in
+         (_STEP_RE.match(d) for d in os.listdir(directory)) if m),
+        reverse=True)
+    for old in steps[keep:]:
+        old_path = os.path.join(directory, f"step_{old}")
+        try:
+            import shutil
+            if os.path.isdir(old_path):
+                shutil.rmtree(old_path)
+            else:
+                os.remove(old_path)
+        except OSError as e:
+            hlog.warning(f"could not prune checkpoint {old_path}: {e}")
+    return path
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    if not os.path.isdir(directory):
+        return None
+    steps = sorted(
+        (int(m.group(1)) for m in
+         (_STEP_RE.match(d) for d in os.listdir(directory)) if m))
+    if not steps:
+        return None
+    return os.path.join(directory, f"step_{steps[-1]}")
+
+
+def restore_checkpoint(directory_or_path: str,
+                       target: Optional[Any] = None,
+                       broadcast: bool = True) -> Any:
+    """Restore the newest checkpoint. With ``broadcast`` (default),
+    only rank 0 reads the storage and the tree is broadcast to every
+    rank — the reference's resume contract
+    (reference: BroadcastGlobalVariablesHook,
+    horovod/tensorflow/__init__.py:117-148) — so shared filesystems
+    aren't required on workers."""
+    path = directory_or_path
+    if os.path.isdir(path) and latest_checkpoint(path) and \
+            not _STEP_RE.match(os.path.basename(path)):
+        path = latest_checkpoint(path)
+
+    if not broadcast or basics.size() <= 1:
+        return _load_tree(path, target)
+
+    from horovod_tpu.jax import broadcast_parameters
+    if basics.rank() == 0:
+        tree = _load_tree(path, target)
+    else:
+        if target is None:
+            raise ValueError(
+                "restore_checkpoint(broadcast=True) on non-root ranks "
+                "needs ``target`` to know the tree structure")
+        tree = target
+    return broadcast_parameters(tree, root_rank=0)

@@ -1,0 +1,252 @@
+// Wire codec — C++ implementation of the control-plane message
+// encoding (role-equivalent of the reference's FlatBuffers layer,
+// reference: horovod/common/wire/message.fbs + message.cc:122-215).
+//
+// The layout is defined in horovod_tpu/common/wire.py; this file
+// implements the identical encoding in C++ (parse into structs,
+// serialize back), byte-for-byte — tests/test_native.py proves
+// round-trip parity on randomized messages. The structs are the
+// C++ core's view of Request/Response for future in-core negotiation.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Request {
+  uint8_t request_type;
+  int32_t request_rank;
+  uint8_t tensor_type;
+  int32_t root_rank;
+  int32_t device;
+  std::string tensor_name;
+  double prescale;
+  double postscale;
+  std::vector<int64_t> shape;
+};
+
+struct RequestList {
+  bool shutdown;
+  std::vector<Request> requests;
+};
+
+struct Response {
+  uint8_t response_type;
+  std::string error_message;
+  double prescale;
+  double postscale;
+  std::vector<std::string> tensor_names;
+  std::vector<int32_t> devices;
+  std::vector<int64_t> tensor_sizes;
+};
+
+struct ResponseList {
+  bool shutdown;
+  double tuned_cycle_time_ms;
+  int64_t tuned_fusion_threshold_bytes;
+  std::vector<Response> responses;
+};
+
+class Reader {
+ public:
+  Reader(const uint8_t* p, int64_t n) : p_(p), n_(n) {}
+  bool ok() const { return ok_; }
+
+  uint8_t u8() {
+    if (!need(1)) return 0;
+    return p_[off_++];
+  }
+  uint32_t u32() {
+    if (!need(4)) return 0;
+    uint32_t v;
+    memcpy(&v, p_ + off_, 4);
+    off_ += 4;
+    return v;
+  }
+  int32_t i32() {
+    if (!need(4)) return 0;
+    int32_t v;
+    memcpy(&v, p_ + off_, 4);
+    off_ += 4;
+    return v;
+  }
+  int64_t i64() {
+    if (!need(8)) return 0;
+    int64_t v;
+    memcpy(&v, p_ + off_, 8);
+    off_ += 8;
+    return v;
+  }
+  double f64() {
+    if (!need(8)) return 0;
+    double v;
+    memcpy(&v, p_ + off_, 8);
+    off_ += 8;
+    return v;
+  }
+  std::string str() {
+    uint32_t n = u32();
+    if (!need(n)) return "";
+    std::string s(reinterpret_cast<const char*>(p_ + off_), n);
+    off_ += n;
+    return s;
+  }
+  bool done() const { return ok_ && off_ == n_; }
+
+ private:
+  bool need(int64_t k) {
+    if (!ok_ || off_ + k > n_) {
+      ok_ = false;
+      return false;
+    }
+    return true;
+  }
+  const uint8_t* p_;
+  int64_t n_;
+  int64_t off_ = 0;
+  bool ok_ = true;
+};
+
+class Writer {
+ public:
+  void u8(uint8_t v) { buf_.push_back(v); }
+  void u32(uint32_t v) { raw(&v, 4); }
+  void i32(int32_t v) { raw(&v, 4); }
+  void i64(int64_t v) { raw(&v, 8); }
+  void f64(double v) { raw(&v, 8); }
+  void str(const std::string& s) {
+    u32(uint32_t(s.size()));
+    buf_.insert(buf_.end(), s.begin(), s.end());
+  }
+  uint8_t* release(int64_t* out_len) {
+    auto* out = static_cast<uint8_t*>(malloc(buf_.size() ? buf_.size() : 1));
+    if (out) memcpy(out, buf_.data(), buf_.size());
+    *out_len = int64_t(buf_.size());
+    return out;
+  }
+
+ private:
+  void raw(const void* p, size_t k) {
+    const uint8_t* b = static_cast<const uint8_t*>(p);
+    buf_.insert(buf_.end(), b, b + k);
+  }
+  std::vector<uint8_t> buf_;
+};
+
+bool parse_request(Reader& r, Request* req) {
+  req->request_type = r.u8();
+  req->request_rank = r.i32();
+  req->tensor_type = r.u8();
+  req->root_rank = r.i32();
+  req->device = r.i32();
+  req->tensor_name = r.str();
+  req->prescale = r.f64();
+  req->postscale = r.f64();
+  uint8_t ndim = r.u8();
+  req->shape.clear();
+  for (int i = 0; i < ndim; i++) req->shape.push_back(r.i64());
+  return r.ok();
+}
+
+void write_request(Writer& w, const Request& req) {
+  w.u8(req.request_type);
+  w.i32(req.request_rank);
+  w.u8(req.tensor_type);
+  w.i32(req.root_rank);
+  w.i32(req.device);
+  w.str(req.tensor_name);
+  w.f64(req.prescale);
+  w.f64(req.postscale);
+  w.u8(uint8_t(req.shape.size()));
+  for (int64_t d : req.shape) w.i64(d);
+}
+
+bool parse_response(Reader& r, Response* resp) {
+  resp->response_type = r.u8();
+  resp->error_message = r.str();
+  resp->prescale = r.f64();
+  resp->postscale = r.f64();
+  uint32_t n = r.u32();
+  resp->tensor_names.clear();
+  for (uint32_t i = 0; r.ok() && i < n; i++)
+    resp->tensor_names.push_back(r.str());
+  n = r.u32();
+  resp->devices.clear();
+  for (uint32_t i = 0; r.ok() && i < n; i++)
+    resp->devices.push_back(r.i32());
+  n = r.u32();
+  resp->tensor_sizes.clear();
+  for (uint32_t i = 0; r.ok() && i < n; i++)
+    resp->tensor_sizes.push_back(r.i64());
+  return r.ok();
+}
+
+void write_response(Writer& w, const Response& resp) {
+  w.u8(resp.response_type);
+  w.str(resp.error_message);
+  w.f64(resp.prescale);
+  w.f64(resp.postscale);
+  w.u32(uint32_t(resp.tensor_names.size()));
+  for (const auto& s : resp.tensor_names) w.str(s);
+  w.u32(uint32_t(resp.devices.size()));
+  for (int32_t d : resp.devices) w.i32(d);
+  w.u32(uint32_t(resp.tensor_sizes.size()));
+  for (int64_t s : resp.tensor_sizes) w.i64(s);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse and re-serialize a RequestList; byte-identical output proves
+// the C++ structs capture the full encoding. Returns 0 on success;
+// -1 on malformed input (including trailing bytes). Caller frees
+// *out with hvd_free.
+int hvd_wire_reencode_request_list(const uint8_t* in, int64_t len,
+                                   uint8_t** out, int64_t* out_len) {
+  Reader r(in, len);
+  RequestList rl;
+  rl.shutdown = r.u8() != 0;
+  uint32_t n = r.u32();
+  for (uint32_t i = 0; r.ok() && i < n; i++) {
+    Request req;
+    if (!parse_request(r, &req)) return -1;
+    rl.requests.push_back(std::move(req));
+  }
+  if (!r.done()) return -1;
+  Writer w;
+  w.u8(rl.shutdown ? 1 : 0);
+  w.u32(uint32_t(rl.requests.size()));
+  for (const auto& req : rl.requests) write_request(w, req);
+  *out = w.release(out_len);
+  return *out ? 0 : -2;
+}
+
+int hvd_wire_reencode_response_list(const uint8_t* in, int64_t len,
+                                    uint8_t** out, int64_t* out_len) {
+  Reader r(in, len);
+  ResponseList rl;
+  rl.shutdown = r.u8() != 0;
+  rl.tuned_cycle_time_ms = r.f64();
+  rl.tuned_fusion_threshold_bytes = r.i64();
+  uint32_t n = r.u32();
+  for (uint32_t i = 0; r.ok() && i < n; i++) {
+    Response resp;
+    if (!parse_response(r, &resp)) return -1;
+    rl.responses.push_back(std::move(resp));
+  }
+  if (!r.done()) return -1;
+  Writer w;
+  w.u8(rl.shutdown ? 1 : 0);
+  w.f64(rl.tuned_cycle_time_ms);
+  w.i64(rl.tuned_fusion_threshold_bytes);
+  w.u32(uint32_t(rl.responses.size()));
+  for (const auto& resp : rl.responses) write_response(w, resp);
+  *out = w.release(out_len);
+  return *out ? 0 : -2;
+}
+
+}  // extern "C"

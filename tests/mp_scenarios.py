@@ -295,6 +295,87 @@ def scenario_scalar_broadcast(hvd_mod, rank, size):
     assert float(np.asarray(out)) == 1.0
 
 
+def scenario_checkpoint_resume(hvd_mod, rank, size):
+    """rank-0 save + broadcast restore: every rank ends bit-identical
+    (reference resume contract: rank-0 checkpoint + state broadcast,
+    SURVEY section 5)."""
+    import tempfile, os
+    from horovod_tpu.utils import save_checkpoint, restore_checkpoint
+
+    tmp = os.environ["HVD_TEST_CKPT_DIR"]
+    state = {"w": np.full((4,), 7.5, np.float32) if rank == 0
+             else np.zeros((4,), np.float32),
+             "step": np.asarray(3, np.int64) if rank == 0
+             else np.asarray(0, np.int64)}
+    save_checkpoint(tmp, state, step=3)
+    hvd_mod.barrier(name="after-save")
+    target = {"w": np.zeros((4,), np.float32),
+              "step": np.asarray(0, np.int64)}
+    restored = restore_checkpoint(tmp, target=target, broadcast=True)
+    np.testing.assert_allclose(np.asarray(restored["w"]), 7.5)
+    assert int(np.asarray(restored["step"])) == 3
+
+
+def _init_jax_distributed(rank, size):
+    import os
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    port = int(os.environ["HOROVOD_CONTROLLER_PORT"]) + 1000
+    jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=size,
+                               process_id=rank)
+    return jax
+
+
+def scenario_xla_backend(hvd_mod, rank, size):
+    """Collectives on jax arrays in a REAL multi-process JAX world:
+    the XlaMeshBackend path (negotiation -> fused psum over the proc
+    mesh), not the socket fallback."""
+    jax = _init_jax_distributed(rank, size)
+    import jax.numpy as jnp
+
+    x = jnp.full((8,), float(rank + 1), jnp.float32)
+    out = hvd_mod.allreduce(x, average=False, name="xla.ar")
+    ssum = sum(range(1, size + 1))
+    assert hasattr(out, "devices"), "output should stay a jax array"
+    np.testing.assert_allclose(np.asarray(out), ssum)
+
+    # fused batch (several tensors in one cycle -> one compiled psum)
+    handles = [hvd_mod.allreduce_async(
+        jnp.full((4,), float(rank + 1) * (i + 1), jnp.float32),
+        average=False, name=f"xla.f/{i}") for i in range(8)]
+    for i, h in enumerate(handles):
+        np.testing.assert_allclose(
+            np.asarray(hvd_mod.synchronize(h)), ssum * (i + 1),
+            rtol=1e-6)
+
+    # broadcast with non-zero root + allgather
+    b = jnp.full((3,), float(rank), jnp.float32)
+    out = hvd_mod.broadcast(b, root_rank=1, name="xla.bc")
+    np.testing.assert_allclose(np.asarray(out), 1.0)
+    g = hvd_mod.allgather(
+        jnp.full((rank + 1, 2), float(rank), jnp.float32), name="xla.ag")
+    assert np.asarray(g).shape == (sum(range(1, size + 1)) + 0, 2) or         np.asarray(g).shape[0] == sum(r + 1 for r in range(size))
+
+
+def scenario_xla_hierarchical(hvd_mod, rank, size):
+    """HOROVOD_HIERARCHICAL_ALLREDUCE: allreduce rides the factored
+    (cross, local) mesh (all ranks share this host -> cross=1,
+    local=size; the factored-psum code path still executes)."""
+    jax = _init_jax_distributed(rank, size)
+    import jax.numpy as jnp
+    from horovod_tpu.common import basics as _b
+
+    x = jnp.full((6,), float(rank + 1), jnp.float32)
+    out = hvd_mod.allreduce(x, average=True, name="hier.ar")
+    np.testing.assert_allclose(np.asarray(out),
+                               sum(range(1, size + 1)) / size)
+    # the 2D mesh must actually have been built
+    rt = _b.runtime()
+    xla = [b for b in rt.op_manager._backends
+           if b.name == "xla_mesh"][0]
+    assert xla._mesh2d is not None, "hierarchical mesh not built"
+
+
 def main():
     scenario, rank, size, port = (sys.argv[1], int(sys.argv[2]),
                                   int(sys.argv[3]), int(sys.argv[4]))

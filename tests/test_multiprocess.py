@@ -130,3 +130,20 @@ def test_tf_distributed_gradient_tape():
 
 def test_scalar_broadcast():
     run_scenario("scalar_broadcast", 2)
+
+
+def test_checkpoint_resume(tmp_path_factory):
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmp:
+        run_scenario("checkpoint_resume", 2,
+                     extra_env={"HVD_TEST_CKPT_DIR": tmp})
+
+
+def test_xla_mesh_backend():
+    """Real multi-process JAX CPU world -> XlaMeshBackend data plane."""
+    run_scenario("xla_backend", 2, timeout=180.0)
+
+
+def test_xla_hierarchical_allreduce():
+    run_scenario("xla_hierarchical", 2, timeout=180.0,
+                 extra_env={"HOROVOD_HIERARCHICAL_ALLREDUCE": "1"})
