@@ -12,19 +12,32 @@ Kernel shape (the canonical TPU flash structure):
   holds the whole sequence on-chip;
 - running max / normalizer / accumulator live in fp32 VMEM scratch,
   initialized at kv step 0 and flushed to HBM at the last kv step;
+- softmax statistics are emitted as [BH, S, 1] arrays with
+  (1, BLOCK_Q, 1) blocks — both trailing block dims equal the array
+  dims, which satisfies the mosaic tiling rule without replicating
+  stats across 128 lanes;
 - causal block-skip: kv tiles entirely in the future are predicated
   off with `pl.when`, saving ~half the FLOPs of causal attention;
 - `offsets` is a runtime int32[2] (scalar-prefetch, SMEM): the global
   positions of q[0] and k[0]. Ring attention passes traced offsets for
   its rotated kv blocks — no retrace per ring step.
 
-``flash_attention``: differentiable (custom VJP; backward recomputes
-through the dense formulation — flash backward's usual trade of FLOPs
-for memory holds only for the forward; a pallas backward kernel is
-future work, so training peak memory is still O(S²) in the backward).
+Backward is a pair of pallas kernels (the FlashAttention-2 split):
+- dq kernel, grid (BH, q blocks, kv blocks): recomputes each p-block
+  from (q, k, lse), forms ds = p * (dp - delta) and accumulates
+  dq += ds @ k in fp32 scratch;
+- dk/dv kernel, grid (BH, kv blocks, q blocks): same recompute per
+  tile, accumulates dv += pᵀ @ do and dk += dsᵀ @ q.
+delta = rowsum(do · o) is precomputed once outside (one fused XLA
+pass, [BH, S, 1]); lse = m + log l comes from the forward's stats, so
+no O(S²) buffer exists anywhere in the backward.
+
+``flash_attention``: differentiable via the kernels above.
 ``flash_attention_stats``: forward-only variant also returning the
 (m, l) softmax statistics, which ring attention merges across shards
 (horovod_tpu/parallel/ring_attention.py).
+``flash_attention_bwd``: the raw backward entry ring attention calls
+per rotated kv shard with the globally-merged lse.
 
 Falls back to interpreter mode off-TPU (tests run it on CPU with tiny
 shapes) and to the dense implementation when shapes don't meet block
@@ -97,16 +110,15 @@ def _kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
         l = l_scr[:]
         denom = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
-        m_ref[0] = m_scr[:].reshape(m_ref.shape[1:])
-        l_ref[0] = l.reshape(l_ref.shape[1:])
-
+        m_ref[0] = m_scr[:]
+        l_ref[0] = l
 
 @functools.partial(
     jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
 def _flash_bhsd(q, k, v, offsets, causal: bool, block_q: int,
                 block_k: int, interpret: bool):
     """q: [BH, Sq, D]; k, v: [BH, Sk, D]; offsets: int32[2].
-    Returns (o [BH,Sq,D], m [BH,Sq], l [BH,Sq])."""
+    Returns (o [BH,Sq,D], m [BH,Sq,1], l [BH,Sq,1])."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -129,8 +141,8 @@ def _flash_bhsd(q, k, v, offsets, causal: bool, block_q: int,
         ],
         out_specs=(
             pl.BlockSpec((1, block_q, d), lambda b, i, j, offs: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j, offs: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j, offs: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j, offs: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j, offs: (b, i, 0)),
         ),
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -143,8 +155,8 @@ def _flash_bhsd(q, k, v, offsets, causal: bool, block_q: int,
         grid_spec=grid_spec,
         out_shape=(
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((bh, seq_q), jnp.float32),
-            jax.ShapeDtypeStruct((bh, seq_q), jnp.float32),
+            jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
         ),
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
@@ -156,9 +168,182 @@ def _flash_bhsd(q, k, v, offsets, causal: bool, block_q: int,
     )(offsets, q, k, v)
 
 
+def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    q_start, k_start, block_q: int, block_k: int,
+                    causal: bool, scale: float):
+    """Shared backward-tile recompute: p = exp(s - lse) and
+    ds = p · (dp − delta) · scale for one [BQ, BK] tile. The dq and
+    dk/dv kernels differ only in what they contract these with."""
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]                                  # [BQ, 1]
+    delta = delta_ref[0]                              # [BQ, 1]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale   # [BQ, BK]
+    if causal:
+        q_pos = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    # Dead rows (l == 0) store lse = +inf -> p underflows to 0.
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)           # [BQ, BK]
+    ds = p * (dp - delta) * scale
+    return q, k, do, p, ds
+
+
+def _bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, dq_scr, *, block_q: int,
+                   block_k: int, num_k: int, causal: bool, scale: float):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q_start = offs_ref[0] + qi * block_q
+    k_start = offs_ref[1] + j * block_k
+    visible = jnp.logical_or(
+        jnp.logical_not(causal),
+        k_start <= q_start + block_q - 1)
+
+    @pl.when(visible)
+    def _():
+        _, k, _, _, ds = _recompute_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            q_start, k_start, block_q, block_k, causal, scale)
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_k - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                    block_q: int, block_k: int, num_q: int,
+                    causal: bool, scale: float):
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)      # kv block (outer)
+    qi = pl.program_id(2)     # q block (inner, streams)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_start = offs_ref[0] + qi * block_q
+    k_start = offs_ref[1] + j * block_k
+    visible = jnp.logical_or(
+        jnp.logical_not(causal),
+        k_start <= q_start + block_q - 1)
+
+    @pl.when(visible)
+    def _():
+        q, _, do, p, ds = _recompute_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            q_start, k_start, block_q, block_k, causal, scale)
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [BK, D]
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [BK, D]
+
+    @pl.when(qi == num_q - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
+def _flash_bwd_bhsd(q, k, v, do, lse, delta, offsets, causal: bool,
+                    block_q: int, block_k: int, interpret: bool):
+    """Backward kernels. q, do: [BH,Sq,D]; k, v: [BH,Sk,D];
+    lse, delta: [BH,Sq,1] fp32. Returns (dq, dk, dv) in input dtypes."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, seq_q, d = q.shape
+    seq_k = k.shape[1]
+    scale = 1.0 / (d ** 0.5)
+    num_q = seq_q // block_q
+    num_k = seq_k // block_k
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j, offs: (b, i, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j, offs: (b, j, 0))
+    stat_spec = pl.BlockSpec((1, block_q, 1),
+                             lambda b, i, j, offs: (b, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, block_q=block_q, block_k=block_k,
+            num_k=num_k, causal=causal, scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, num_q, num_k),
+            in_specs=[q_spec, k_spec, k_spec, q_spec, stat_spec,
+                      stat_spec],
+            out_specs=q_spec,
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=6 * bh * seq_q * seq_k * d // (2 if causal else 1),
+            bytes_accessed=(2 * q.size + k.size + v.size)
+            * q.dtype.itemsize,
+            transcendentals=bh * seq_q * seq_k,
+        ),
+    )(offsets, q, k, v, do, lse, delta)
+
+    # dk/dv: swap grid so the kv block is outer and q streams.
+    q_spec2 = pl.BlockSpec((1, block_q, d), lambda b, j, i, offs: (b, i, 0))
+    k_spec2 = pl.BlockSpec((1, block_k, d), lambda b, j, i, offs: (b, j, 0))
+    stat_spec2 = pl.BlockSpec((1, block_q, 1),
+                              lambda b, j, i, offs: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+            num_q=num_q, causal=causal, scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, num_k, num_q),
+            in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, stat_spec2,
+                      stat_spec2],
+            out_specs=(k_spec2, k_spec2),
+            scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                            pltpu.VMEM((block_k, d), jnp.float32)],
+        ),
+        out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=10 * bh * seq_q * seq_k * d // (2 if causal else 1),
+            bytes_accessed=(q.size + 2 * (k.size + v.size))
+            * q.dtype.itemsize,
+            transcendentals=bh * seq_q * seq_k,
+        ),
+    )(offsets, q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
 def _dense_reference(q, k, v, causal: bool, q_offset, k_offset):
     """Mathematically identical dense formulation (fp32 softmax) — the
-    differentiation target for the custom VJP and the shape-fallback."""
+    shape fallback and the test oracle for the kernels."""
     d = q.shape[-1]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32)
@@ -178,17 +363,23 @@ def _shapes_ok(seq_q, seq_k, block_q, block_k):
     return seq_q % block_q == 0 and seq_k % block_k == 0
 
 
+def _to_bhsd(x):
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _from_bhsd(x, b, h):
+    bh, s, d = x.shape
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
 def _run(q, k, v, offsets, causal, block_q, block_k, interpret):
     b, seq_q, h, d = q.shape
-
-    def to_bhsd(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-
-    o, m, l = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), offsets,
+    o, m, l = _flash_bhsd(_to_bhsd(q), _to_bhsd(k), _to_bhsd(v), offsets,
                           causal, block_q, block_k, bool(interpret))
-    o = o.reshape(b, h, seq_q, d).transpose(0, 2, 1, 3)
-    m = m.reshape(b, h, seq_q)
-    l = l.reshape(b, h, seq_q)
+    o = _from_bhsd(o, b, h)
+    m = m[..., 0].reshape(b, h, seq_q)
+    l = l[..., 0].reshape(b, h, seq_q)
     return o, m, l
 
 
@@ -216,23 +407,66 @@ def flash_attention_stats(q, k, v, causal: bool = True,
     return _run(q, k, v, offsets, causal, block_q, block_k, interpret)
 
 
+def _lse_from_stats(m, l):
+    """[B,H,S] stats -> [BH,S,1] fp32 lse; +inf marks dead rows so the
+    backward's exp(s - lse) underflows to exactly 0 for them."""
+    b, h, s = m.shape
+    lse = jnp.where(l > 0.0, m + jnp.log(jnp.where(l > 0.0, l, 1.0)),
+                    jnp.inf)
+    return lse.reshape(b * h, s, 1)
+
+
+def flash_attention_bwd(q, k, v, o, m, l, do, causal: bool = True,
+                        q_offset=0, k_offset=0,
+                        block_q: int = 128, block_k: int = 128,
+                        interpret: Optional[bool] = None):
+    """Raw flash backward against externally-merged softmax stats.
+
+    q, k, v, o, do: [B,S,H,D]; m, l: [B,H,Sq] (as returned — or ring-
+    merged — from flash_attention_stats). Returns (dq, dk, dv) in the
+    input dtypes. Ring attention calls this once per rotated kv shard
+    with the *global* lse, which makes per-shard contributions sum to
+    the exact full-sequence gradient."""
+    b, seq_q, h, d = q.shape
+    seq_k = k.shape[1]
+    block_q = min(block_q, seq_q)
+    block_k = min(block_k, seq_k)
+    if not _shapes_ok(seq_q, seq_k, block_q, block_k):
+        raise ValueError(
+            f"sequence lengths ({seq_q}, {seq_k}) must be divisible by "
+            f"blocks ({block_q}, {block_k})")
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                         jnp.asarray(k_offset, jnp.int32)])
+    qb, kb, vb, dob, ob = (_to_bhsd(x) for x in (q, k, v, do, o))
+    lse = _lse_from_stats(m, l)
+    delta = jnp.sum(dob.astype(jnp.float32) * ob.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    dq, dk, dv = _flash_bwd_bhsd(qb, kb, vb, dob, lse, delta, offsets,
+                                 bool(causal), block_q, block_k,
+                                 bool(interpret))
+    return (_from_bhsd(dq, b, h), _from_bhsd(dk, b, h),
+            _from_bhsd(dv, b, h))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _flash(q, k, v, offsets, causal, block_q, block_k, interpret):
     return _run(q, k, v, offsets, causal, block_q, block_k, interpret)[0]
 
 
 def _flash_fwd(q, k, v, offsets, causal, block_q, block_k, interpret):
-    out = _flash(q, k, v, offsets, causal, block_q, block_k, interpret)
-    return out, (q, k, v, offsets)
+    o, m, l = _run(q, k, v, offsets, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, m, l, offsets)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
     import numpy as np
-    q, k, v, offsets = residuals
-    _, vjp = jax.vjp(
-        lambda q, k, v: _dense_reference(q, k, v, causal, offsets[0],
-                                         offsets[1]), q, k, v)
-    dq, dk, dv = vjp(g)
+    q, k, v, o, m, l, offsets = residuals
+    dq, dk, dv = flash_attention_bwd(
+        q, k, v, o, m, l, g, causal=causal,
+        q_offset=offsets[0], k_offset=offsets[1],
+        block_q=block_q, block_k=block_k, interpret=interpret)
     d_offsets = np.zeros(offsets.shape, jax.dtypes.float0)
     return dq, dk, dv, d_offsets
 

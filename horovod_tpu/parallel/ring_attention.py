@@ -89,12 +89,13 @@ def _ring_einsum(q, k, v, causal: bool, axis: str):
     return (o / denom).astype(q.dtype)
 
 
-def _ring_flash_fwd_impl(q, k, v, axis: str, block: int):
+def _ring_flash_fwd_impl(q, k, v, causal: bool, axis: str, block: int):
     """Ring forward where each local block runs the pallas flash
     kernel (flash_attention_stats) and the per-shard (o, m, l) softmax
     statistics are merged across ring steps. kv rotation and merge
     live at the jax level (ppermute on ICI); the O(S_local²) inner
-    work never leaves VMEM."""
+    work never leaves VMEM. Returns (o, m, l) — the merged global
+    stats are the backward's residuals."""
     from horovod_tpu.parallel.flash_attention import flash_attention_stats
 
     p = jax.lax.axis_size(axis)
@@ -112,7 +113,7 @@ def _ring_flash_fwd_impl(q, k, v, axis: str, block: int):
         k_t, v_t, o_num, m_run, l_run = carry
         src = (idx + t) % p
         o_i, m_i, l_i = flash_attention_stats(
-            q, k_t, v_t, causal=True, q_offset=q_off,
+            q, k_t, v_t, causal=causal, q_offset=q_off,
             k_offset=src * s_local, block_q=block, block_k=block)
         m_new = jnp.maximum(m_run, m_i)
         a = jnp.exp(m_run - m_new)
@@ -132,25 +133,75 @@ def _ring_flash_fwd_impl(q, k, v, axis: str, block: int):
             0, p, step, (k, v, o_num, m_run, l_run))
     denom = jnp.where(l_run == 0.0, 1.0,
                       l_run).transpose(0, 2, 1)[..., None]
-    return (o_num / denom).astype(q.dtype)
+    return (o_num / denom).astype(q.dtype), m_run, l_run
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _ring_flash(q, k, v, axis, block):
-    return _ring_flash_fwd_impl(q, k, v, axis, block)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(q, k, v, causal, axis, block):
+    return _ring_flash_fwd_impl(q, k, v, causal, axis, block)[0]
 
 
-def _ring_flash_fwd(q, k, v, axis, block):
-    return _ring_flash(q, k, v, axis, block), (q, k, v)
+def _ring_flash_fwd(q, k, v, causal, axis, block):
+    o, m, l = _ring_flash_fwd_impl(q, k, v, causal, axis, block)
+    return o, (q, k, v, o, m, l)
 
 
-def _ring_flash_bwd(axis, block, residuals, g):
-    # Backward recomputes through the einsum ring (exact same math);
-    # its vjp transposes the ppermutes correctly.
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q, k, v: _ring_einsum(q, k, v, True, axis), q, k, v)
-    return vjp(g)
+def _ring_flash_bwd(causal, axis, block, residuals, g):
+    """Ring backward on the pallas backward kernels: a second kv pass
+    where each rotated shard's (dk, dv) accumulators travel with it —
+    after p rotations they arrive back at the owning device. Per-shard
+    contributions use the globally-merged lse, so their sum is the
+    exact full-sequence gradient (same math as the dense backward, up
+    to fp32 accumulation order)."""
+    from horovod_tpu.parallel.flash_attention import (
+        _flash_bwd_bhsd, _lse_from_stats, _to_bhsd, _from_bhsd,
+    )
+
+    q, k, v, o, m, l = residuals
+    p = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    b, s_local, h, d = q.shape
+    q_off = idx * s_local
+    perm = [(i, (i - 1) % p) for i in range(p)]
+    interpret = jax.default_backend() not in ("tpu", "axon")
+
+    # Loop-invariant residual prep, done once: layout transposes of the
+    # local tensors, lse from the merged stats, delta = rowsum(do·o).
+    qb, gb, ob = _to_bhsd(q), _to_bhsd(g), _to_bhsd(o)
+    kb, vb = _to_bhsd(k), _to_bhsd(v)
+    lse = _lse_from_stats(m, l)
+    delta = jnp.sum(gb.astype(jnp.float32) * ob.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    dq0 = jnp.zeros(qb.shape, jnp.float32)
+    dk0 = jnp.zeros(kb.shape, jnp.float32)
+    dv0 = jnp.zeros(vb.shape, jnp.float32)
+
+    def step(t, carry):
+        k_t, v_t, dk_t, dv_t, dq = carry
+        src = (idx + t) % p
+        offsets = jnp.stack([jnp.asarray(q_off, jnp.int32),
+                             jnp.asarray(src * s_local, jnp.int32)])
+        dq_i, dk_i, dv_i = _flash_bwd_bhsd(
+            qb, k_t, v_t, gb, lse, delta, offsets, causal, block,
+            block, interpret)
+        dq = dq + dq_i.astype(jnp.float32)
+        dk_t = dk_t + dk_i.astype(jnp.float32)
+        dv_t = dv_t + dv_i.astype(jnp.float32)
+        k_n = jax.lax.ppermute(k_t, axis, perm)
+        v_n = jax.lax.ppermute(v_t, axis, perm)
+        dk_n = jax.lax.ppermute(dk_t, axis, perm)
+        dv_n = jax.lax.ppermute(dv_t, axis, perm)
+        return k_n, v_n, dk_n, dv_n, dq
+
+    if p == 1:
+        _, _, dk, dv, dq = step(0, (kb, vb, dk0, dv0, dq0))
+    else:
+        _, _, dk, dv, dq = jax.lax.fori_loop(
+            0, p, step, (kb, vb, dk0, dv0, dq0))
+    return (_from_bhsd(dq, b, h).astype(q.dtype),
+            _from_bhsd(dk, b, h).astype(k.dtype),
+            _from_bhsd(dv, b, h).astype(v.dtype))
 
 
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
@@ -167,15 +218,20 @@ def ring_attention(q, k, v, causal: bool = True, axis: str = "seq",
     ``use_flash`` (default: auto — on TPU with block-divisible local
     sequences) runs each per-shard block through the pallas flash
     kernel and merges softmax statistics across ring steps; gradients
-    flow through a custom VJP that recomputes via the jax-level ring.
+    flow through a second ring over the pallas backward kernels
+    against the globally-merged lse.
     """
     s_local = q.shape[1]
     block = min(128, s_local)
     if use_flash is None:
-        use_flash = (causal and s_local % block == 0
+        use_flash = (s_local % block == 0
                      and jax.default_backend() in ("tpu", "axon"))
+    elif use_flash and s_local % block != 0:
+        raise ValueError(
+            f"use_flash requires local sequence {s_local} divisible by "
+            f"block {block}")
     if use_flash:
-        return _ring_flash(q, k, v, axis, block)
+        return _ring_flash(q, k, v, bool(causal), axis, block)
     return _ring_einsum(q, k, v, causal, axis)
 
 
