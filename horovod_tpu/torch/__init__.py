@@ -268,37 +268,56 @@ def broadcast_optimizer_state(optimizer, root_rank: int = 0):
     # Scalars (lr, momentum, step counters) ride one fused broadcast.
     if scalars:
         keys = sorted(scalars)
+        pos = {k: i for i, k in enumerate(keys)}
         vec = np.asarray([float(scalars[k]) for k in keys], np.float64)
         out = np.asarray(_ops.broadcast(vec, root_rank=root_rank,
                                         name="bcast.os.scalars"))
-        it = iter(out)
 
-        def restore(path, container, key, value):
-            # Every scalar recorded by visit() was packed into the vec,
-            # so every one must consume a slot here — a skipped next()
-            # would shift all later scalars by one. bool is a subclass
+        def converted(path, value):
+            # Addressed by path (not a running iterator), so restore
+            # order can't drift from visit order. bool is a subclass
             # of int; restore it as bool, not 0.0/1.0.
-            broadcasted = next(it)
+            broadcasted = out[pos[path]]
             if isinstance(value, bool):
-                container[key] = bool(broadcasted)
-            elif isinstance(value, int):
-                container[key] = int(broadcasted)
-            elif isinstance(value, float):
-                container[key] = float(broadcasted)
+                return bool(broadcasted)
+            if isinstance(value, int):
+                return int(broadcasted)
+            return float(broadcasted)
+
+        def rebuilt_tuple(path, tup):
+            # Tuples (e.g. Adam's betas) are immutable — rebuild the
+            # whole container from broadcast values and hand it back
+            # for the parent to reassign (the reference's option
+            # callbacks likewise assign whole option values).
+            new = []
+            for i, v in enumerate(tup):
+                p = f"{path}/{i}"
+                if p in scalars:
+                    new.append(converted(p, v))
+                elif isinstance(v, tuple):
+                    new.append(rebuilt_tuple(p, v))
+                else:
+                    revisit(p, v)
+                    new.append(v)
+            return tuple(new)
 
         def revisit(path, value):
             if isinstance(value, dict):
                 for k in sorted(value, key=str):
                     p = f"{path}/{k}"
                     if p in scalars:
-                        restore(p, value, k, value[k])
+                        value[k] = converted(p, value[k])
+                    elif isinstance(value[k], tuple):
+                        value[k] = rebuilt_tuple(p, value[k])
                     else:
                         revisit(p, value[k])
-            elif isinstance(value, (list, tuple)):
+            elif isinstance(value, list):
                 for i, v in enumerate(value):
                     p = f"{path}/{i}"
                     if p in scalars:
-                        restore(p, value, i, v)
+                        value[i] = converted(p, v)
+                    elif isinstance(v, tuple):
+                        value[i] = rebuilt_tuple(p, v)
                     else:
                         revisit(p, v)
 

@@ -23,6 +23,9 @@ _STEP_RE = re.compile(r"^step_(\d+)$")
 
 
 def _save_tree(path: str, tree: Any) -> None:
+    # orbax rejects relative paths; the flax fallback doesn't care —
+    # normalize so behavior doesn't depend on which backend is present.
+    path = os.path.abspath(path)
     try:
         import orbax.checkpoint as ocp
         ckptr = ocp.PyTreeCheckpointer()
@@ -37,6 +40,7 @@ def _save_tree(path: str, tree: Any) -> None:
 
 
 def _load_tree(path: str, target: Optional[Any]) -> Any:
+    path = os.path.abspath(path)
     if os.path.isdir(path):
         import orbax.checkpoint as ocp
         ckptr = ocp.PyTreeCheckpointer()

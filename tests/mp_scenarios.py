@@ -217,6 +217,43 @@ def scenario_torch_optimizer(hvd_mod, rank, size):
     assert isinstance(g.get("nesterov", False), bool)
 
 
+def scenario_torch_adam_state(hvd_mod, rank, size):
+    """broadcast_optimizer_state with tuple hyperparameters (Adam's
+    betas) and materialized per-param state incl. int step counters —
+    tuples must be rebuilt, not assigned into (reference analog:
+    test_torch.py:802-1003 covering every optimizer class)."""
+    import torch
+    import horovod_tpu.torch as hvd
+
+    torch.manual_seed(200 + rank)
+    model = torch.nn.Linear(5, 3)
+    # rank-divergent hyperparams: the broadcast must impose rank 0's
+    betas = (0.9, 0.999) if rank == 0 else (0.5, 0.7)
+    lr = 1e-3 if rank == 0 else 0.1
+    opt = torch.optim.Adam(model.parameters(), lr=lr, betas=betas,
+                           amsgrad=False)
+    # materialize state (exp_avg tensors + int step counters)
+    loss = model(torch.randn(4, 5)).sum()
+    loss.backward()
+    opt.step()
+
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+    g = opt.param_groups[0]
+    assert isinstance(g["betas"], tuple), type(g["betas"])
+    assert g["betas"] == (0.9, 0.999), g["betas"]
+    assert abs(g["lr"] - 1e-3) < 1e-12, g["lr"]
+    # tensor state agrees world-wide after broadcast
+    for pid, st in opt.state_dict()["state"].items():
+        for key, val in st.items():
+            if isinstance(val, torch.Tensor):
+                gathered = hvd.allgather(
+                    val.detach().reshape(1, -1).to(torch.float32),
+                    name=f"check.adam.{pid}.{key}")
+                for r in range(size):
+                    assert torch.allclose(gathered[r], gathered[0]), \
+                        f"state {pid}/{key} diverged"
+
+
 def scenario_jax_adapter(hvd_mod, rank, size):
     """jax adapter host path: pytree gradient allreduce + parameter
     broadcast through the background runtime."""

@@ -120,6 +120,11 @@ def test_jax_adapter_host_path():
     run_scenario("jax_adapter", 2)
 
 
+def test_torch_adam_state_broadcast():
+    run_scenario("torch_adam_state", 2, timeout=120.0)
+
+
+
 def test_keras_distributed_optimizer():
     run_scenario("keras_optimizer", 2, timeout=180.0)
 
