@@ -284,45 +284,28 @@ def broadcast_optimizer_state(optimizer, root_rank: int = 0):
                 return int(broadcasted)
             return float(broadcasted)
 
-        def rebuilt_tuple(path, tup):
-            # Tuples (e.g. Adam's betas) are immutable — rebuild the
-            # whole container from broadcast values and hand it back
-            # for the parent to reassign (the reference's option
-            # callbacks likewise assign whole option values).
-            new = []
-            for i, v in enumerate(tup):
-                p = f"{path}/{i}"
-                if p in scalars:
-                    new.append(converted(p, v))
-                elif isinstance(v, tuple):
-                    new.append(rebuilt_tuple(p, v))
-                else:
-                    revisit(p, v)
-                    new.append(v)
-            return tuple(new)
-
-        def revisit(path, value):
+        def restored(path, value):
+            # One dispatch for every container shape. Returns the value
+            # to store back: scalars come from the broadcast vector;
+            # tuples (e.g. Adam's betas) are immutable so the whole
+            # container is rebuilt and reassigned on the parent (the
+            # reference's option callbacks likewise assign whole option
+            # values); dicts/lists are mutated in place.
+            if path in scalars:
+                return converted(path, value)
+            if isinstance(value, tuple):
+                return tuple(restored(f"{path}/{i}", v)
+                             for i, v in enumerate(value))
             if isinstance(value, dict):
                 for k in sorted(value, key=str):
-                    p = f"{path}/{k}"
-                    if p in scalars:
-                        value[k] = converted(p, value[k])
-                    elif isinstance(value[k], tuple):
-                        value[k] = rebuilt_tuple(p, value[k])
-                    else:
-                        revisit(p, value[k])
+                    value[k] = restored(f"{path}/{k}", value[k])
             elif isinstance(value, list):
                 for i, v in enumerate(value):
-                    p = f"{path}/{i}"
-                    if p in scalars:
-                        value[i] = converted(p, v)
-                    elif isinstance(v, tuple):
-                        value[i] = rebuilt_tuple(p, v)
-                    else:
-                        revisit(p, v)
+                    value[i] = restored(f"{path}/{i}", v)
+            return value
 
-        revisit("state", state_dict["state"])
-        revisit("param_groups", state_dict["param_groups"])
+        restored("state", state_dict["state"])
+        restored("param_groups", state_dict["param_groups"])
         optimizer.load_state_dict(state_dict)
 
 

@@ -34,7 +34,7 @@ def _save_tree(path: str, tree: Any) -> None:
     except ImportError:
         pass
     from flax import serialization
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
     with open(path, "wb") as f:
         f.write(serialization.to_bytes(tree))
 

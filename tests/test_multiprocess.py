@@ -124,7 +124,6 @@ def test_torch_adam_state_broadcast():
     run_scenario("torch_adam_state", 2, timeout=120.0)
 
 
-
 def test_keras_distributed_optimizer():
     run_scenario("keras_optimizer", 2, timeout=180.0)
 
