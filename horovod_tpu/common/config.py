@@ -64,6 +64,11 @@ class Config:
     timeline_path: str = ""
     timeline_mark_cycles: bool = False
 
+    # Async collective completion (reference: cuda_operations.cc:148-179
+    # detached finalizer threads + Status::InProgress). Off = the cycle
+    # loop blocks until each collective's outputs are ready.
+    async_completion: bool = True
+
     # Stall detection (reference: operations.cc:543-624)
     stall_check_disable: bool = False
     stall_check_time_seconds: float = 60.0
@@ -112,6 +117,8 @@ class Config:
         c.timeline_path = os.environ.get("HOROVOD_TIMELINE", "")
         c.timeline_mark_cycles = _env_bool(
             "HOROVOD_TIMELINE_MARK_CYCLES", c.timeline_mark_cycles)
+        c.async_completion = _env_bool(
+            "HOROVOD_ASYNC_COMPLETION", c.async_completion)
         c.stall_check_disable = _env_bool(
             "HOROVOD_STALL_CHECK_DISABLE", c.stall_check_disable)
         c.stall_check_time_seconds = _env_float(

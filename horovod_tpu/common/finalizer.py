@@ -1,0 +1,69 @@
+"""Asynchronous collective completion.
+
+TPU-native equivalent of the reference's CUDA finalizer threads
+(reference: horovod/common/ops/cuda_operations.cc:148-179 —
+``FinalizeCUDAQueue`` detaches a thread per batch that waits on the
+recorded CUDA events, fires every entry's StatusCallback, and lets the
+op return ``Status::InProgress()`` so the background loop keeps
+negotiating the next cycle instead of blocking on the collective).
+
+On TPU the data plane is an XLA computation whose dispatch is already
+asynchronous; what must not block is the *negotiation loop*. A backend
+that wants async completion issues its computation, registers a
+completion closure here (typically ``jax.block_until_ready`` on the
+output arrays followed by the callbacks), and returns
+``Status.InProgress()``. One detached thread per batch mirrors the
+reference and avoids head-of-line blocking: a small batch issued after
+a huge allreduce may complete first, exactly as with per-batch CUDA
+finalizers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List
+
+from horovod_tpu.common import logging as hlog
+
+
+class Finalizer:
+    """Detached per-batch completion threads with a drainable registry."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._threads: List[threading.Thread] = []
+        self._closed = False
+
+    def submit(self, fn: Callable[[], None]) -> bool:
+        """Run ``fn`` on a detached finalizer thread. Returns False when
+        draining has begun — the caller must then complete synchronously."""
+        t = threading.Thread(target=self._run, args=(fn,),
+                             name="hvd-finalizer", daemon=True)
+        with self._lock:
+            if self._closed:
+                return False
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+            # Start under the lock so a concurrent drain() can never
+            # observe (and join) a registered-but-unstarted thread.
+            t.start()
+        return True
+
+    @staticmethod
+    def _run(fn: Callable[[], None]) -> None:
+        try:
+            fn()
+        except Exception as e:  # a closure must never kill the process
+            hlog.error(f"finalizer task failed: {e!r}")
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Refuse new work and wait for in-flight completions — called
+        from the background loop's shutdown path so every issued
+        collective still fires its callbacks before SHUT_DOWN fan-out."""
+        with self._lock:
+            self._closed = True
+            threads = list(self._threads)
+        deadline = time.monotonic() + timeout
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()))

@@ -71,6 +71,14 @@ class Runtime:
             warning_time=config.stall_check_time_seconds,
             shutdown_time=config.stall_shutdown_time_seconds,
             disabled=config.stall_check_disable)
+        # Async completion: backends that return InProgress complete on
+        # detached finalizer threads while this loop keeps negotiating
+        # (reference: cuda_operations.cc:148-179).
+        self.finalizer = None
+        if getattr(config, "async_completion", True):
+            from horovod_tpu.common.finalizer import Finalizer
+            self.finalizer = Finalizer()
+            op_manager.attach_finalizer(self.finalizer)
         self._shutdown_requested = threading.Event()
         self._done = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -136,8 +144,11 @@ class Runtime:
                        rank=self.controller.rank)
         finally:
             self._done.set()
-            # Fail everything still pending
-            # (reference: operations.cc:898-913).
+            # Drain in-flight async completions first so every issued
+            # collective fires its real status, then fail what was never
+            # issued (reference: operations.cc:898-913).
+            if self.finalizer is not None:
+                self.finalizer.drain()
             for entry in self.tensor_table.pop_all():
                 if entry.callback:
                     entry.callback(Status.Aborted(SHUT_DOWN_ERROR))

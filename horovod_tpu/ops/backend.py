@@ -27,6 +27,11 @@ from horovod_tpu.common.tensor_table import TensorTableEntry
 class CollectiveBackend:
     name = "abstract"
 
+    # Set by OperationManager.attach_finalizer when async completion is
+    # enabled; backends that issue asynchronously submit a completion
+    # closure and return Status.InProgress.
+    finalizer = None
+
     def enabled(self, entries: List[TensorTableEntry],
                 response: Response) -> bool:
         raise NotImplementedError

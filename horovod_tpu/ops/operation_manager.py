@@ -22,6 +22,13 @@ class OperationManager:
     def __init__(self, backends: List[CollectiveBackend]):
         self._backends = backends
 
+    def attach_finalizer(self, finalizer) -> None:
+        """Give every backend the runtime's Finalizer so it may return
+        Status.InProgress and complete on a detached thread (reference:
+        FinalizeCUDAQueue, cuda_operations.cc:148-179)."""
+        for b in self._backends:
+            b.finalizer = finalizer
+
     def _pick(self, entries, response) -> CollectiveBackend:
         for b in self._backends:
             if b.enabled(entries, response):

@@ -197,6 +197,52 @@ class XlaMeshBackend(CollectiveBackend):
         out = fn(garr)
         return out
 
+    @staticmethod
+    def _observe(outs) -> Status:
+        """Block until the issued collective's outputs are really done.
+        block_until_ready alone is not enough on the axon platform
+        (it can return before execution finishes), so also fetch one
+        element of each output — a value fetch is a true sync point."""
+        try:
+            import jax
+            jax.block_until_ready(outs)
+            for o in outs:
+                if hasattr(o, "ndim") and getattr(o, "size", 0):
+                    np.asarray(jax.device_get(o[(0,) * o.ndim]))
+            return Status.OK()
+        except Exception as ex:
+            return Status.UnknownError(
+                f"async collective completion failed: {ex!r}")
+
+    def _complete(self, entries) -> Status:
+        """Async completion (reference: FinalizeCUDAQueue,
+        cuda_operations.cc:148-179): the jitted collective is already
+        in flight; hand the output arrays to a finalizer thread that
+        observes readiness and fires the callbacks, and return
+        InProgress so the negotiation loop keeps cycling."""
+        fin = self.finalizer
+        if fin is None:
+            return Status.OK()
+        outs = [e.output for e in entries]
+
+        def finalize():
+            st = self._observe(outs)
+            for e in entries:
+                if e.callback:
+                    try:
+                        e.callback(st)
+                    except Exception as ex:
+                        # One adapter callback must not starve the rest
+                        # of the batch of their completions.
+                        hlog.error(f"completion callback for "
+                                   f"{e.tensor_name} raised: {ex!r}")
+
+        if not fin.submit(finalize):
+            # Draining: observe readiness inline; the loop fires the
+            # callbacks synchronously on a non-InProgress status.
+            return self._observe(outs)
+        return Status.InProgress()
+
     # -- allreduce -------------------------------------------------------
     def execute_allreduce(self, entries, response: Response) -> Status:
         import jax
@@ -233,7 +279,7 @@ class XlaMeshBackend(CollectiveBackend):
             e.output = jax.device_put(
                 fused[offset:offset + n].reshape(a.shape))
             offset += n
-        return Status.OK()
+        return self._complete(entries)
 
     # -- allgather (variable dim0 via pad + slice) -----------------------
     def execute_allgather(self, entries, response: Response) -> Status:
@@ -258,7 +304,7 @@ class XlaMeshBackend(CollectiveBackend):
         g = out.addressable_data(0)
         parts = [g[r][:dim0_sizes[r]] for r in range(len(dim0_sizes))]
         entry.output = jax.device_put(jnp.concatenate(parts, axis=0))
-        return Status.OK()
+        return self._complete(entries)
 
     # -- broadcast (masked psum) ----------------------------------------
     def execute_broadcast(self, entries, response: Response) -> Status:
@@ -280,7 +326,7 @@ class XlaMeshBackend(CollectiveBackend):
                                  extra=(root,))
         entry.output = jax.device_put(
             out.addressable_data(0).reshape(x.shape))
-        return Status.OK()
+        return self._complete(entries)
 
     # -- alltoall --------------------------------------------------------
     def execute_alltoall(self, entries, response: Response) -> Status:
@@ -299,7 +345,7 @@ class XlaMeshBackend(CollectiveBackend):
 
         out = self._run_shard_op("alltoall", x, P(_AXIS), body)
         entry.output = jax.device_put(out.addressable_data(0))
-        return Status.OK()
+        return self._complete(entries)
 
     # -- reducescatter ---------------------------------------------------
     def execute_reducescatter(self, entries, response: Response) -> Status:
@@ -325,7 +371,7 @@ class XlaMeshBackend(CollectiveBackend):
         out = self._run_shard_op("reducescatter", x, P(_AXIS), body,
                                  extra=(pre, post))
         entry.output = jax.device_put(out.addressable_data(0))
-        return Status.OK()
+        return self._complete(entries)
 
     def execute_barrier(self, entries, response: Response) -> Status:
         import jax.numpy as jnp

@@ -1,0 +1,171 @@
+"""Async collective completion: the negotiation loop must keep cycling
+while an earlier collective is still executing (reference analog:
+Status::InProgress + detached finalizer threads,
+cuda_operations.cc:148-179)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.controller import LocalController
+from horovod_tpu.common.finalizer import Finalizer
+from horovod_tpu.common.message import (
+    RequestType, numpy_dtype_to_datatype,
+)
+from horovod_tpu.common.runtime import Runtime
+from horovod_tpu.common.status import Status
+from horovod_tpu.common.tensor_table import TensorTableEntry
+from horovod_tpu.ops.backend import CollectiveBackend
+from horovod_tpu.ops.operation_manager import OperationManager
+
+
+class GatedAsyncBackend(CollectiveBackend):
+    """Issues instantly; the FIRST batch's completion blocks on a gate
+    the test controls — a stand-in for a huge in-flight allreduce."""
+
+    name = "gated-async"
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.issued = []          # tensor names in issue order
+        self.issued_cv = threading.Condition()
+
+    def enabled(self, entries, response):
+        return True
+
+    def execute_allreduce(self, entries, response):
+        for e in entries:
+            e.output = e.tensor
+        with self.issued_cv:
+            first = not self.issued
+            self.issued.extend(response.tensor_names)
+            self.issued_cv.notify_all()
+        gate = self.gate if first else None
+
+        def finalize():
+            if gate is not None:
+                assert gate.wait(10.0), "test gate never opened"
+            for e in entries:
+                if e.callback:
+                    e.callback(Status.OK())
+
+        assert self.finalizer is not None
+        assert self.finalizer.submit(finalize)
+        return Status.InProgress()
+
+
+def _enqueue(rt, name, done_events):
+    arr = np.arange(4, dtype=np.float32)
+    entry = TensorTableEntry(tensor_name=name, tensor=arr)
+    ev = threading.Event()
+    done_events[name] = ev
+
+    def callback(status):
+        assert status.ok(), status.reason
+        ev.set()
+
+    entry.callback = callback
+    st = rt.enqueue(RequestType.ALLREDUCE, entry,
+                    numpy_dtype_to_datatype(arr.dtype), arr.shape)
+    assert st.ok(), st.reason
+
+
+def test_negotiation_continues_while_collective_in_flight():
+    cfg = Config()
+    cfg.cycle_time_ms = 1.0
+    cfg.stall_check_disable = True
+    backend = GatedAsyncBackend()
+    rt = Runtime(cfg, LocalController(), OperationManager([backend]))
+    rt.start()
+    done = {}
+    try:
+        _enqueue(rt, "big.0", done)
+        # wait until cycle N has ISSUED the big collective
+        with backend.issued_cv:
+            assert backend.issued_cv.wait_for(
+                lambda: "big.0" in backend.issued, timeout=10.0)
+
+        # cycle N+1: a second tensor must negotiate, issue, AND complete
+        # while big.0 is still executing (its gate is closed).
+        _enqueue(rt, "small.1", done)
+        assert done["small.1"].wait(10.0), \
+            "negotiation loop blocked behind the in-flight collective"
+        assert not done["big.0"].is_set(), \
+            "big.0 completed before its gate opened?"
+
+        backend.gate.set()
+        assert done["big.0"].wait(10.0)
+    finally:
+        backend.gate.set()
+        rt.request_shutdown()
+        rt.join(10.0)
+
+
+def test_drain_completes_in_flight_on_shutdown():
+    """Shutdown must wait for issued collectives: their callbacks fire
+    with the real status, not SHUT_DOWN_ERROR."""
+    cfg = Config()
+    cfg.cycle_time_ms = 1.0
+    cfg.stall_check_disable = True
+    backend = GatedAsyncBackend()
+    rt = Runtime(cfg, LocalController(), OperationManager([backend]))
+    rt.start()
+    done = {}
+    try:
+        _enqueue(rt, "big.0", done)
+        with backend.issued_cv:
+            assert backend.issued_cv.wait_for(
+                lambda: "big.0" in backend.issued, timeout=10.0)
+        rt.request_shutdown()
+        time.sleep(0.05)            # loop exits; drain blocks on gate
+        assert not done["big.0"].is_set()
+        backend.gate.set()
+        rt.join(10.0)
+        assert done["big.0"].wait(10.0)
+    finally:
+        backend.gate.set()
+        rt.request_shutdown()
+        rt.join(10.0)
+
+
+def test_finalizer_drain_refuses_new_work():
+    fin = Finalizer()
+    ran = threading.Event()
+    assert fin.submit(ran.set)
+    fin.drain(5.0)
+    assert ran.is_set()
+    assert not fin.submit(lambda: None)
+
+
+def test_sync_mode_keeps_blocking_semantics():
+    """HOROVOD_ASYNC_COMPLETION=0: no finalizer attached; a backend
+    without one returns OK synchronously and callbacks fire in-loop."""
+    cfg = Config()
+    cfg.cycle_time_ms = 1.0
+    cfg.stall_check_disable = True
+    cfg.async_completion = False
+
+    class SyncBackend(CollectiveBackend):
+        name = "sync"
+
+        def enabled(self, entries, response):
+            return True
+
+        def execute_allreduce(self, entries, response):
+            assert self.finalizer is None
+            for e in entries:
+                e.output = e.tensor
+            return Status.OK()
+
+    rt = Runtime(cfg, LocalController(), OperationManager([SyncBackend()]))
+    rt.start()
+    done = {}
+    try:
+        _enqueue(rt, "x.0", done)
+        assert done["x.0"].wait(10.0)
+    finally:
+        rt.request_shutdown()
+        rt.join(10.0)
