@@ -18,6 +18,7 @@ hostname exactly like the reference's ``MPI_Comm_split_type(SHARED)`` +
 from __future__ import annotations
 
 import json
+import os
 import socket
 import threading
 import time
@@ -25,6 +26,15 @@ from typing import Dict, List, Optional
 
 from horovod_tpu.common import logging as hlog
 from horovod_tpu.common import network
+
+def _my_hostname() -> str:
+    """Hostname used for local/cross topology grouping. The
+    HOROVOD_HOSTNAME override serves containerized ranks whose kernel
+    hostname is meaningless, and lets tests force a multi-host shape
+    on one machine (reference analog: host_hash's override-free
+    hostname grouping, run/common/util/host_hash.py)."""
+    return os.environ.get("HOROVOD_HOSTNAME") or socket.gethostname()
+
 
 # Frame tags on the controller channel.
 TAG_HANDSHAKE = 1
@@ -178,7 +188,7 @@ class TcpCoordinator(Controller):
         self._server = network.listen(port)
         self.port = self._server.getsockname()[1]
         self._channels: Dict[int, network.Channel] = {}
-        self._hostname = socket.gethostname()
+        self._hostname = _my_hostname()
         self._size = size
         self._start_timeout = start_timeout
         self.topology = None  # set by accept_workers
@@ -289,11 +299,18 @@ class TcpCoordinator(Controller):
                     lib.hvd_free(bufs[i])
         return out
 
-    def _native_send_all(self, payload: bytes, tag: int) -> bool:
+    def _native_send_all(self, payload: bytes, tag: int,
+                         exclude_rank: Optional[int] = None) -> bool:
         lib, ctypes = self._native
-        n = len(self._worker_ranks)
+        if exclude_rank is None:
+            fds, n = self._worker_fds, len(self._worker_ranks)
+        else:
+            sub = [fd for r, fd in zip(self._worker_ranks,
+                                       self._worker_fds)
+                   if r != exclude_rank]
+            fds, n = (ctypes.c_int * len(sub))(*sub), len(sub)
         buf = self._as_u8(ctypes, payload)
-        rc = lib.hvd_broadcast_frame(self._worker_fds, n, tag, buf,
+        rc = lib.hvd_broadcast_frame(fds, n, tag, buf,
                                      len(payload), self._native_secret,
                                      len(self._secret))
         if rc != 0:
@@ -354,10 +371,21 @@ class TcpCoordinator(Controller):
     def broadcast_data(self, payload: Optional[bytes],
                        root_rank: int = 0) -> bytes:
         if root_rank != 0:
-            # Pull the payload up from the root, then fan out.
+            # Pull the payload up from the root, then fan out to
+            # everyone EXCEPT the root — it already has the bytes, and
+            # echoing them back would double the root's traffic.
             tag, payload = self._channels[root_rank].recv()
             if tag != TAG_DATA:
                 raise ConnectionError("expected TAG_DATA from root")
+            assert payload is not None
+            if self._native is not None:
+                self._native_send_all(payload, TAG_DATA,
+                                      exclude_rank=root_rank)
+                return payload
+            for r, ch in self._channels.items():
+                if r != root_rank:
+                    ch.send(payload, TAG_DATA)
+            return payload
         assert payload is not None
         if self._native is not None:
             self._native_send_all(payload, TAG_DATA)
@@ -390,7 +418,7 @@ class TcpWorker(Controller):
                                    timeout=start_timeout,
                                    retry_deadline=start_timeout)
         hello = json.dumps({
-            "rank": rank, "hostname": socket.gethostname()}).encode()
+            "rank": rank, "hostname": _my_hostname()}).encode()
         self._ch.send(hello, TAG_HANDSHAKE)
         tag, payload = self._ch.recv()
         if tag != TAG_HANDSHAKE:
@@ -415,7 +443,10 @@ class TcpWorker(Controller):
     def broadcast_data(self, payload: Optional[bytes],
                        root_rank: int = 0) -> bytes:
         if payload is not None and self.rank == root_rank:
+            # Root sends up; the coordinator fans out to the others
+            # only — our own copy is already authoritative.
             self._ch.send(payload, TAG_DATA)
+            return payload
         tag, data = self._ch.recv()
         if tag != TAG_DATA:
             raise ConnectionError(f"expected TAG_DATA, got {tag}")

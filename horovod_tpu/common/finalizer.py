@@ -67,3 +67,11 @@ class Finalizer:
         deadline = time.monotonic() + timeout
         for t in threads:
             t.join(max(0.0, deadline - time.monotonic()))
+        stuck = [t.name for t in threads if t.is_alive()]
+        if stuck:
+            # Callbacks of these batches will never fire; any
+            # synchronize() on their handles is hung — say so.
+            hlog.error(
+                f"finalizer drain timed out after {timeout}s with "
+                f"{len(stuck)} completion thread(s) still running; "
+                "their collectives' callbacks will not fire")

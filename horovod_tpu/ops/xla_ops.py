@@ -99,20 +99,24 @@ class XlaMeshBackend(CollectiveBackend):
             return False
 
     def _maybe_build_hierarchical_mesh(self, reps) -> None:
-        """HOROVOD_HIERARCHICAL_ALLREDUCE: factor the flat proc mesh
-        into (cross, local) axes so psum decomposes into an intra-host
-        reduction riding ICI and a cross-host stage riding DCN — the
-        XLA rendering of NCCLHierarchicalAllreduce's reduce-scatter →
-        cross allreduce → allgather (reference:
-        horovod/common/ops/nccl_operations.cc:167-372). Only the
-        reduction ops use this mesh; rank-ordered ops (allgather,
-        alltoall, broadcast roots) stay on the flat mesh where slot r
-        is unambiguously rank r."""
+        """HOROVOD_HIERARCHICAL_ALLREDUCE / _ALLGATHER: factor the flat
+        proc mesh into (cross, local) axes so collectives decompose
+        into an intra-host stage riding ICI and a cross-host stage
+        riding DCN — the XLA rendering of NCCLHierarchicalAllreduce's
+        reduce-scatter → cross allreduce → allgather (reference:
+        nccl_operations.cc:167-372) and MPIHierarchicalAllgather's
+        node-shared buffer + cross allgatherv (reference:
+        mpi_operations.cc:179-329). Allreduce is order-free; the
+        hierarchical allgather reshapes (cross, local) back into rank
+        order, which the contiguous per-host rank layout guarantees.
+        Other rank-ordered ops (alltoall, broadcast roots) stay on the
+        flat mesh where slot r is unambiguously rank r."""
         from jax.sharding import Mesh
         cfg = self._config
         topo = self._ctl.topology
-        if cfg is None or topo is None or \
-                not getattr(cfg, "hierarchical_allreduce", False):
+        if cfg is None or topo is None or not (
+                getattr(cfg, "hierarchical_allreduce", False)
+                or getattr(cfg, "hierarchical_allgather", False)):
             return
         if not topo.is_homogeneous or topo.local_size <= 1:
             return
@@ -120,8 +124,9 @@ class XlaMeshBackend(CollectiveBackend):
         # (rank == cross_rank * local_size + local_rank).
         if topo.rank != topo.cross_rank * topo.local_size + \
                 topo.local_rank:
-            hlog.warning("hierarchical allreduce disabled: ranks are "
-                         "not grouped contiguously per host")
+            hlog.warning("hierarchical collectives disabled (allreduce/"
+                         "allgather): ranks are not grouped "
+                         "contiguously per host")
             return
         grid = np.array(reps).reshape(topo.cross_size, topo.local_size)
         self._mesh2d = Mesh(grid, ("cross", "local"))
@@ -258,7 +263,8 @@ class XlaMeshBackend(CollectiveBackend):
         # Factored (cross, local) psum when hierarchical allreduce is
         # on: XLA emits the intra-host stage on ICI and the cross-host
         # stage on DCN.
-        if self._mesh2d is not None:
+        if self._mesh2d is not None and getattr(
+                self._config, "hierarchical_allreduce", False):
             mesh, axes = self._mesh2d, ("cross", "local")
         else:
             mesh, axes = self._mesh, _AXIS
@@ -295,11 +301,32 @@ class XlaMeshBackend(CollectiveBackend):
         if pad:
             x = jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
 
-        def body(t):
-            return jax.lax.all_gather(t, _AXIS)
+        hier = (self._mesh2d is not None and getattr(
+            self._config, "hierarchical_allgather", False))
+        if hier:
+            # Two-level gather (reference: MPIHierarchicalAllgather,
+            # mpi_operations.cc:179-329): gather the host's shards
+            # locally (ICI), then exchange whole host blocks across
+            # hosts (DCN). The (cross, local) result reshapes exactly
+            # into rank order under the contiguous per-host layout.
+            local_size = self._mesh2d.shape["local"]
+            cross_size = self._mesh2d.shape["cross"]
 
-        out = self._run_shard_op("allgather", x, P(), body,
-                                 extra=(tuple(dim0_sizes),))
+            def body(t):
+                g_local = jax.lax.all_gather(t, "local")
+                g = jax.lax.all_gather(g_local, "cross")
+                return g.reshape((cross_size * local_size,) + t.shape)
+
+            out = self._run_shard_op(
+                "allgather_hier", x, P(), body,
+                extra=(tuple(dim0_sizes),), mesh=self._mesh2d,
+                axes=("cross", "local"))
+        else:
+            def body(t):
+                return jax.lax.all_gather(t, _AXIS)
+
+            out = self._run_shard_op("allgather", x, P(), body,
+                                     extra=(tuple(dim0_sizes),))
         # out: [size, max_dim0, ...] replicated; slice each rank's real rows
         g = out.addressable_data(0)
         parts = [g[r][:dim0_sizes[r]] for r in range(len(dim0_sizes))]

@@ -413,6 +413,35 @@ def scenario_xla_hierarchical(hvd_mod, rank, size):
     assert xla._mesh2d is not None, "hierarchical mesh not built"
 
 
+def scenario_xla_hierarchical_allgather(hvd_mod, rank, size):
+    """HOROVOD_HIERARCHICAL_ALLGATHER on a forced 2-host topology
+    (HOROVOD_HOSTNAME set by the harness: ranks 0,1 on hostA; 2,3 on
+    hostB): variable-dim0 allgather must take the two-level
+    local-gather -> cross-exchange path and still return rank-ordered
+    rows (reference: MPIHierarchicalAllgather,
+    mpi_operations.cc:179-329)."""
+    assert size == 4, "scenario expects 4 ranks"
+    jax = _init_jax_distributed(rank, size)
+    import jax.numpy as jnp
+    from horovod_tpu.common import basics as _b
+
+    # variable dim0: rank r contributes r+1 rows valued r
+    x = jnp.full((rank + 1, 3), float(rank), jnp.float32)
+    out = hvd_mod.allgather(x, name="hier.ag")
+    expected = np.concatenate(
+        [np.full((r + 1, 3), float(r), np.float32) for r in range(size)])
+    np.testing.assert_allclose(np.asarray(out), expected)
+
+    rt = _b.runtime()
+    xla = [b for b in rt.op_manager._backends if b.name == "xla_mesh"][0]
+    assert xla._mesh2d is not None, "hierarchical mesh not built"
+    assert xla._mesh2d.shape["cross"] == 2 and \
+        xla._mesh2d.shape["local"] == 2, dict(xla._mesh2d.shape)
+    kinds = {k[0] for k in xla._cache}
+    assert "allgather_hier" in kinds, kinds
+    assert "allgather" not in kinds, kinds
+
+
 def main():
     scenario, rank, size, port = (sys.argv[1], int(sys.argv[2]),
                                   int(sys.argv[3]), int(sys.argv[4]))

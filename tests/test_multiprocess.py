@@ -22,15 +22,18 @@ def _free_port() -> int:
 
 
 def run_scenario(scenario: str, size: int, timeout: float = 90.0,
-                 extra_env=None):
+                 extra_env=None, per_rank_env=None):
     port = _free_port()
     procs = []
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env.setdefault("JAX_PLATFORMS", "cpu")
+    base = dict(os.environ)
+    base["PYTHONPATH"] = REPO + os.pathsep + base.get("PYTHONPATH", "")
+    base.setdefault("JAX_PLATFORMS", "cpu")
     if extra_env:
-        env.update(extra_env)
+        base.update(extra_env)
     for rank in range(size):
+        env = dict(base)
+        if per_rank_env:
+            env.update(per_rank_env(rank))
         procs.append(subprocess.Popen(
             [sys.executable, "-m", "tests.mp_scenarios", scenario,
              str(rank), str(size), str(port)],
@@ -71,6 +74,12 @@ def test_allgather(size):
 
 def test_broadcast():
     run_scenario("broadcast", 2)
+
+
+def test_broadcast_nonzero_root_three_ranks():
+    """size > 2 with every root: the root's payload must not be echoed
+    back to it by the coordinator fan-out."""
+    run_scenario("broadcast", 3)
 
 
 def test_alltoall():
@@ -151,3 +160,14 @@ def test_xla_mesh_backend():
 def test_xla_hierarchical_allreduce():
     run_scenario("xla_hierarchical", 2, timeout=180.0,
                  extra_env={"HOROVOD_HIERARCHICAL_ALLREDUCE": "1"})
+
+
+def test_xla_hierarchical_allgather():
+    """Forced 2-host topology (2 ranks per fake host): the
+    HOROVOD_HIERARCHICAL_ALLGATHER knob must route allgather through
+    the two-level (local, cross) path."""
+    run_scenario(
+        "xla_hierarchical_allgather", 4, timeout=240.0,
+        extra_env={"HOROVOD_HIERARCHICAL_ALLGATHER": "1"},
+        per_rank_env=lambda rank: {
+            "HOROVOD_HOSTNAME": f"fakehost{rank // 2}"})
