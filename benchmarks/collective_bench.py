@@ -1,0 +1,269 @@
+"""Collective-path microbenchmarks on a multi-process CPU world.
+
+What the reference publishes as its value proposition is collective
+efficiency (docs/benchmarks.md; README.md:66-70 scaling efficiency).
+This bench measures THIS framework's full control+data path — enqueue →
+negotiate (TCP controller) → fuse → execute (socket backend) →
+callback — with no shortcuts:
+
+1. **allreduce bus bandwidth vs message size**: per-op wall time and
+   algorithm/bus bandwidth for single-tensor allreduces from 4 KiB to
+   16 MiB, plus a fused-batch point (32 x 128 KiB in one cycle —
+   exercising tensor fusion).
+2. **scaling efficiency**: steps/sec of a synthetic data-parallel
+   train step (MLP on CPU jax, gradients averaged through the
+   framework) at world size 1 vs N; efficiency = steps_N / steps_1
+   (global throughput per chip vs ideal).
+
+Run with no arguments to orchestrate everything (spawns the worlds,
+writes benchmarks/RESULTS_cpu.json):
+
+    python benchmarks/collective_bench.py [--np 8]
+
+The numbers stand in for BASELINE.json's multi-chip north star in this
+single-chip environment: the control-plane + fusion overheads measured
+here are exactly what bounds scaling efficiency on real pods.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ALLREDUCE_SIZES = [4 << 10, 64 << 10, 1 << 20, 4 << 20, 16 << 20]
+FUSED_COUNT, FUSED_BYTES = 32, 128 << 10
+ALLREDUCE_ITERS = 20
+TRAIN_STEPS = 30
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# worker halves (run in subprocesses)
+# ---------------------------------------------------------------------------
+
+def worker_allreduce(rank: int, size: int) -> None:
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init()
+    results = []
+    for nbytes in ALLREDUCE_SIZES:
+        n = nbytes // 4
+        x = np.full((n,), float(rank + 1), np.float32)
+        for i in range(3):
+            hvd.allreduce(x, average=False, name=f"warm.{nbytes}.{i}")
+        hvd.barrier(name=f"bar.{nbytes}")
+        t0 = time.perf_counter()
+        for i in range(ALLREDUCE_ITERS):
+            out = hvd.allreduce(x, average=False,
+                                name=f"ar.{nbytes}.{i}")
+        dt = time.perf_counter() - t0
+        assert abs(float(out[0]) - sum(range(1, size + 1))) < 1e-4
+        per_op = dt / ALLREDUCE_ITERS
+        algbw = nbytes / per_op
+        results.append({
+            "bytes": nbytes,
+            "us_per_op": round(per_op * 1e6, 1),
+            "algbw_MBps": round(algbw / 1e6, 2),
+            # ring-equivalent bus bandwidth (nccl-tests convention)
+            "busbw_MBps": round(algbw * 2 * (size - 1) / size / 1e6, 2),
+        })
+
+    # fused batch: FUSED_COUNT tensors submitted together ride one
+    # negotiated cycle / fused response
+    xs = [np.full((FUSED_BYTES // 4,), float(rank + 1), np.float32)
+          for _ in range(FUSED_COUNT)]
+    for rep in range(2):
+        handles = [hvd.allreduce_async(x, average=False,
+                                       name=f"fw.{rep}.{i}")
+                   for i, x in enumerate(xs)]
+        for h in handles:
+            hvd.synchronize(h)
+    hvd.barrier(name="bar.fused")
+    t0 = time.perf_counter()
+    for rep in range(ALLREDUCE_ITERS):
+        handles = [hvd.allreduce_async(x, average=False,
+                                       name=f"f.{rep}.{i}")
+                   for i, x in enumerate(xs)]
+        for h in handles:
+            hvd.synchronize(h)
+    dt = time.perf_counter() - t0
+    total = FUSED_COUNT * FUSED_BYTES
+    per_op = dt / ALLREDUCE_ITERS
+    fused = {
+        "bytes": total, "tensors": FUSED_COUNT,
+        "us_per_batch": round(per_op * 1e6, 1),
+        "algbw_MBps": round(total / per_op / 1e6, 2),
+        "busbw_MBps": round(
+            total / per_op * 2 * (size - 1) / size / 1e6, 2),
+    }
+    if rank == 0:
+        print("RESULT " + json.dumps(
+            {"allreduce": results, "fused": fused}), flush=True)
+    hvd.shutdown()
+
+
+def worker_train(rank: int, size: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    import horovod_tpu.jax as hvd
+
+    hvd.init()
+    rng = np.random.RandomState(42)  # same data shape on every rank
+    w_sizes = [(256, 512), (512, 512), (512, 256)]
+    params = [jnp.asarray(rng.randn(*s) * 0.01, jnp.float32)
+              for s in w_sizes]
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    tx = optax.sgd(0.01)
+    opt_state = tx.init(params)
+    x = jnp.asarray(rng.randn(64, 256), jnp.float32)
+
+    @jax.jit
+    def loss_grads(params, x):
+        def loss_fn(ps):
+            h = x
+            for w in ps:
+                h = jnp.tanh(h @ w)
+            return (h ** 2).mean()
+        return jax.value_and_grad(loss_fn)(params)
+
+    @jax.jit
+    def apply(params, opt_state, grads):
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    def step(params, opt_state):
+        loss, grads = loss_grads(params, x)
+        # the framework's out-of-jit gradient path: enqueue every leaf,
+        # negotiate, fuse, execute, synchronize
+        grads = hvd.allreduce_gradients(grads)
+        params, opt_state = apply(params, opt_state, grads)
+        return params, opt_state, loss
+
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state)
+    float(loss)
+    hvd.barrier(name="bar.train")
+    t0 = time.perf_counter()
+    for _ in range(TRAIN_STEPS):
+        params, opt_state, loss = step(params, opt_state)
+    float(loss)
+    dt = time.perf_counter() - t0
+    if rank == 0:
+        print("RESULT " + json.dumps(
+            {"steps_per_sec": round(TRAIN_STEPS / dt, 2)}), flush=True)
+    hvd.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# orchestrator
+# ---------------------------------------------------------------------------
+
+def _run_world(mode: str, size: int, timeout: float = 300.0) -> dict:
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    # The TPU plugin's sitecustomize (gated on this knob) overrides
+    # jax_platforms to "axon,cpu" at interpreter start — workers would
+    # silently compute on the tunneled TPU with ~100 ms round trips.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["HOROVOD_CONTROLLER_ADDR"] = "127.0.0.1"
+    env["HOROVOD_CONTROLLER_PORT"] = str(port)
+    env["HOROVOD_SIZE"] = str(size)
+    env.setdefault("HOROVOD_CYCLE_TIME", "1")
+    procs = []
+    for rank in range(size):
+        e = dict(env)
+        e["HOROVOD_RANK"] = str(rank)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--worker", mode, "--rank", str(rank), "--size", str(size)],
+            cwd=REPO, env=e, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT))
+    outs = []
+    for rank, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise RuntimeError(f"{mode} np={size} rank {rank} timed out")
+        outs.append(out.decode())
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"{mode} np={size} rank {rank} exited {p.returncode}:\n"
+                + outs[-1])
+    for line in outs[0].splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    raise RuntimeError(f"no RESULT line from rank 0:\n{outs[0]}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--np", type=int, default=8)
+    ap.add_argument("--worker", choices=["allreduce", "train"])
+    ap.add_argument("--rank", type=int)
+    ap.add_argument("--size", type=int)
+    args = ap.parse_args()
+
+    if args.worker:
+        {"allreduce": worker_allreduce,
+         "train": worker_train}[args.worker](args.rank, args.size)
+        return
+
+    np_ = args.np
+    print(f"== allreduce bus bandwidth (np={np_}, socket backend, "
+          f"full negotiate->fuse->execute) ==", flush=True)
+    coll = _run_world("allreduce", np_)
+    for row in coll["allreduce"]:
+        print(f"  {row['bytes']:>9} B  {row['us_per_op']:>9} us  "
+              f"alg {row['algbw_MBps']:>8} MB/s  "
+              f"bus {row['busbw_MBps']:>8} MB/s")
+    f = coll["fused"]
+    print(f"  fused {f['tensors']}x{f['bytes'] // f['tensors']} B  "
+          f"{f['us_per_batch']} us/batch  bus {f['busbw_MBps']} MB/s")
+
+    print(f"== scaling efficiency (data-parallel MLP, out-of-jit "
+          f"gradient path) ==", flush=True)
+    t1 = _run_world("train", 1)
+    tn = _run_world("train", np_)
+    eff = tn["steps_per_sec"] / t1["steps_per_sec"]
+    print(f"  np=1: {t1['steps_per_sec']} steps/s   "
+          f"np={np_}: {tn['steps_per_sec']} steps/s   "
+          f"efficiency {eff:.1%}")
+
+    out = {
+        "world_size": np_,
+        "allreduce": coll["allreduce"],
+        "fused": coll["fused"],
+        "train_steps_per_sec": {"1": t1["steps_per_sec"],
+                                str(np_): tn["steps_per_sec"]},
+        "scaling_efficiency": round(eff, 4),
+    }
+    path = os.path.join(REPO, "benchmarks", "RESULTS_cpu.json")
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=2)
+        fh.write("\n")
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -258,14 +258,16 @@ class Runtime:
             for e in entries:
                 self.timeline.start(
                     e.tensor_name, response.response_type.name)
-            # Wait for input readiness — the ReadyEvent poll
-            # (reference: operations.cc:507-518). On TPU this covers
-            # jax async dispatch still materializing the input.
+            # Input readiness: the reference polls CUDA ReadyEvents here
+            # (operations.cc:507-518) because its backends consume raw
+            # device pointers. JAX tensors are futures — every consumer
+            # (np.asarray on the socket path, device_put/jit on the mesh
+            # path) orders on the producing computation, so a blocking
+            # poll adds nothing but latency (and is_ready() from a
+            # non-main thread costs ~100 ms flat on some platforms).
+            # The QUEUE activity stays in the trace as the handoff
+            # marker between negotiation and execution.
             self.timeline.activity_start_all(names, ACT_QUEUE)
-            for e in entries:
-                if e.ready_fn is not None:
-                    while not e.ready_fn():
-                        time.sleep(100e-9)
             self.timeline.activity_end_all(names)
 
             self.timeline.activity_start_all(names, ACT_COLLECTIVE)

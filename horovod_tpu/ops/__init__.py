@@ -61,11 +61,12 @@ def _inspect(tensor):
             dev = sorted(d.id for d in tensor.devices())[0]
         except Exception:
             dev = 0
-        ready_fn = None
-        if hasattr(tensor, "is_ready"):
-            ready_fn = tensor.is_ready
+        # No ready_fn: jax arrays are futures — backends order on the
+        # producing computation via their own consumption (np.asarray /
+        # device_put), so no ReadyEvent poll is needed (and is_ready()
+        # off-thread is pathologically slow on some platforms).
         return (tensor, "jax", dev, np.dtype(tensor.dtype),
-                tuple(tensor.shape), ready_fn)
+                tuple(tensor.shape), None)
     arr = np.asarray(tensor)
     return arr, None, -1, arr.dtype, arr.shape, None
 

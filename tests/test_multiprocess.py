@@ -28,6 +28,9 @@ def run_scenario(scenario: str, size: int, timeout: float = 90.0,
     base = dict(os.environ)
     base["PYTHONPATH"] = REPO + os.pathsep + base.get("PYTHONPATH", "")
     base.setdefault("JAX_PLATFORMS", "cpu")
+    # Keep the TPU plugin's sitecustomize from overriding jax_platforms
+    # back to the tunneled TPU inside worker processes.
+    base.pop("PALLAS_AXON_POOL_IPS", None)
     if extra_env:
         base.update(extra_env)
     for rank in range(size):
