@@ -77,7 +77,7 @@ def init(comm=None, config: Optional[Config] = None) -> None:
 
         backends = [
             XlaMeshBackend(controller, config=cfg),
-            SocketBackend(controller),
+            SocketBackend(controller, secret=secret, config=cfg),
             LocalBackend(lambda: controller.size),
         ]
         op_manager = OperationManager(backends)

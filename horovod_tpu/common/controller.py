@@ -403,6 +403,12 @@ class TcpCoordinator(Controller):
             ch.send(payloads[r], TAG_DATA)
         return payloads[0]
 
+    def worker_peer_ip(self, rank: int) -> str:
+        """IP of worker ``rank`` as seen from this coordinator — the
+        address other ranks use to reach that worker's data listener
+        (ring rendezvous, ops/ring.py)."""
+        return self._channels[rank].sock.getpeername()[0]
+
     def close(self) -> None:
         for ch in self._channels.values():
             ch.close()
@@ -414,6 +420,7 @@ class TcpWorker(Controller):
 
     def __init__(self, rank: int, size: int, addr: str, port: int,
                  secret: bytes = b"", start_timeout: float = 30.0):
+        self.coordinator_addr = addr  # rank 0's reachable address
         self._ch = network.connect(addr, port, secret,
                                    timeout=start_timeout,
                                    retry_deadline=start_timeout)

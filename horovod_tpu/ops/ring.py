@@ -1,0 +1,186 @@
+"""Bandwidth-optimal ring allreduce over worker↔worker TCP links.
+
+The socket backend's star topology (gather → sum at rank 0 →
+broadcast) funnels 2(N-1)·S bytes through the coordinator per op — the
+root's NIC and memcpy loop bound the whole world. The reference never
+hits this because MPI_Allreduce internally runs ring /
+recursive-doubling algorithms (reference: mpi_operations.cc:25-84
+delegates to the MPI library). This module supplies the TCP rendering
+of that algorithm: the classic 2-phase ring (reduce-scatter then
+allgather, Baidu/NCCL style), where every rank sends and receives
+exactly 2·S·(N-1)/N bytes over point-to-point links that all run in
+parallel — aggregate bandwidth scales with N instead of collapsing
+into rank 0.
+
+Topology setup is a one-time rendezvous riding the existing control
+plane: each rank opens a data listener, ports are gathered/broadcast
+through the coordinator, rank r dials rank (r+1) mod N and accepts
+from rank (r-1) mod N. Connections authenticate with the run's HMAC
+secret (same Channel framing as the control plane). Whether the ring
+is usable is agreed world-wide through ``controller.agree`` — exactly
+like the XLA mesh backend's availability vote — so no rank can take
+the ring path while another falls back to the star.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from horovod_tpu import native as _native
+from horovod_tpu.common import logging as hlog
+from horovod_tpu.common import network
+
+_TAG_RING_HELLO = 40
+_TAG_RING_DATA = 41
+
+
+class Ring:
+    """Established ring: one channel to the next rank, one from the
+    previous. Single-threaded use per phase (the background loop)."""
+
+    def __init__(self, rank: int, size: int, next_ch: network.Channel,
+                 prev_ch: network.Channel):
+        self._rank = rank
+        self._size = size
+        self._next = next_ch
+        self._prev = prev_ch
+
+    def _exchange(self, send_bytes: bytes) -> bytes:
+        """Full-duplex step: ship ``send_bytes`` to the next rank while
+        pulling the previous rank's frame."""
+        err: List[Exception] = []
+
+        def _send():
+            try:
+                self._next.send(send_bytes, _TAG_RING_DATA)
+            except Exception as e:  # surfaced after join
+                err.append(e)
+
+        t = threading.Thread(target=_send, name="hvd-ring-send")
+        t.start()
+        try:
+            tag, data = self._prev.recv()
+        finally:
+            t.join()
+        if err:
+            raise err[0]
+        if tag != _TAG_RING_DATA:
+            raise ConnectionError(f"ring: expected data frame, got {tag}")
+        return data
+
+    def allreduce_(self, buf: np.ndarray) -> np.ndarray:
+        """In-place sum-allreduce of a flat contiguous array."""
+        n = self._size
+        r = self._rank
+        cuts = np.linspace(0, buf.size, n + 1).astype(np.int64)
+        chunks = [buf[cuts[i]:cuts[i + 1]] for i in range(n)]
+        # Phase 1: reduce-scatter. After step t, chunk (r - t - 1) holds
+        # the partial sum of t + 2 ranks; after N-1 steps chunk (r+1)
+        # is fully reduced on this rank.
+        for step in range(n - 1):
+            si = (r - step) % n
+            ri = (r - step - 1) % n
+            data = self._exchange(chunks[si].tobytes())
+            src = np.frombuffer(data, dtype=buf.dtype)
+            dst = chunks[ri]
+            if not _native.sum_into(dst, src):
+                dst += src
+        # Phase 2: allgather of the reduced chunks.
+        for step in range(n - 1):
+            si = (r + 1 - step) % n
+            ri = (r - step) % n
+            data = self._exchange(chunks[si].tobytes())
+            chunks[ri][:] = np.frombuffer(data, dtype=buf.dtype)
+        return buf
+
+    def close(self) -> None:
+        for ch in (self._next, self._prev):
+            try:
+                ch.close()
+            except Exception:
+                pass
+
+
+def establish(controller, secret: bytes = b"",
+              timeout: float = 30.0) -> Optional[Ring]:
+    """One-time ring rendezvous through the control plane. Must be
+    called at the same negotiated-response position on every rank.
+    Returns None (on every rank, by agreement) if any rank fails."""
+    rank, size = controller.rank, controller.size
+
+    # Phase A — advertise my data port. This control-plane exchange
+    # runs UNCONDITIONALLY on every rank (a rank that skipped it would
+    # hang the others in gather), advertising port -1 on local failure
+    # so the whole world skips phase B together.
+    srv = None
+    try:
+        srv = network.listen(0)
+        srv.settimeout(timeout)
+        port = srv.getsockname()[1]
+    except Exception as e:
+        hlog.warning(f"ring listen failed on rank {rank}: {e!r}")
+        port = -1
+    my = json.dumps({"port": port}).encode()
+    try:
+        gathered = controller.gather_data(my)
+        if gathered is not None:  # coordinator
+            addrs = []
+            for r in range(size):
+                p = json.loads(gathered[r].decode())["port"]
+                ip = "" if r == 0 else controller.worker_peer_ip(r)
+                addrs.append([ip, p])
+            blob = controller.broadcast_data(json.dumps(addrs).encode())
+        else:
+            blob = controller.broadcast_data(None)
+        addrs = json.loads(blob.decode())
+    except Exception as e:
+        hlog.warning(f"ring rendezvous failed on rank {rank}: {e!r}")
+        addrs = None
+
+    ring = None
+    local_ok = False
+    if addrs is not None and all(a[1] > 0 for a in addrs):
+        # Phase B — dial next, accept prev. Every listener predates
+        # every dial (the rendezvous was the barrier) so connect-then-
+        # accept cannot deadlock; accept's timeout bounds the wait if a
+        # neighbor's dial failed, and agree() below restores consensus.
+        try:
+            nxt = (rank + 1) % size
+            ip, nport = addrs[nxt]
+            if not ip:  # rank 0's data listener sits by the coordinator
+                ip = getattr(controller, "coordinator_addr", "127.0.0.1")
+            next_ch = network.connect(ip, nport, secret, timeout=timeout,
+                                      retry_deadline=timeout)
+            next_ch.send(json.dumps({"rank": rank}).encode(),
+                         _TAG_RING_HELLO)
+            sock, _ = srv.accept()
+            sock.settimeout(None)
+            prev_ch = network.Channel(sock, secret)
+            tag, hello = prev_ch.recv()
+            if tag != _TAG_RING_HELLO:
+                raise ConnectionError("ring handshake failed")
+            prev_rank = json.loads(hello.decode())["rank"]
+            if prev_rank != (rank - 1) % size:
+                raise ConnectionError(
+                    f"ring neighbor mismatch: expected "
+                    f"{(rank - 1) % size}, got {prev_rank}")
+            ring = Ring(rank, size, next_ch, prev_ch)
+            local_ok = True
+        except Exception as e:
+            hlog.warning(
+                f"ring data plane unavailable on rank {rank}: {e!r}")
+    if srv is not None:
+        try:
+            srv.close()
+        except Exception:
+            pass
+    ok = controller.agree(local_ok)
+    if not ok:
+        if ring is not None:
+            ring.close()
+        return None
+    return ring

@@ -59,11 +59,32 @@ def _restore(entry, host_result: np.ndarray):
 class SocketBackend(CollectiveBackend):
     name = "socket"
 
-    def __init__(self, controller: Controller):
+    def __init__(self, controller: Controller, secret: bytes = b"",
+                 config=None):
         self._ctl = controller
+        self._secret = secret
+        self._ring = None
+        self._ring_tried = False
+        threshold = 32 * 1024
+        if config is not None:
+            threshold = getattr(config, "ring_threshold_bytes", threshold)
+        self._ring_threshold = threshold
 
     def enabled(self, entries, response) -> bool:
         return self._ctl.size > 1
+
+    def _ring_for(self, nbytes: int):
+        """Ring data plane for large payloads: establish lazily, once,
+        at a world-consistent response position (all ranks evaluate the
+        same negotiated size against the same threshold). None => star."""
+        if self._ring_threshold < 0 or nbytes < self._ring_threshold \
+                or self._ctl.size < 3:
+            return None
+        if not self._ring_tried:
+            self._ring_tried = True
+            from horovod_tpu.ops import ring as _ring
+            self._ring = _ring.establish(self._ctl, self._secret)
+        return self._ring
 
     # -- allreduce -------------------------------------------------------
     def execute_allreduce(self, entries, response: Response) -> Status:
