@@ -1,28 +1,45 @@
-"""Collective-path microbenchmarks on a multi-process CPU world.
+"""Collective-path benchmarks on a multi-process CPU world.
 
 What the reference publishes as its value proposition is collective
 efficiency (docs/benchmarks.md; README.md:66-70 scaling efficiency).
 This bench measures THIS framework's full control+data path — enqueue →
-negotiate (TCP controller) → fuse → execute (socket backend) →
-callback — with no shortcuts:
+negotiate (TCP controller) → fuse → execute → callback — with no
+shortcuts, across its three host data planes:
 
-1. **allreduce bus bandwidth vs message size**: per-op wall time and
-   algorithm/bus bandwidth for single-tensor allreduces from 4 KiB to
-   16 MiB, plus a fused-batch point (32 x 128 KiB in one cycle —
-   exercising tensor fusion).
-2. **scaling efficiency**: steps/sec of a synthetic data-parallel
-   train step (MLP on CPU jax, gradients averaged through the
-   framework) at world size 1 vs N; efficiency = steps_N / steps_1
-   (global throughput per chip vs ideal).
+  * ``shm``   — shared-memory segment, the default for same-host worlds
+                (the TPU deployment shape: one process per chip);
+  * ``star``  — TCP socket gather→sum@0→broadcast, the universal
+                fallback (reference analog: MPI CPU ops);
+  * ``ring``  — 2-phase TCP ring for large payloads on multi-host
+                worlds (reference analog: MPI's internal ring
+                algorithms inside MPI_Allreduce).
+
+Timings are **medians** over ALLREDUCE_ITERS ops (p25/p75 recorded):
+this host is a 1-vCPU VM with bursty external interference, and means
+are dominated by the bad windows.
+
+IMPORTANT CONTEXT FOR THE SCALING NUMBERS: with ``os.cpu_count() == 1``
+an np=8 world time-shares one core, so the classic efficiency metric
+steps_N / steps_1 is bounded above by cores/np (12.5% at np=8) for any
+framework, with zero communication cost — 8x the compute now shares
+one core. RESULTS_cpu.json therefore reports, alongside the raw
+number:
+
+  * ``timeshare_ideal`` = min(cores, np)/np — the ceiling the metric
+    has on this machine;
+  * ``efficiency_vs_achievable`` = raw / ideal — how close the
+    framework gets to that ceiling (this is the number comparable to
+    the reference's published 90%, which was measured with one GPU
+    per rank, i.e. compute actually parallel);
+  * a ``fixed_compute`` scenario where the per-step compute is a
+    sleep (parallelizable even on one core, like real accelerator
+    compute) and only the gradient exchange costs CPU — isolating the
+    framework's communication overhead the way a real cluster would.
 
 Run with no arguments to orchestrate everything (spawns the worlds,
 writes benchmarks/RESULTS_cpu.json):
 
     python benchmarks/collective_bench.py [--np 8]
-
-The numbers stand in for BASELINE.json's multi-chip north star in this
-single-chip environment: the control-plane + fusion overheads measured
-here are exactly what bounds scaling efficiency on real pods.
 """
 
 from __future__ import annotations
@@ -39,8 +56,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 ALLREDUCE_SIZES = [4 << 10, 64 << 10, 1 << 20, 4 << 20, 16 << 20]
 FUSED_COUNT, FUSED_BYTES = 32, 128 << 10
-ALLREDUCE_ITERS = 20
+ALLREDUCE_ITERS = 21
 TRAIN_STEPS = 30
+FIXED_COMPUTE_S = 0.100  # simulated per-step compute (parallelizable)
+
+VARIANTS = {
+    # name -> extra env for the world
+    "shm": {},
+    "star": {"HOROVOD_TPU_SHM": "0", "HOROVOD_TPU_RING_THRESHOLD": "-1"},
+    "ring": {"HOROVOD_TPU_SHM": "0",
+             "HOROVOD_TPU_RING_THRESHOLD": "32768"},
+}
 
 
 def _free_port() -> int:
@@ -49,6 +75,12 @@ def _free_port() -> int:
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def _quantiles(xs):
+    xs = sorted(xs)
+    n = len(xs)
+    return xs[n // 4], xs[n // 2], xs[(3 * n) // 4]
 
 
 # ---------------------------------------------------------------------------
@@ -67,17 +99,20 @@ def worker_allreduce(rank: int, size: int) -> None:
         for i in range(3):
             hvd.allreduce(x, average=False, name=f"warm.{nbytes}.{i}")
         hvd.barrier(name=f"bar.{nbytes}")
-        t0 = time.perf_counter()
+        times = []
         for i in range(ALLREDUCE_ITERS):
+            t0 = time.perf_counter()
             out = hvd.allreduce(x, average=False,
                                 name=f"ar.{nbytes}.{i}")
-        dt = time.perf_counter() - t0
+            times.append(time.perf_counter() - t0)
         assert abs(float(out[0]) - sum(range(1, size + 1))) < 1e-4
-        per_op = dt / ALLREDUCE_ITERS
-        algbw = nbytes / per_op
+        p25, med, p75 = _quantiles(times)
+        algbw = nbytes / med
         results.append({
             "bytes": nbytes,
-            "us_per_op": round(per_op * 1e6, 1),
+            "us_per_op": round(med * 1e6, 1),
+            "us_p25": round(p25 * 1e6, 1),
+            "us_p75": round(p75 * 1e6, 1),
             "algbw_MBps": round(algbw / 1e6, 2),
             # ring-equivalent bus bandwidth (nccl-tests convention)
             "busbw_MBps": round(algbw * 2 * (size - 1) / size / 1e6, 2),
@@ -94,22 +129,23 @@ def worker_allreduce(rank: int, size: int) -> None:
         for h in handles:
             hvd.synchronize(h)
     hvd.barrier(name="bar.fused")
-    t0 = time.perf_counter()
+    times = []
     for rep in range(ALLREDUCE_ITERS):
+        t0 = time.perf_counter()
         handles = [hvd.allreduce_async(x, average=False,
                                        name=f"f.{rep}.{i}")
                    for i, x in enumerate(xs)]
         for h in handles:
             hvd.synchronize(h)
-    dt = time.perf_counter() - t0
+        times.append(time.perf_counter() - t0)
     total = FUSED_COUNT * FUSED_BYTES
-    per_op = dt / ALLREDUCE_ITERS
+    _, med, _ = _quantiles(times)
     fused = {
         "bytes": total, "tensors": FUSED_COUNT,
-        "us_per_batch": round(per_op * 1e6, 1),
-        "algbw_MBps": round(total / per_op / 1e6, 2),
+        "us_per_batch": round(med * 1e6, 1),
+        "algbw_MBps": round(total / med / 1e6, 2),
         "busbw_MBps": round(
-            total / per_op * 2 * (size - 1) / size / 1e6, 2),
+            total / med * 2 * (size - 1) / size / 1e6, 2),
     }
     if rank == 0:
         print("RESULT " + json.dumps(
@@ -160,14 +196,51 @@ def worker_train(rank: int, size: int) -> None:
         params, opt_state, loss = step(params, opt_state)
     float(loss)
     hvd.barrier(name="bar.train")
-    t0 = time.perf_counter()
+    times = []
     for _ in range(TRAIN_STEPS):
+        t0 = time.perf_counter()
         params, opt_state, loss = step(params, opt_state)
-    float(loss)
-    dt = time.perf_counter() - t0
+        float(loss)
+        times.append(time.perf_counter() - t0)
+    _, med, _ = _quantiles(times)
     if rank == 0:
         print("RESULT " + json.dumps(
-            {"steps_per_sec": round(TRAIN_STEPS / dt, 2)}), flush=True)
+            {"steps_per_sec": round(1.0 / med, 2)}), flush=True)
+    hvd.shutdown()
+
+
+def worker_fixed_compute(rank: int, size: int) -> None:
+    """Per-step compute is a sleep — parallelizable across ranks even on
+    one core, like real accelerator compute — so the measured slowdown
+    vs np=1 is purely the framework's communication overhead."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init()
+    grads = [np.full((256, 512), 0.1 * (rank + 1), np.float32),
+             np.full((512, 512), 0.2 * (rank + 1), np.float32),
+             np.full((512, 256), 0.3 * (rank + 1), np.float32)]
+
+    def step(i):
+        time.sleep(FIXED_COMPUTE_S)
+        handles = [hvd.allreduce_async(g, average=True,
+                                       name=f"fc.{i}.{j}")
+                   for j, g in enumerate(grads)]
+        for h in handles:
+            hvd.synchronize(h)
+
+    for i in range(3):
+        step(-1 - i)
+    hvd.barrier(name="bar.fc")
+    times = []
+    for i in range(TRAIN_STEPS):
+        t0 = time.perf_counter()
+        step(i)
+        times.append(time.perf_counter() - t0)
+    _, med, _ = _quantiles(times)
+    if rank == 0:
+        print("RESULT " + json.dumps(
+            {"steps_per_sec": round(1.0 / med, 2)}), flush=True)
     hvd.shutdown()
 
 
@@ -175,7 +248,8 @@ def worker_train(rank: int, size: int) -> None:
 # orchestrator
 # ---------------------------------------------------------------------------
 
-def _run_world(mode: str, size: int, timeout: float = 300.0) -> dict:
+def _run_world(mode: str, size: int, timeout: float = 600.0,
+               extra_env=None) -> dict:
     port = _free_port()
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -188,6 +262,8 @@ def _run_world(mode: str, size: int, timeout: float = 300.0) -> dict:
     env["HOROVOD_CONTROLLER_PORT"] = str(port)
     env["HOROVOD_SIZE"] = str(size)
     env.setdefault("HOROVOD_CYCLE_TIME", "1")
+    if extra_env:
+        env.update(extra_env)
     procs = []
     for rank in range(size):
         e = dict(env)
@@ -219,44 +295,84 @@ def _run_world(mode: str, size: int, timeout: float = 300.0) -> dict:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--np", type=int, default=8)
-    ap.add_argument("--worker", choices=["allreduce", "train"])
+    ap.add_argument("--worker",
+                    choices=["allreduce", "train", "fixed_compute"])
     ap.add_argument("--rank", type=int)
     ap.add_argument("--size", type=int)
+    ap.add_argument("--skip-variants", action="store_true",
+                    help="only bench the default (shm) data plane")
     args = ap.parse_args()
 
     if args.worker:
         {"allreduce": worker_allreduce,
-         "train": worker_train}[args.worker](args.rank, args.size)
+         "train": worker_train,
+         "fixed_compute": worker_fixed_compute}[args.worker](
+             args.rank, args.size)
         return
 
     np_ = args.np
-    print(f"== allreduce bus bandwidth (np={np_}, socket backend, "
-          f"full negotiate->fuse->execute) ==", flush=True)
-    coll = _run_world("allreduce", np_)
-    for row in coll["allreduce"]:
-        print(f"  {row['bytes']:>9} B  {row['us_per_op']:>9} us  "
-              f"alg {row['algbw_MBps']:>8} MB/s  "
-              f"bus {row['busbw_MBps']:>8} MB/s")
-    f = coll["fused"]
-    print(f"  fused {f['tensors']}x{f['bytes'] // f['tensors']} B  "
-          f"{f['us_per_batch']} us/batch  bus {f['busbw_MBps']} MB/s")
+    cores = os.cpu_count() or 1
 
-    print(f"== scaling efficiency (data-parallel MLP, out-of-jit "
-          f"gradient path) ==", flush=True)
+    sweeps = {}
+    variant_names = ["shm"] if args.skip_variants else list(VARIANTS)
+    for variant in variant_names:
+        print(f"== allreduce medians (np={np_}, data plane: {variant}) "
+              f"==", flush=True)
+        coll = _run_world("allreduce", np_, extra_env=VARIANTS[variant])
+        for row in coll["allreduce"]:
+            print(f"  {row['bytes']:>9} B  {row['us_per_op']:>10} us  "
+                  f"(p25 {row['us_p25']:>9} / p75 {row['us_p75']:>9})  "
+                  f"bus {row['busbw_MBps']:>8} MB/s", flush=True)
+        f = coll["fused"]
+        print(f"  fused {f['tensors']}x{f['bytes'] // f['tensors']} B  "
+              f"{f['us_per_batch']} us/batch  bus {f['busbw_MBps']} MB/s")
+        sweeps[variant] = coll
+
+    print(f"== scaling (data-parallel MLP, real compute on "
+          f"{cores} core(s)) ==", flush=True)
     t1 = _run_world("train", 1)
     tn = _run_world("train", np_)
     eff = tn["steps_per_sec"] / t1["steps_per_sec"]
+    ideal = min(cores, np_) / np_
     print(f"  np=1: {t1['steps_per_sec']} steps/s   "
           f"np={np_}: {tn['steps_per_sec']} steps/s   "
-          f"efficiency {eff:.1%}")
+          f"raw efficiency {eff:.1%}   "
+          f"(ceiling on this host: {ideal:.1%} — compute time-shares "
+          f"{cores} core(s); vs-achievable {min(eff / ideal, 1.0):.1%})",
+          flush=True)
+
+    print(f"== scaling (fixed {FIXED_COMPUTE_S * 1e3:.0f} ms compute — "
+          f"parallelizable, isolates comm overhead) ==", flush=True)
+    f1 = _run_world("fixed_compute", 1)
+    fn = _run_world("fixed_compute", np_)
+    fc_eff = fn["steps_per_sec"] / f1["steps_per_sec"]
+    print(f"  np=1: {f1['steps_per_sec']} steps/s   "
+          f"np={np_}: {fn['steps_per_sec']} steps/s   "
+          f"efficiency {fc_eff:.1%}", flush=True)
 
     out = {
         "world_size": np_,
-        "allreduce": coll["allreduce"],
-        "fused": coll["fused"],
+        "cpu_count": cores,
+        "allreduce": sweeps["shm"]["allreduce"],
+        "fused": sweeps["shm"]["fused"],
+        "allreduce_variants": {
+            v: sweeps[v]["allreduce"] for v in sweeps},
         "train_steps_per_sec": {"1": t1["steps_per_sec"],
                                 str(np_): tn["steps_per_sec"]},
         "scaling_efficiency": round(eff, 4),
+        "timeshare_ideal": round(ideal, 4),
+        "efficiency_vs_achievable": round(min(eff / ideal, 1.0), 4),
+        "fixed_compute_ms": FIXED_COMPUTE_S * 1e3,
+        "fixed_compute_steps_per_sec": {
+            "1": f1["steps_per_sec"], str(np_): fn["steps_per_sec"]},
+        "fixed_compute_scaling_efficiency": round(fc_eff, 4),
+        "note": (
+            "cpu_count==1 hosts time-share all ranks' compute on one "
+            "core, capping steps_N/steps_1 at timeshare_ideal for ANY "
+            "framework; fixed_compute_scaling_efficiency isolates the "
+            "framework's communication overhead with parallelizable "
+            "compute, and is the number comparable to the reference's "
+            "published scaling efficiencies (one GPU per rank)."),
     }
     path = os.path.join(REPO, "benchmarks", "RESULTS_cpu.json")
     with open(path, "w") as fh:

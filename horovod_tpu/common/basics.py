@@ -75,9 +75,13 @@ def init(comm=None, config: Optional[Config] = None) -> None:
                                    cfg.controller_port, secret=secret,
                                    start_timeout=cfg.start_timeout)
 
+        from horovod_tpu.ops.shm_ops import ShmBackend
+        socket_backend = SocketBackend(controller, secret=secret,
+                                       config=cfg)
         backends = [
             XlaMeshBackend(controller, config=cfg),
-            SocketBackend(controller, secret=secret, config=cfg),
+            ShmBackend(controller, fallback=socket_backend, config=cfg),
+            socket_backend,
             LocalBackend(lambda: controller.size),
         ]
         op_manager = OperationManager(backends)

@@ -54,6 +54,21 @@ class Config:
     fusion_threshold_bytes: int = 64 * 1024 * 1024
     cycle_time_ms: float = 5.0
 
+    # Ring data plane for the socket backend (TPU-native extension): host
+    # payloads at or above this size ride the bandwidth-optimal 2-phase
+    # ring (ops/ring.py) instead of the star through rank 0 — the TCP
+    # rendering of what MPI_Allreduce gives the reference internally
+    # (reference: mpi_operations.cc:25-84). Small messages stay on the
+    # star (2 hops beats 2(N-1) lockstep hops when latency dominates,
+    # the same size-based algorithm switch MPI/NCCL make internally).
+    # Needs >= 3 ranks; -1 disables.
+    ring_threshold_bytes: int = 1024 * 1024
+
+    # Shared-memory data plane for same-host worlds (TPU-native rendering
+    # of the reference's MPI_Win_allocate_shared staging,
+    # mpi_operations.cc:179-329). HOROVOD_TPU_SHM=0 forces sockets.
+    shm_enabled: bool = True
+
     # Hierarchical collectives (reference: operations.cc:822-841); on TPU
     # this selects ICI×DCN mesh-axis-factored collectives (read by the
     # spmd hierarchical helpers; the flat TCP/XLA backends ignore it).
@@ -110,6 +125,9 @@ class Config:
         c.fusion_threshold_bytes = _env_int(
             "HOROVOD_FUSION_THRESHOLD", c.fusion_threshold_bytes)
         c.cycle_time_ms = _env_float("HOROVOD_CYCLE_TIME", c.cycle_time_ms)
+        c.ring_threshold_bytes = _env_int(
+            "HOROVOD_TPU_RING_THRESHOLD", c.ring_threshold_bytes)
+        c.shm_enabled = _env_bool("HOROVOD_TPU_SHM", c.shm_enabled)
         c.hierarchical_allreduce = _env_bool(
             "HOROVOD_HIERARCHICAL_ALLREDUCE", c.hierarchical_allreduce)
         c.hierarchical_allgather = _env_bool(

@@ -43,6 +43,15 @@ TAG_RESPONSES = 3   # coordinator -> worker: serialized ResponseList
 TAG_DATA = 4        # data-plane payload (socket fallback backend)
 
 
+def _as_buffer(payload):
+    """Normalize a data-plane payload to a flat byte view. Callers may
+    pass numpy arrays straight through (zero-copy send path); the
+    control plane still deals in bytes."""
+    if payload is None or isinstance(payload, (bytes, bytearray)):
+        return payload
+    return memoryview(payload).cast("B")
+
+
 class Topology:
     """World/local/cross identity of this process
     (reference: global_state.h:95-118)."""
@@ -356,6 +365,7 @@ class TcpCoordinator(Controller):
         return payload
 
     def gather_data(self, payload: bytes) -> Optional[List[bytes]]:
+        payload = _as_buffer(payload)
         if self._native is not None:
             return self._native_gather(payload, TAG_DATA)
         out: List[bytes] = [b""] * self._size
@@ -370,6 +380,7 @@ class TcpCoordinator(Controller):
 
     def broadcast_data(self, payload: Optional[bytes],
                        root_rank: int = 0) -> bytes:
+        payload = _as_buffer(payload)
         if root_rank != 0:
             # Pull the payload up from the root, then fan out to
             # everyone EXCEPT the root — it already has the bytes, and
@@ -444,11 +455,12 @@ class TcpWorker(Controller):
         return data
 
     def gather_data(self, payload: bytes) -> Optional[List[bytes]]:
-        self._ch.send(payload, TAG_DATA)
+        self._ch.send(_as_buffer(payload), TAG_DATA)
         return None
 
     def broadcast_data(self, payload: Optional[bytes],
                        root_rank: int = 0) -> bytes:
+        payload = _as_buffer(payload)
         if payload is not None and self.rank == root_rank:
             # Root sends up; the coordinator fans out to the others
             # only — our own copy is already authoritative.

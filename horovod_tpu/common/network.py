@@ -22,16 +22,34 @@ from typing import Optional, Tuple
 
 _HDR = struct.Struct("<IB")
 _DIGEST_LEN = 32
+# Below this size, frames go out as one concatenated sendall (one
+# packet); above it the header and payload are sent separately so the
+# payload never has to be copied into a fresh bytes object. Large frames
+# are the data plane's hot path — on a CPU-bound host the avoided memcpy
+# is a measurable fraction of per-op cost.
+_INLINE_SEND = 16 * 1024
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if r == 0:
             raise ConnectionError("socket closed while reading")
-        buf.extend(chunk)
+        got += r
     return bytes(buf)
+
+
+def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    got = 0
+    n = len(view)
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if r == 0:
+            raise ConnectionError("socket closed while reading")
+        got += r
 
 
 class Channel:
@@ -47,14 +65,28 @@ class Channel:
         except OSError:
             pass
 
-    def send(self, payload: bytes, tag: int = 0) -> None:
-        hdr = _HDR.pack(len(payload), tag)
+    def send(self, payload, tag: int = 0) -> None:
+        """``payload`` is any C-contiguous buffer (bytes, bytearray,
+        memoryview, numpy array) — large buffers are written straight
+        from their memory, never copied into a bytes object."""
+        if not isinstance(payload, (bytes, bytearray)):
+            payload = memoryview(payload).cast("B")
+        n = len(payload)
+        hdr = _HDR.pack(n, tag)
         if self.secret:
-            digest = hmac.new(self.secret, bytes([tag]) + payload,
-                              hashlib.sha256).digest()
-            self.sock.sendall(hdr + digest + payload)
+            h = hmac.new(self.secret, bytes((tag,)), hashlib.sha256)
+            h.update(payload)
+            digest = h.digest()
+            if n <= _INLINE_SEND:
+                self.sock.sendall(b"".join((hdr, digest, payload)))
+            else:
+                self.sock.sendall(hdr + digest)
+                self.sock.sendall(payload)
+        elif n <= _INLINE_SEND:
+            self.sock.sendall(b"".join((hdr, payload)))
         else:
-            self.sock.sendall(hdr + payload)
+            self.sock.sendall(hdr)
+            self.sock.sendall(payload)
 
     def recv(self) -> Tuple[int, bytes]:
         hdr = _recv_exact(self.sock, _HDR.size)
@@ -69,6 +101,27 @@ class Channel:
             return tag, payload
         payload = _recv_exact(self.sock, n)
         return tag, payload
+
+    def recv_into(self, buf) -> Tuple[int, int]:
+        """Receive one frame directly into a writable buffer (zero-copy
+        data-plane path; ops/ring.py). The frame must fit exactly or be
+        smaller. Returns (tag, payload_nbytes)."""
+        hdr = _recv_exact(self.sock, _HDR.size)
+        n, tag = _HDR.unpack(hdr)
+        view = memoryview(buf).cast("B")
+        if n > len(view):
+            raise ConnectionError(
+                f"frame of {n} bytes overflows {len(view)}-byte buffer")
+        if self.secret:
+            digest = _recv_exact(self.sock, _DIGEST_LEN)
+            _recv_exact_into(self.sock, view[:n])
+            h = hmac.new(self.secret, bytes((tag,)), hashlib.sha256)
+            h.update(view[:n])
+            if not hmac.compare_digest(digest, h.digest()):
+                raise ConnectionError("HMAC authentication failed")
+        else:
+            _recv_exact_into(self.sock, view[:n])
+        return tag, n
 
     def close(self) -> None:
         try:

@@ -153,6 +153,7 @@ class Runtime:
                 if entry.callback:
                     entry.callback(Status.Aborted(SHUT_DOWN_ERROR))
             self.timeline.shutdown()
+            self.op_manager.close()
             try:
                 self.controller.close()
             except Exception:

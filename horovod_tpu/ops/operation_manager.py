@@ -29,6 +29,17 @@ class OperationManager:
         for b in self._backends:
             b.finalizer = finalizer
 
+    def close(self) -> None:
+        """Release backend resources (ring channels, shm mappings) at
+        shutdown."""
+        for b in self._backends:
+            close = getattr(b, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
+
     def _pick(self, entries, response) -> CollectiveBackend:
         for b in self._backends:
             if b.enabled(entries, response):

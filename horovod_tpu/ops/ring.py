@@ -49,28 +49,33 @@ class Ring:
         self._next = next_ch
         self._prev = prev_ch
 
-    def _exchange(self, send_bytes: bytes) -> bytes:
-        """Full-duplex step: ship ``send_bytes`` to the next rank while
-        pulling the previous rank's frame."""
+    def _exchange_into(self, send_arr: np.ndarray,
+                       recv_arr: np.ndarray) -> None:
+        """Full-duplex step: ship ``send_arr`` to the next rank while
+        filling ``recv_arr`` from the previous rank. Both are contiguous
+        numpy views — nothing is copied through intermediate bytes."""
         err: List[Exception] = []
 
         def _send():
             try:
-                self._next.send(send_bytes, _TAG_RING_DATA)
+                self._next.send(send_arr, _TAG_RING_DATA)
             except Exception as e:  # surfaced after join
                 err.append(e)
 
         t = threading.Thread(target=_send, name="hvd-ring-send")
         t.start()
         try:
-            tag, data = self._prev.recv()
+            tag, nbytes = self._prev.recv_into(recv_arr)
         finally:
             t.join()
         if err:
             raise err[0]
         if tag != _TAG_RING_DATA:
             raise ConnectionError(f"ring: expected data frame, got {tag}")
-        return data
+        if nbytes != recv_arr.nbytes:
+            raise ConnectionError(
+                f"ring: expected {recv_arr.nbytes}-byte chunk, "
+                f"got {nbytes}")
 
     def allreduce_(self, buf: np.ndarray) -> np.ndarray:
         """In-place sum-allreduce of a flat contiguous array."""
@@ -78,24 +83,46 @@ class Ring:
         r = self._rank
         cuts = np.linspace(0, buf.size, n + 1).astype(np.int64)
         chunks = [buf[cuts[i]:cuts[i + 1]] for i in range(n)]
+        scratch = np.empty(max(c.size for c in chunks), dtype=buf.dtype)
         # Phase 1: reduce-scatter. After step t, chunk (r - t - 1) holds
         # the partial sum of t + 2 ranks; after N-1 steps chunk (r+1)
         # is fully reduced on this rank.
         for step in range(n - 1):
             si = (r - step) % n
             ri = (r - step - 1) % n
-            data = self._exchange(chunks[si].tobytes())
-            src = np.frombuffer(data, dtype=buf.dtype)
             dst = chunks[ri]
+            src = scratch[:dst.size]
+            self._exchange_into(chunks[si], src)
             if not _native.sum_into(dst, src):
                 dst += src
-        # Phase 2: allgather of the reduced chunks.
+        # Phase 2: allgather of the reduced chunks, received in place.
         for step in range(n - 1):
             si = (r + 1 - step) % n
             ri = (r - step) % n
-            data = self._exchange(chunks[si].tobytes())
-            chunks[ri][:] = np.frombuffer(data, dtype=buf.dtype)
+            self._exchange_into(chunks[si], chunks[ri])
         return buf
+
+    def reduce_scatter_(self, buf: np.ndarray) -> np.ndarray:
+        """Phase-1-only ring over ``size`` equal flat chunks; returns a
+        view of the fully-reduced chunk this rank owns (chunk index ==
+        rank, matching reducescatter's dim-0 partitioning). ``buf.size``
+        must divide evenly by the world size."""
+        n = self._size
+        r = self._rank
+        chunk = buf.size // n
+        chunks = [buf[i * chunk:(i + 1) * chunk] for i in range(n)]
+        scratch = np.empty(chunk, dtype=buf.dtype)
+        # Schedule shifted one slot vs allreduce_'s phase 1 so the chunk
+        # that ends fully reduced on rank r is chunk r itself.
+        for step in range(n - 1):
+            si = (r - step - 1) % n
+            ri = (r - step - 2) % n
+            dst = chunks[ri]
+            src = scratch[:dst.size]
+            self._exchange_into(chunks[si], src)
+            if not _native.sum_into(dst, src):
+                dst += src
+        return chunks[r]
 
     def close(self) -> None:
         for ch in (self._next, self._prev):

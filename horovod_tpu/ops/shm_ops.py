@@ -1,0 +1,359 @@
+"""Shared-memory data plane for intra-host worlds.
+
+When every rank lives on one host (one process per TPU chip is the
+normal deployment shape), tensors should move through RAM, not through
+the loopback TCP stack. The reference does exactly this where it
+matters most: ``MPIHierarchicalAllgather`` stages node-local data in an
+``MPI_Win_allocate_shared`` window and lets ranks memcpy in and out of
+it (reference: horovod/common/ops/mpi_operations.cc:179-329). This
+backend is the standalone rendering of that idea: one POSIX shared
+memory segment per world, negotiated through the existing TCP control
+plane, carrying every collective's payload at memcpy speed.
+
+Layout is fixed per segment generation so concurrent ops can never
+alias each other across a cycle boundary:
+
+    [ slot 0 | slot 1 | ... | slot N-1 | out region (N slots wide) ]
+
+with every slot ``stride`` bytes (page-padded to the largest negotiated
+payload so far; the segment re-establishes and grows when an op
+outgrows it). Invariants that make the single ready/done round trip
+per op safe:
+
+  * a rank writes ONLY its own slot, and only at the start of its own
+    execute — which is provably after it finished reading the previous
+    op's result;
+  * the out region is written ONLY by the coordinator, ONLY between
+    the ready-gather completing (all ranks wrote + stopped reading)
+    and the done-broadcast;
+  * results are copied out of the segment before the op returns, so
+    user-visible outputs never alias shared pages.
+
+The segment file is unlinked immediately after the establishment
+rendezvous (the mappings keep the memory alive), so no /dev/shm litter
+survives a crash. Establishment failure on any rank is agreed
+world-wide (``controller.agree``) and degrades every rank to the
+socket backend together — same pattern as the ring data plane
+(ops/ring.py).
+"""
+
+from __future__ import annotations
+
+import json
+import mmap
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from horovod_tpu import native as _native
+from horovod_tpu.common import logging as hlog
+from horovod_tpu.common.message import Response
+from horovod_tpu.common.status import Status
+from horovod_tpu.ops.backend import CollectiveBackend
+from horovod_tpu.ops.socket_ops import (
+    _pack_fused, _restore, _to_numpy, _unpack_fused,
+)
+
+_PAGE = 4096
+
+
+def _pad(nbytes: int) -> int:
+    return -(-max(nbytes, 1) // _PAGE) * _PAGE
+
+
+class ShmBackend(CollectiveBackend):
+    name = "shm"
+
+    def __init__(self, controller, fallback: CollectiveBackend,
+                 config=None):
+        self._ctl = controller
+        self._fallback = fallback
+        self._map: Optional[mmap.mmap] = None
+        self._stride = 0
+        self._gen = 0
+        self._dead = False
+        want = True if config is None else getattr(config, "shm_enabled",
+                                                   True)
+        self._opt_in = want and os.path.isdir("/dev/shm")
+
+    def enabled(self, entries, response) -> bool:
+        # Same-host check makes every per-host property (e.g. /dev/shm
+        # availability) automatically world-consistent.
+        t = getattr(self._ctl, "topology", None)
+        return (self._opt_in and not self._dead and t is not None
+                and t.size > 1 and t.local_size == t.size)
+
+    # -- segment lifecycle -------------------------------------------------
+
+    def _segment_for(self, nbytes: int) -> Optional[Tuple[mmap.mmap, int]]:
+        """Return (mmap, stride) able to hold one ``nbytes`` payload per
+        slot, (re)establishing through the control plane when the
+        current segment is too small. All ranks call this at the same
+        negotiated response position with the same ``nbytes``."""
+        stride = _pad(nbytes)
+        if self._map is not None and self._stride >= stride:
+            return self._map, self._stride
+        ctl = self._ctl
+        # Grow generously so streams of slightly-increasing sizes don't
+        # re-establish every op.
+        stride = _pad(max(stride, 2 * self._stride))
+        total = stride * ctl.size * 2
+        self._gen += 1
+        new_map = None
+        path = ""
+        ok = False
+        if ctl.is_coordinator:
+            ctl.gather_data(b"")  # everyone reached establishment
+            path = f"/dev/shm/hvdtpu-{os.getpid()}-{self._gen}"
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL,
+                             0o600)
+                try:
+                    os.ftruncate(fd, total)
+                    new_map = mmap.mmap(fd, total)
+                finally:
+                    os.close(fd)
+                ok = True
+            except OSError as e:
+                hlog.warning(f"shm segment create failed: {e!r}", rank=0)
+            ctl.broadcast_data(json.dumps(
+                {"path": path if ok else "", "total": total}).encode())
+        else:
+            ctl.gather_data(b"")
+            info = json.loads(bytes(ctl.broadcast_data(None)).decode())
+            if info["path"]:
+                try:
+                    fd = os.open(info["path"], os.O_RDWR)
+                    try:
+                        new_map = mmap.mmap(fd, info["total"])
+                    finally:
+                        os.close(fd)
+                    ok = True
+                except OSError as e:
+                    hlog.warning(
+                        f"shm segment open failed: {e!r}", rank=ctl.rank)
+        agreed = ctl.agree(ok)
+        if ctl.is_coordinator and path:
+            # Every rank holds a mapping (or we are tearing down); the
+            # name can go away now — crash-safe cleanup.
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        if not agreed:
+            for m in (new_map, self._map):
+                if m is not None:
+                    try:
+                        m.close()
+                    except (BufferError, ValueError):
+                        pass
+            self._map = None
+            self._dead = True
+            hlog.warning("shm data plane unavailable; falling back to "
+                         "the socket backend", rank=ctl.rank)
+            return None
+        old = self._map
+        self._map = new_map
+        self._stride = stride
+        if old is not None:
+            # Rendezvous above was a barrier: nobody still reads old.
+            try:
+                old.close()
+            except (BufferError, ValueError):
+                pass
+        return self._map, self._stride
+
+    def _view(self, offset: int, dtype, count: int) -> np.ndarray:
+        return np.frombuffer(self._map, dtype=dtype, count=count,
+                             offset=offset)
+
+    def close(self) -> None:
+        if self._map is not None:
+            try:
+                self._map.close()
+            except (BufferError, ValueError):
+                pass
+            self._map = None
+
+    # -- collectives ---------------------------------------------------------
+
+    def execute_allreduce(self, entries, response: Response) -> Status:
+        ctl = self._ctl
+        arrays = [_to_numpy(e.tensor) for e in entries]
+        dtype = arrays[0].dtype
+        fused, _ = _pack_fused(arrays, response)
+        seg = self._segment_for(fused.nbytes)
+        if seg is None:
+            return self._fallback.execute_allreduce(entries, response)
+        _, stride = seg
+        out_off = ctl.size * stride
+        if ctl.is_coordinator:
+            ctl.gather_data(b"")  # all slots written
+            out = self._view(out_off, dtype, fused.size)
+            out[:] = fused
+            for r in range(1, ctl.size):
+                src = self._view(r * stride, dtype, fused.size)
+                if not _native.sum_into(out, src):
+                    out += src
+            ctl.broadcast_data(b"")
+            result = out.copy()
+        else:
+            slot = self._view(ctl.rank * stride, dtype, fused.size)
+            slot[:] = fused
+            ctl.gather_data(b"")
+            ctl.broadcast_data(None)
+            result = self._view(out_off, dtype, fused.size).copy()
+        _unpack_fused(entries, arrays, result, response)
+        return Status.OK()
+
+    def execute_allgather(self, entries, response: Response) -> Status:
+        ctl = self._ctl
+        (entry,) = entries
+        arr = np.ascontiguousarray(_to_numpy(entry.tensor))
+        rows = list(response.tensor_sizes)
+        row_elems = int(np.prod(arr.shape[1:], dtype=np.int64)) \
+            if arr.ndim > 1 else 1
+        itemsize = arr.dtype.itemsize
+        seg = self._segment_for(max(rows) * row_elems * itemsize)
+        if seg is None:
+            return self._fallback.execute_allgather(entries, response)
+        _, stride = seg
+        out_off = ctl.size * stride
+        total_elems = sum(rows) * row_elems
+        if ctl.is_coordinator:
+            ctl.gather_data(b"")
+            out = self._view(out_off, arr.dtype, total_elems)
+            pos = 0
+            for r in range(ctl.size):
+                n = rows[r] * row_elems
+                if r == 0:
+                    out[pos:pos + n] = arr.reshape(-1)
+                else:
+                    out[pos:pos + n] = self._view(r * stride, arr.dtype, n)
+                pos += n
+            ctl.broadcast_data(b"")
+            result = out.copy()
+        else:
+            slot = self._view(ctl.rank * stride, arr.dtype,
+                              arr.size)
+            slot[:] = arr.reshape(-1)
+            ctl.gather_data(b"")
+            ctl.broadcast_data(None)
+            result = self._view(out_off, arr.dtype, total_elems).copy()
+        out_shape = (sum(rows),) + arr.shape[1:]
+        entry.output = _restore(entry, result.reshape(out_shape))
+        return Status.OK()
+
+    def execute_broadcast(self, entries, response: Response) -> Status:
+        ctl = self._ctl
+        (entry,) = entries
+        orig = _to_numpy(entry.tensor)
+        arr = np.ascontiguousarray(orig)
+        seg = self._segment_for(arr.nbytes)
+        if seg is None:
+            return self._fallback.execute_broadcast(entries, response)
+        _, stride = seg
+        out_off = ctl.size * stride
+        root = entry.root_rank
+        if ctl.rank == root and not ctl.is_coordinator:
+            slot = self._view(ctl.rank * stride, arr.dtype, arr.size)
+            slot[:] = arr.reshape(-1)
+        if ctl.is_coordinator:
+            ctl.gather_data(b"")
+            out = self._view(out_off, arr.dtype, arr.size)
+            if root == 0:
+                out[:] = arr.reshape(-1)
+            else:
+                out[:] = self._view(root * stride, arr.dtype, arr.size)
+            ctl.broadcast_data(b"")
+        else:
+            ctl.gather_data(b"")
+            ctl.broadcast_data(None)
+        result = self._view(out_off, arr.dtype, arr.size).copy()
+        entry.output = _restore(entry, result.reshape(orig.shape))
+        return Status.OK()
+
+    def execute_alltoall(self, entries, response: Response) -> Status:
+        ctl = self._ctl
+        (entry,) = entries
+        arr = np.ascontiguousarray(_to_numpy(entry.tensor))
+        seg = self._segment_for(arr.nbytes)
+        if seg is None:
+            return self._fallback.execute_alltoall(entries, response)
+        _, stride = seg
+        size = ctl.size
+        out_off = size * stride
+        per_elems = (arr.shape[0] // size) * (
+            int(np.prod(arr.shape[1:], dtype=np.int64))
+            if arr.ndim > 1 else 1)
+        if ctl.is_coordinator:
+            ctl.gather_data(b"")
+            flat0 = arr.reshape(-1)
+            # destination d's block lands at out_off + d*stride, source
+            # blocks concatenated in rank order.
+            for d in range(size):
+                dst = self._view(out_off + d * stride, arr.dtype,
+                                 size * per_elems)
+                for s in range(size):
+                    blk = (flat0[d * per_elems:(d + 1) * per_elems]
+                           if s == 0 else
+                           self._view(s * stride, arr.dtype,
+                                      arr.size)[d * per_elems:
+                                                (d + 1) * per_elems])
+                    dst[s * per_elems:(s + 1) * per_elems] = blk
+            ctl.broadcast_data(b"")
+        else:
+            slot = self._view(ctl.rank * stride, arr.dtype, arr.size)
+            slot[:] = arr.reshape(-1)
+            ctl.gather_data(b"")
+            ctl.broadcast_data(None)
+        result = self._view(out_off + ctl.rank * stride, arr.dtype,
+                            size * per_elems).copy()
+        entry.output = _restore(entry, result.reshape(arr.shape))
+        return Status.OK()
+
+    def execute_reducescatter(self, entries, response: Response) -> Status:
+        ctl = self._ctl
+        (entry,) = entries
+        arr = np.ascontiguousarray(_to_numpy(entry.tensor))
+        if response.prescale_factor != 1.0:
+            arr = arr * np.asarray(response.prescale_factor, arr.dtype)
+        seg = self._segment_for(arr.nbytes)
+        if seg is None:
+            return self._fallback.execute_reducescatter(entries, response)
+        _, stride = seg
+        size = ctl.size
+        out_off = size * stride
+        per_rank = arr.shape[0] // size
+        per_elems = per_rank * (int(np.prod(arr.shape[1:],
+                                            dtype=np.int64))
+                                if arr.ndim > 1 else 1)
+        if ctl.is_coordinator:
+            ctl.gather_data(b"")
+            out = self._view(out_off, arr.dtype, arr.size)
+            out[:] = arr.reshape(-1)
+            for r in range(1, size):
+                src = self._view(r * stride, arr.dtype, arr.size)
+                if not _native.sum_into(out, src):
+                    out += src
+            ctl.broadcast_data(b"")
+        else:
+            slot = self._view(ctl.rank * stride, arr.dtype, arr.size)
+            slot[:] = arr.reshape(-1)
+            ctl.gather_data(b"")
+            ctl.broadcast_data(None)
+        result = self._view(out_off + ctl.rank * per_elems *
+                            arr.dtype.itemsize, arr.dtype,
+                            per_elems).copy()
+        result = result.reshape((per_rank,) + arr.shape[1:])
+        if response.postscale_factor != 1.0:
+            result = result * np.asarray(response.postscale_factor,
+                                         arr.dtype)
+        entry.output = _restore(entry, result)
+        return Status.OK()
+
+    def execute_barrier(self, entries, response: Response) -> Status:
+        # A barrier moves no payload; the socket backend's tiny
+        # gather/broadcast round IS the barrier.
+        return self._fallback.execute_barrier(entries, response)

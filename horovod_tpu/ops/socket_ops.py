@@ -56,22 +56,57 @@ def _restore(entry, host_result: np.ndarray):
     return host_result
 
 
+def _pack_fused(arrays: List[np.ndarray], response: Response):
+    """Fusion-buffer pack shared by the host backends (reference:
+    ops/collective_operations.cc:35-63). Returns (flat, fresh): ``fresh``
+    is True when ``flat`` is known not to alias a caller tensor (safe to
+    mutate in place). Single-tensor packs skip the copy, like the
+    reference's MPI_IN_PLACE path (mpi_operations.cc:44-47)."""
+    dtype = arrays[0].dtype
+    fresh = len(arrays) > 1
+    if len(arrays) == 1:
+        flat = np.ascontiguousarray(arrays[0]).reshape(-1)
+    else:
+        flat = np.concatenate([a.reshape(-1) for a in arrays])
+    if response.prescale_factor != 1.0:
+        flat = flat * np.asarray(response.prescale_factor, dtype)
+        fresh = True
+    return flat, fresh
+
+
+def _unpack_fused(entries, arrays, result: np.ndarray, response: Response):
+    """Per-entry unpack of a fused result + postscale (the reference's
+    MemcpyOutFusionBuffer, collective_operations.cc:35-63). ``result``
+    must be safe for entries to alias (fresh or already copied)."""
+    if response.postscale_factor != 1.0:
+        result = result * np.asarray(response.postscale_factor,
+                                     result.dtype)
+    offset = 0
+    for e, a in zip(entries, arrays):
+        n = a.size
+        e.output = _restore(e, result[offset:offset + n].reshape(a.shape))
+        offset += n
+
+
 class SocketBackend(CollectiveBackend):
     name = "socket"
 
     def __init__(self, controller: Controller, secret: bytes = b"",
                  config=None):
+        from horovod_tpu.common.config import Config
         self._ctl = controller
         self._secret = secret
         self._ring = None
         self._ring_tried = False
-        threshold = 32 * 1024
-        if config is not None:
-            threshold = getattr(config, "ring_threshold_bytes", threshold)
-        self._ring_threshold = threshold
+        self._ring_threshold = (config or Config()).ring_threshold_bytes
 
     def enabled(self, entries, response) -> bool:
         return self._ctl.size > 1
+
+    def close(self) -> None:
+        if self._ring is not None:
+            self._ring.close()
+            self._ring = None
 
     def _ring_for(self, nbytes: int):
         """Ring data plane for large payloads: establish lazily, once,
@@ -91,36 +126,33 @@ class SocketBackend(CollectiveBackend):
         ctl = self._ctl
         arrays = [_to_numpy(e.tensor) for e in entries]
         dtype = arrays[0].dtype
-        # Pack into the fusion buffer (single-tensor case skips the copy,
-        # like the reference's MPI_IN_PLACE path, mpi_operations.cc:44-47).
-        if len(arrays) == 1:
-            fused = np.ascontiguousarray(arrays[0]).reshape(-1)
+        fused, fresh = _pack_fused(arrays, response)
+
+        # Large payloads ride the ring (every rank computes the same
+        # negotiated size, so the path choice is world-consistent).
+        ring = self._ring_for(fused.nbytes)
+        if ring is not None:
+            # allreduce is not in-place at the API: never mutate a buffer
+            # that may alias the caller's tensor.
+            buf = fused if (fresh and fused.flags.writeable) \
+                else fused.copy()
+            result = ring.allreduce_(buf)
         else:
-            fused = np.concatenate([a.reshape(-1) for a in arrays])
-        if response.prescale_factor != 1.0:
-            fused = fused * np.asarray(response.prescale_factor, dtype)
+            gathered = ctl.gather_data(fused)
+            if gathered is not None:  # coordinator
+                # gathered[0] is our own fused view — sum into a fresh
+                # buffer so the caller's tensor is never mutated.
+                acc = np.array(fused, dtype=dtype, copy=True)
+                for data in gathered[1:]:
+                    src = np.frombuffer(data, dtype=dtype)
+                    if not _native.sum_into(acc, src):
+                        acc += src
+                ctl.broadcast_data(acc)
+                result = acc
+            else:
+                result = _np_from_bytes(ctl.broadcast_data(None), dtype)
 
-        gathered = ctl.gather_data(fused.tobytes())
-        if gathered is not None:  # coordinator
-            acc = np.frombuffer(bytearray(gathered[0]), dtype=dtype)
-            for data in gathered[1:]:
-                src = np.frombuffer(data, dtype=dtype)
-                if not _native.sum_into(acc, src):
-                    acc += src
-            result = _np_from_bytes(
-                ctl.broadcast_data(acc.tobytes()), dtype)
-        else:
-            result = _np_from_bytes(ctl.broadcast_data(None), dtype)
-
-        if response.postscale_factor != 1.0:
-            result = result * np.asarray(response.postscale_factor, dtype)
-
-        offset = 0
-        for e, a in zip(entries, arrays):
-            n = a.size
-            out = result[offset:offset + n].reshape(a.shape)
-            e.output = _restore(e, out)
-            offset += n
+        _unpack_fused(entries, arrays, result, response)
         return Status.OK()
 
     # -- allgather -------------------------------------------------------
@@ -128,7 +160,7 @@ class SocketBackend(CollectiveBackend):
         ctl = self._ctl
         (entry,) = entries  # allgather responses are not fused (parity)
         arr = np.ascontiguousarray(_to_numpy(entry.tensor))
-        gathered = ctl.gather_data(arr.tobytes())
+        gathered = ctl.gather_data(arr)
         if gathered is not None:
             blob = b"".join(gathered)
             result = _np_from_bytes(ctl.broadcast_data(blob), arr.dtype)
@@ -184,11 +216,26 @@ class SocketBackend(CollectiveBackend):
         ctl = self._ctl
         (entry,) = entries
         arr = np.ascontiguousarray(_to_numpy(entry.tensor))
+        fresh = False
         if response.prescale_factor != 1.0:
             arr = arr * np.asarray(response.prescale_factor, arr.dtype)
-        gathered = ctl.gather_data(arr.tobytes())
+            fresh = True
         size = ctl.size
         per_rank = arr.shape[0] // size
+        ring = self._ring_for(arr.nbytes) \
+            if arr.shape[0] % size == 0 else None
+        if ring is not None:
+            flat = arr.reshape(-1)
+            buf = flat if (fresh and flat.flags.writeable) \
+                else flat.copy()
+            result = ring.reduce_scatter_(buf).reshape(
+                (per_rank,) + arr.shape[1:])
+            if response.postscale_factor != 1.0:
+                result = result * np.asarray(response.postscale_factor,
+                                             arr.dtype)
+            entry.output = _restore(entry, result)
+            return Status.OK()
+        gathered = ctl.gather_data(arr.tobytes())
         if gathered is not None:
             acc = np.frombuffer(bytearray(gathered[0]), dtype=arr.dtype)
             for data in gathered[1:]:

@@ -85,6 +85,243 @@ def scenario_reducescatter(hvd, rank, size):
     np.testing.assert_allclose(out, expected)
 
 
+def scenario_ring_allreduce(hvd, rank, size):
+    """Payloads over the (harness-lowered) threshold ride the ring data
+    plane; small ones keep the star; reducescatter reuses the same ring.
+    (Reference analog: MPI_Allreduce's internal ring algorithms,
+    mpi_operations.cc:25-84.)"""
+    from horovod_tpu.common import basics as _b
+    ssum = sum(range(1, size + 1))
+
+    n = 100_000
+    x = np.arange(n, dtype=np.float64) + rank
+    out = hvd.allreduce(x, average=False, name="ring.big")
+    np.testing.assert_allclose(
+        out, size * np.arange(n, dtype=np.float64) + sum(range(size)))
+
+    rt = _b.runtime()
+    sock = [b for b in rt.op_manager._backends if b.name == "socket"][0]
+    assert sock._ring is not None, "ring was not established"
+
+    # below threshold -> star path, after the ring already exists
+    y = np.full(8, float(rank + 1), np.float32)
+    np.testing.assert_allclose(
+        hvd.allreduce(y, average=False, name="ring.small"), ssum)
+
+    # non-in-place contract: the caller's array must survive the ring
+    z = np.full(50_000, float(rank + 1), np.float32)
+    out = hvd.allreduce(z, average=True, name="ring.big2")
+    np.testing.assert_allclose(out, ssum / size)
+    np.testing.assert_allclose(z, float(rank + 1))
+
+    # fused batch over the threshold -> one ring op for the whole pack
+    handles = [hvd.allreduce_async(
+        np.full(20_000, float(rank + 1) * (i + 1), np.float64),
+        average=False, name=f"ring.f/{i}") for i in range(4)]
+    for i, h in enumerate(handles):
+        np.testing.assert_allclose(
+            hvd.synchronize(h), ssum * (i + 1))
+
+    # reducescatter on the same ring (phase-1-only schedule)
+    per = 4096
+    rs = np.arange(size * per, dtype=np.float64) * (rank + 1)
+    out = hvd.reducescatter(rs, name="ring.rs")
+    expected = (np.arange(size * per, dtype=np.float64)
+                * ssum)[rank * per:(rank + 1) * per]
+    np.testing.assert_allclose(out, expected)
+
+
+def scenario_ring_fallback(hvd, rank, size):
+    """Ring establishment failing on ONE rank must degrade the whole
+    world to the star path by agreement (ops/ring.py establish():
+    port -1 advertisement + agree()) — no divergence, results correct."""
+    from horovod_tpu.common import basics as _b
+    from horovod_tpu.common import network as _net
+
+    orig_listen = _net.listen
+    if rank == 1:
+        def _fail(*a, **k):
+            raise OSError("forced listen failure (test)")
+        _net.listen = _fail
+
+    x = np.full(100_000, float(rank + 1), np.float64)
+    out = hvd.allreduce(x, average=False, name="rf.big")
+    np.testing.assert_allclose(out, sum(range(1, size + 1)))
+
+    rt = _b.runtime()
+    sock = [b for b in rt.op_manager._backends if b.name == "socket"][0]
+    assert sock._ring_tried, "ring establishment was never attempted"
+    assert sock._ring is None, "ring must not exist after a failed vote"
+
+    _net.listen = orig_listen
+    # the world stays on the star path (establishment is tried once)
+    out = hvd.allreduce(x, average=False, name="rf.big2")
+    np.testing.assert_allclose(out, sum(range(1, size + 1)))
+
+
+def scenario_shm_collectives(hvd, rank, size):
+    """All five collectives + fused batch + segment growth on the
+    shared-memory backend (same-host world selects it automatically)."""
+    from horovod_tpu.common import basics as _b
+    rt = _b.runtime()
+    shm = [b for b in rt.op_manager._backends if b.name == "shm"][0]
+    ssum = sum(range(1, size + 1))
+
+    # allreduce (small -> establishes the first segment)
+    x = np.full((4, 3), float(rank + 1), np.float32)
+    np.testing.assert_allclose(
+        hvd.allreduce(x, average=False, name="shm.ar"),
+        np.full((4, 3), ssum, np.float32))
+    assert shm._map is not None, "shm segment not established"
+    gen0 = shm._gen
+
+    # large allreduce -> segment must grow (re-establishment)
+    big = np.arange(300_000, dtype=np.float64) + rank
+    np.testing.assert_allclose(
+        hvd.allreduce(big, average=False, name="shm.big"),
+        size * np.arange(300_000, dtype=np.float64) + sum(range(size)))
+    assert shm._gen > gen0, "segment did not grow for the larger payload"
+
+    # input must never be mutated (slots are written, results copied out)
+    np.testing.assert_allclose(big, np.arange(300_000) + rank)
+
+    # fused batch in one cycle
+    handles = [hvd.allreduce_async(
+        np.full(1000, float(rank + 1) * (i + 1), np.float64),
+        average=False, name=f"shm.f/{i}") for i in range(8)]
+    for i, h in enumerate(handles):
+        np.testing.assert_allclose(
+            hvd.synchronize(h), ssum * (i + 1))
+
+    # variable-dim0 allgather
+    g = hvd.allgather(
+        np.full((rank + 1, 2), float(rank), np.float32), name="shm.ag")
+    assert g.shape == (sum(r + 1 for r in range(size)), 2)
+    offset = 0
+    for r in range(size):
+        np.testing.assert_allclose(g[offset:offset + r + 1], float(r))
+        offset += r + 1
+
+    # broadcast from every root (incl. non-coordinator roots)
+    for root in range(size):
+        out = hvd.broadcast(np.full(5, float(rank * 10), np.float64),
+                            root_rank=root, name=f"shm.bc/{root}")
+        np.testing.assert_allclose(out, float(root * 10))
+
+    # alltoall
+    per = 2
+    a = np.arange(size * per, dtype=np.float32) + 100 * rank
+    out = hvd.alltoall(a, name="shm.a2a")
+    expected = np.concatenate(
+        [np.arange(rank * per, (rank + 1) * per) + 100 * src
+         for src in range(size)]).astype(np.float32)
+    np.testing.assert_allclose(out, expected)
+
+    # reducescatter
+    rs = np.arange(size * 3, dtype=np.float32) * (rank + 1)
+    out = hvd.reducescatter(rs, name="shm.rs")
+    np.testing.assert_allclose(
+        out, (np.arange(size * 3, dtype=np.float32)
+              * ssum)[rank * 3:(rank + 1) * 3])
+
+    hvd.barrier(name="shm.bar")
+
+
+def scenario_autotune(hvd, rank, size):
+    """End-to-end autotune under a real 2-process world: drive traffic
+    until the coordinator's Bayesian tuner converges, then verify every
+    worker adopted the coordinator's tuned values via the ResponseList
+    trailer (reference: SyncParams, parameter_manager.cc:64-78)."""
+    import time as _t
+    from horovod_tpu.common import basics as _b
+    rt = _b.runtime()
+    pm = rt.parameter_manager
+    assert pm is not None, "HOROVOD_AUTOTUNE=1 must create the manager"
+
+    x = np.full(4096, float(rank + 1), np.float32)
+    converged = False
+    for i in range(2000):
+        hvd.allreduce(x, average=False, name=f"at.{i}")
+        # world-consistent loop exit: rank 0 broadcasts its tuning state
+        flag = 0.0 if rank != 0 else (0.0 if pm._tuning else 1.0)
+        done = hvd.broadcast(np.asarray([flag]), root_rank=0,
+                             name=f"at.done/{i}")
+        if float(done[0]) == 1.0:
+            converged = True
+            break
+    assert converged, "autotune did not converge within the op budget"
+
+    # one extra collective so the cycle that carried the converged
+    # trailer has definitely passed through apply_synced on workers
+    hvd.barrier(name="at.sync")
+    _t.sleep(0.2)
+
+    tuned = hvd.broadcast(np.asarray(pm._current, np.float64),
+                          root_rank=0, name="at.vals")
+    if rank != 0:
+        # rtol bounded by the wire trailer's float32 round-trip
+        np.testing.assert_allclose(np.asarray(pm._current, np.float64),
+                                   tuned, rtol=1e-5)
+        assert abs(pm.fusion_threshold_bytes()
+                   - tuned[0] * 1024 * 1024) <= 1
+        assert abs(pm.cycle_time_ms() - tuned[1]) < 1e-4
+
+
+def scenario_timeline(hvd, rank, size):
+    """Drive one of each collective so rank 0's timeline (enabled via
+    HOROVOD_TIMELINE in the harness env) records the full vocabulary
+    (reference: test/test_timeline.py:42-58)."""
+    x = np.full(64, float(rank + 1), np.float32)
+    out = hvd.allreduce(x, average=False, name="tl.ar")
+    np.testing.assert_allclose(out, sum(range(1, size + 1)))
+    g = hvd.allgather(np.full((rank + 1, 2), float(rank), np.float32),
+                      name="tl.ag")
+    assert g.shape[0] == sum(r + 1 for r in range(size))
+    hvd.broadcast(x, root_rank=0, name="tl.bc")
+
+
+def scenario_shm_fallback(hvd, rank, size):
+    """Segment creation failing on one rank must degrade the whole
+    world to the socket backend together (agree() vote)."""
+    from horovod_tpu.common import basics as _b
+    from horovod_tpu.ops import shm_ops as _shm
+
+    if rank == 1:
+        real_open = _shm.os.open
+
+        def _fail(path, *a, **k):
+            if isinstance(path, str) and path.startswith("/dev/shm/"):
+                raise OSError("forced shm failure (test)")
+            return real_open(path, *a, **k)
+        _shm.os = type(_shm.os)("os_shim")
+        _shm.os.__dict__.update(__import__("os").__dict__)
+        _shm.os.open = _fail
+
+    x = np.full(1000, float(rank + 1), np.float64)
+    out = hvd.allreduce(x, average=False, name="sf.ar")
+    np.testing.assert_allclose(out, sum(range(1, size + 1)))
+
+    rt = _b.runtime()
+    shm = [b for b in rt.op_manager._backends if b.name == "shm"][0]
+    assert shm._dead, "shm backend should be dead after the failed vote"
+    assert shm._map is None
+
+    # follow-up ops stay correct on the socket path
+    out = hvd.allreduce(x, average=False, name="sf.ar2")
+    np.testing.assert_allclose(out, sum(range(1, size + 1)))
+
+
+def scenario_shm_multihost_disabled(hvd, rank, size):
+    from horovod_tpu.common import basics as _b
+    x = np.full(100, float(rank + 1), np.float32)
+    out = hvd.allreduce(x, average=False, name="mh.ar")
+    np.testing.assert_allclose(out, sum(range(1, size + 1)))
+    rt = _b.runtime()
+    shm = [b for b in rt.op_manager._backends if b.name == "shm"][0]
+    assert shm._map is None, "shm must not establish across fake hosts"
+    assert not shm.enabled([], None)
+
+
 def scenario_barrier(hvd, rank, size):
     import time
     t0 = time.monotonic()

@@ -97,6 +97,43 @@ def test_barrier():
     run_scenario("barrier", 2)
 
 
+@pytest.mark.parametrize("size", [3, 4])
+def test_ring_allreduce(size):
+    """Large payloads take the 2-phase ring data plane (threshold
+    lowered so modest tensors cross it); mixed sizes exercise both
+    paths against one established ring. Shm is disabled so the socket
+    backend — the ring's host — is actually selected."""
+    run_scenario("ring_allreduce", size, timeout=120.0,
+                 extra_env={"HOROVOD_TPU_RING_THRESHOLD": "1024",
+                            "HOROVOD_TPU_SHM": "0"})
+
+
+def test_ring_establishment_failure_falls_back_to_star():
+    run_scenario("ring_fallback", 3, timeout=120.0,
+                 extra_env={"HOROVOD_TPU_RING_THRESHOLD": "1024",
+                            "HOROVOD_TPU_SHM": "0"})
+
+
+def test_shm_collectives():
+    """Same-host world -> the shared-memory data plane carries every
+    collective (reference analog: MPI_Win_allocate_shared staging,
+    mpi_operations.cc:179-329)."""
+    run_scenario("shm_collectives", 3, timeout=120.0)
+
+
+def test_shm_establishment_failure_falls_back_to_socket():
+    run_scenario("shm_fallback", 2, timeout=120.0)
+
+
+def test_shm_disabled_for_multihost_topology():
+    """Forced 2-host topology: shm must NOT be selected (ranks do not
+    actually share memory in production multi-host worlds)."""
+    run_scenario(
+        "shm_multihost_disabled", 2, timeout=120.0,
+        per_rank_env=lambda rank: {
+            "HOROVOD_HOSTNAME": f"fakehost{rank}"})
+
+
 def test_shape_mismatch_error():
     run_scenario("shape_mismatch_error", 2)
 
