@@ -79,14 +79,17 @@ def main() -> None:
 
     devices = jax.devices()
     n_dev = len(devices)
-    per_chip_batch = 128
+    per_chip_batch = 256
     batch = per_chip_batch * n_dev
     image_size = 224
     # Timed in chunks with a value fetch per chunk: on the experimental
     # axon platform block_until_ready() can return before execution
     # finishes, and very deep async queues measure erratically — a
     # float() fetch is the only reliable sync point.
-    warmup_steps, chunk_steps, chunks = 5, 10, 3
+    # Median-of-chunks timing: the host VM sees bursty external
+    # interference (see benchmarks/collective_bench.py), so a single
+    # long mean can absorb a bad window; per-chunk medians are robust.
+    warmup_steps, chunk_steps, chunks = 5, 25, 5
 
     mesh = spmd.create_mesh({"data": n_dev}, devices=devices)
     model = ResNet50(num_classes=1000, dtype=jnp.bfloat16,
@@ -147,11 +150,15 @@ def main() -> None:
     train_step = jax.jit(step_body, donate_argnums=(0, 1, 2)).lower(
         params, batch_stats, opt_state, images, labels).compile()
 
-    # MFU uses analytic MODEL flops (3x the 4.09 GFLOP ResNet-50
-    # forward per image — the convention of the scaling literature);
-    # HFU uses XLA's own executed-flop count for the compiled step
-    # (includes rematerialization and whatever else actually runs).
-    model_step_flops = 3 * 4.09e9 * batch
+    # MFU uses analytic MODEL flops: ResNet-50 @224 is 4.089 G MACs
+    # per forward image (the widely-quoted "4.09 GFLOPs" is the MACs
+    # convention); MFU counts 2 flops per MAC (the PaLM / scaling-book
+    # convention, same basis as the chip's peak spec) and 3x forward
+    # for the train step. Cross-check: XLA's own cost analysis reports
+    # 7.97 GF/img for the compiled forward — 0.97x this model count,
+    # i.e. the step executes essentially zero non-model flops (no
+    # remat/layout waste); ``flops_ratio`` below reports it per run.
+    model_step_flops = 3 * (2 * 4.089e9) * batch
     try:
         hw_step_flops = float(train_step.cost_analysis()["flops"])
         if not np.isfinite(hw_step_flops) or hw_step_flops <= 0:
@@ -164,19 +171,20 @@ def main() -> None:
             params, batch_stats, opt_state, images, labels)
     float(loss)  # real sync (see note above)
 
-    t0 = time.perf_counter()
+    chunk_dts = []
     for _ in range(chunks):
+        t0 = time.perf_counter()
         for _ in range(chunk_steps):
             params, batch_stats, opt_state, loss = train_step(
                 params, batch_stats, opt_state, images, labels)
         float(loss)
-    dt = time.perf_counter() - t0
+        chunk_dts.append(time.perf_counter() - t0)
+    sec_per_step = float(np.median(chunk_dts)) / chunk_steps
 
-    steps = chunk_steps * chunks
-    img_per_sec = batch * steps / dt
+    img_per_sec = batch / sec_per_step
     per_chip = img_per_sec / n_dev
     peak = _peak_flops(n_dev)
-    mfu = (model_step_flops * steps / dt) / peak
+    mfu = (model_step_flops / sec_per_step) / peak
     result = {
         "metric": "resnet50_hvd_train_images_per_sec_per_chip",
         "value": round(per_chip, 2),
@@ -187,7 +195,9 @@ def main() -> None:
         "n_devices": n_dev,
     }
     if hw_step_flops is not None:
-        result["hfu"] = round((hw_step_flops * steps / dt) / peak, 4)
+        result["hfu"] = round((hw_step_flops / sec_per_step) / peak, 4)
+        result["flops_ratio_executed_vs_model"] = round(
+            hw_step_flops / model_step_flops, 3)
     print(json.dumps(result))
     hvd.shutdown()
 

@@ -39,10 +39,22 @@ def _require_runtime() -> Runtime:
     return _runtime
 
 
-def init(comm=None, config: Optional[Config] = None) -> None:
-    """Initialize the runtime. ``comm`` accepts a (rank, size) tuple for
-    explicit worlds (reference: common/__init__.py:58-84 init(comm=...));
-    otherwise identity comes from the environment.
+def init(comm=None, config: Optional[Config] = None,
+         coordinator_listener=None) -> None:
+    """Initialize the runtime. ``comm`` accepts either a (rank, size)
+    TUPLE for explicit worlds, or a LIST of global ranks forming a
+    sub-world (reference: common/__init__.py:58-84 init(comm=ranks)):
+    members are renumbered 0..len-1 in list order, the first listed
+    rank's process hosts the sub-world's coordinator on the configured
+    controller port, and processes NOT in the list come up as size-1
+    worlds so they can keep doing local work while the subset runs
+    collectives. With ``comm=None`` identity comes from the environment.
+
+    ``coordinator_listener`` (rank 0 only) — an already-bound listening
+    socket for the coordinator to adopt, closing the reserve/release/
+    rebind race in launch layers that must publish the port before
+    init. Launcher-spawned rank 0 can instead inherit the reservation
+    as a file descriptor via ``HOROVOD_CONTROLLER_FD``.
     """
     global _runtime
     with _lock:
@@ -51,7 +63,38 @@ def init(comm=None, config: Optional[Config] = None) -> None:
                     # test-and-set, operations.cc:1342-1360)
         cfg = config or Config.from_env()
         hlog.set_level(cfg.log_level)
-        if comm is not None:
+        if isinstance(comm, list):
+            ranks = [int(r) for r in comm]
+            g_rank = cfg.rank if cfg.rank >= 0 else 0
+            # An inherited coordinator fd (launcher-reserved) serves the
+            # FULL world's published endpoint; it is only valid when this
+            # process leads a sub-world anchored at global rank 0. Close
+            # it otherwise or it lingers as a dead listener that eats the
+            # port and black-holes connects.
+            if cfg.controller_fd >= 0 and not (
+                    ranks and ranks[0] == 0 and g_rank == 0):
+                import os as _os
+                try:
+                    _os.close(cfg.controller_fd)
+                except OSError:
+                    pass
+                cfg.controller_fd = -1
+            if g_rank in ranks:
+                cfg.rank = ranks.index(g_rank)
+                cfg.size = len(ranks)
+                if ranks[0] != 0 and cfg.controller_port:
+                    # The env endpoint belongs to global rank 0, which is
+                    # NOT in this sub-world: derive a deterministic
+                    # per-subset port so the sub-coordinator never
+                    # collides with the full world's listener. On
+                    # multi-host launches where the first listed rank is
+                    # not on the env-addr host, set
+                    # HOROVOD_CONTROLLER_ADDR to that rank's host before
+                    # calling init.
+                    cfg.controller_port += 1 + (ranks[0] % 997)
+            else:
+                cfg.rank, cfg.size = 0, 1
+        elif comm is not None:
             rank, size = comm
             cfg.rank, cfg.size = int(rank), int(size)
         size = cfg.size if cfg.size > 0 else 1
@@ -61,9 +104,14 @@ def init(comm=None, config: Optional[Config] = None) -> None:
         if size == 1:
             controller: Controller = LocalController()
         elif rank == 0:
+            listener = coordinator_listener
+            if listener is None and cfg.controller_fd >= 0:
+                import socket as _socket
+                listener = _socket.socket(fileno=cfg.controller_fd)
             coord = TcpCoordinator(size, port=cfg.controller_port,
                                    secret=secret,
-                                   start_timeout=cfg.start_timeout)
+                                   start_timeout=cfg.start_timeout,
+                                   listener=listener)
             coord.accept_workers()
             controller = coord
         else:

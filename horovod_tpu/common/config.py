@@ -105,6 +105,11 @@ class Config:
     # Rendezvous address of the rank-0 coordinator.
     controller_addr: str = ""
     controller_port: int = 0
+    # Inherited fd of a pre-bound coordinator listener (socket-activation
+    # style): the launcher's TaskServer reserves the port and passes the
+    # open socket to rank 0, so the endpoint it published can never be
+    # stolen between reservation and bind.
+    controller_fd: int = -1
     secret_key: str = ""
     start_timeout: float = 30.0
 
@@ -160,6 +165,7 @@ class Config:
         c.log_hide_time = _env_bool("HOROVOD_LOG_HIDE_TIME", c.log_hide_time)
         c.controller_addr = os.environ.get("HOROVOD_CONTROLLER_ADDR", "")
         c.controller_port = _env_int("HOROVOD_CONTROLLER_PORT", 0)
+        c.controller_fd = _env_int("HOROVOD_CONTROLLER_FD", c.controller_fd)
         c.secret_key = os.environ.get("HOROVOD_SECRET_KEY", "")
         c.start_timeout = _env_float("HOROVOD_START_TIMEOUT", c.start_timeout)
         c.native_core = _env_bool("HOROVOD_TPU_NATIVE", c.native_core)

@@ -192,9 +192,16 @@ class TcpCoordinator(Controller):
     Python per-channel loop is the fallback."""
 
     def __init__(self, size: int, port: int = 0, secret: bytes = b"",
-                 start_timeout: float = 30.0):
+                 start_timeout: float = 30.0, listener=None):
+        """``listener`` — an already-bound listening socket to adopt
+        instead of binding ``port``. Launch layers that must publish
+        the coordinator endpoint BEFORE init (Spark rendezvous,
+        hvdtpurun's per-host port reservation) hand the bound socket
+        over so there is no close-then-rebind window for another
+        process to steal the port."""
         self._secret = secret
-        self._server = network.listen(port)
+        self._server = listener if listener is not None \
+            else network.listen(port)
         self.port = self._server.getsockname()[1]
         self._channels: Dict[int, network.Channel] = {}
         self._hostname = _my_hostname()

@@ -2,9 +2,16 @@
 
 Role-equivalent of the reference's FlatBuffers schema
 (reference: horovod/common/wire/message.fbs, message.cc:122-215,317-346).
-We define a compact little-endian layout instead of FlatBuffers; the
-native C++ core implements the identical encoding (native/wire.cc), so
-either side can produce/consume messages.
+We define a compact little-endian layout instead of FlatBuffers.
+
+Why this codec is pure Python (measured decision): a busy 30-request
+cycle costs 59 us to serialize + 196 us to parse, and an idle cycle's
+empty lists cost 1.4 us round-trip — noise against the 1-5 ms cycle
+time. A C++ codec behind ctypes cannot beat that without also moving
+the whole negotiation loop in-core (materializing Python
+Request/Response objects from C structs costs more than parsing the
+bytes in Python), so the earlier native parity codec was deleted
+rather than wired in.
 
 Layout (all little-endian):
   varless fixed ints; strings are u32 length + UTF-8 bytes;

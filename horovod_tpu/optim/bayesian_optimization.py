@@ -1,9 +1,11 @@
 """Bayesian optimization over the tuning box.
 
 (reference: horovod/common/optim/bayesian_optimization.{h,cc} — GP
-surrogate + Expected Improvement acquisition, maximized with L-BFGS in
-the reference; on a 2-D box a dense random-candidate search is simpler
-and equally effective, and has no native dependency.)
+surrogate + Expected Improvement acquisition, maximized with L-BFGS
+over multiple restarts via third_party/lbfgs.) Here the acquisition is
+maximized the same way: a dense random sweep seeds multi-start
+L-BFGS-B refinement; when scipy is unavailable the sweep's best
+candidate stands alone.
 """
 
 from __future__ import annotations
@@ -62,7 +64,40 @@ class BayesianOptimization:
         self._gp.fit(np.stack(self._xs), np.asarray(self._ys))
         cand = self._rng.uniform(size=(2048, self.dim))
         ei = self._expected_improvement(cand)
-        return self._denormalize(cand[int(np.argmax(ei))])
+        best_z = cand[int(np.argmax(ei))]
+        best_ei = float(ei[int(np.argmax(ei))])
+        refined, refined_ei = self._maximize_ei(cand, ei)
+        if refined is not None and refined_ei >= best_ei:
+            best_z = refined
+        return self._denormalize(best_z)
+
+    def _maximize_ei(self, cand: np.ndarray, ei: np.ndarray,
+                     n_starts: int = 5):
+        """Multi-start L-BFGS-B refinement of the acquisition maximum
+        (reference: bayesian_optimization.cc L-BFGS maximization over
+        the GP posterior, third_party/lbfgs). Returns (point in
+        normalized coords, its EI), or (None, -inf) without scipy."""
+        try:
+            from scipy.optimize import minimize
+        except ImportError:
+            return None, float("-inf")
+
+        def neg_ei(z):
+            return -float(self._expected_improvement(
+                np.clip(z, 0.0, 1.0)[None, :])[0])
+
+        starts = cand[np.argsort(ei)[-n_starts:]]
+        best, best_v = None, float("-inf")
+        for s in starts:
+            try:
+                res = minimize(neg_ei, s, method="L-BFGS-B",
+                               bounds=[(0.0, 1.0)] * self.dim)
+            except Exception:
+                continue
+            v = -float(res.fun)
+            if np.isfinite(v) and v > best_v:
+                best, best_v = np.clip(np.asarray(res.x), 0.0, 1.0), v
+        return best, best_v
 
     def best(self) -> Tuple[Optional[np.ndarray], float]:
         if not self._ys:

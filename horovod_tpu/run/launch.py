@@ -9,6 +9,7 @@ coordinates, so the whole stack is ours.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import secrets as _secrets
 import shlex
@@ -22,6 +23,120 @@ from typing import Dict, List, Optional, Tuple
 
 from horovod_tpu.common import logging as hlog
 from horovod_tpu.run.services import DriverService, local_addresses
+
+
+class HostCheckCache:
+    """Cached host-reachability results, one hour by default
+    (reference: run/util/cache.py — the 60-minute ``~/.horovod`` result
+    cache keyed by check parameters; ``--disable-cache`` bypasses it).
+    Only successes are cached: a host that was down may come back, so
+    failures are always re-probed."""
+
+    def __init__(self, path: Optional[str] = None, ttl_s: float = 3600.0):
+        base = os.environ.get("HOROVOD_TPU_CACHE_DIR", "~/.horovod_tpu")
+        self._path = path or os.path.join(
+            os.path.expanduser(base), "hostcheck.json")
+        self._ttl = ttl_s
+        self._data: Dict[str, dict] = {}
+        try:
+            with open(self._path) as f:
+                self._data = json.load(f)
+        except (OSError, ValueError):
+            pass
+
+    def get(self, key: str) -> Optional[bool]:
+        ent = self._data.get(key)
+        if ent and ent.get("ok") and time.time() - ent["t"] < self._ttl:
+            return True
+        return None
+
+    def put_all(self, results: Dict[str, bool]) -> None:
+        """Record a batch of results and persist once. Call from ONE
+        thread after the probe threads have joined — the store is not
+        synchronized."""
+        for key, ok in results.items():
+            if ok:
+                self._data[key] = {"ok": True, "t": time.time()}
+            else:
+                self._data.pop(key, None)
+        try:
+            os.makedirs(os.path.dirname(self._path), exist_ok=True)
+            tmp = f"{self._path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(self._data, f)
+            os.replace(tmp, self._path)
+        except OSError:
+            pass
+
+
+def _local_hosts() -> set:
+    return {"localhost", "127.0.0.1", socket.gethostname()}
+
+
+def _ssh_base(ssh_port: Optional[int],
+              connect_timeout: Optional[float] = None) -> List[str]:
+    cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
+    if connect_timeout is not None:
+        cmd += ["-o", f"ConnectTimeout={max(1, int(connect_timeout))}"]
+    if ssh_port:
+        cmd += ["-p", str(ssh_port)]
+    return cmd
+
+
+def _default_ssh_check(host: str, ssh_port: Optional[int],
+                       timeout: float) -> bool:
+    cmd = _ssh_base(ssh_port, connect_timeout=timeout) + [host, "true"]
+    try:
+        return subprocess.run(
+            cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            timeout=timeout + 5).returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
+def check_hosts_reachable(hosts: List[Tuple[str, int]],
+                          ssh_port: Optional[int] = None,
+                          timeout: float = 10.0,
+                          check_fn=None,
+                          cache: Optional[HostCheckCache] = None) -> None:
+    """Threaded ssh reachability pre-check before anything is spawned
+    (reference: run/run.py:44-100 — parallel `ssh true` probes): a dead
+    host fails fast with a per-host message instead of surfacing later
+    as a generic registration timeout. ``check_fn(host) -> bool`` is
+    injectable for tests; successes are cached (see HostCheckCache).
+    Cache reads/writes happen on this thread only — probe threads just
+    run the checks."""
+    to_check = [h for h, _ in hosts if h not in _local_hosts()]
+    if not to_check:
+        return
+    check = check_fn or (
+        lambda h: _default_ssh_check(h, ssh_port, timeout))
+    results: Dict[str, bool] = {}
+    need_probe = []
+    for h in to_check:
+        if cache is not None and cache.get(f"{h}:{ssh_port or 22}"):
+            results[h] = True
+        else:
+            need_probe.append(h)
+
+    def _probe(h: str) -> None:
+        results[h] = bool(check(h))
+
+    threads = [threading.Thread(target=_probe, args=(h,), daemon=True)
+               for h in need_probe]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout + 10)
+    if cache is not None and need_probe:
+        cache.put_all({f"{h}:{ssh_port or 22}": results.get(h, False)
+                       for h in need_probe})
+    dead = [h for h in to_check if not results.get(h)]
+    if dead:
+        raise RuntimeError(
+            f"host(s) unreachable over ssh: {', '.join(dead)} — verify "
+            f"connectivity (`ssh {dead[0]} true`), the -H host list, "
+            f"and --ssh-port, then retry.")
 
 
 def parse_hosts(spec: str) -> List[Tuple[str, int]]:
@@ -108,10 +223,7 @@ def _ssh_spawn(host: str, ssh_port: Optional[int], remote_cmd: str,
     (reference: run/run.py:103-190 _launch_task_servers)."""
     exports = " ".join(
         f"{k}={shlex.quote(v)}" for k, v in env_to_forward.items())
-    cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
-    if ssh_port:
-        cmd += ["-p", str(ssh_port)]
-    cmd += [host, f"{exports} {remote_cmd}"]
+    cmd = _ssh_base(ssh_port) + [host, f"{exports} {remote_cmd}"]
     return subprocess.Popen(cmd)
 
 
@@ -119,12 +231,20 @@ def run_multihost(hosts: List[Tuple[str, int]], command: List[str],
                   ssh_port: Optional[int] = None,
                   env: Optional[Dict[str, str]] = None,
                   start_timeout: float = 60.0,
-                  spawn_fn=None) -> int:
-    """Driver flow: start DriverService → launch task servers (ssh by
-    default; ``spawn_fn(host_index, driver_addr, driver_port, env)``
-    is injectable for tests) → registration → ring probe → rank
-    assignment → launch → collect exits
-    (reference: run/run.py:193-264 _driver_fn)."""
+                  spawn_fn=None, host_check_fn=None,
+                  disable_cache: bool = False) -> int:
+    """Driver flow: ssh reachability pre-check → start DriverService →
+    launch task servers (ssh by default; ``spawn_fn(host_index,
+    driver_addr, driver_port, env)`` is injectable for tests) →
+    registration → ring probe → rank assignment → launch → collect
+    exits (reference: run/run.py:193-264 _driver_fn; pre-check
+    run/run.py:44-100)."""
+    # Injected check_fns (tests) must never write fabricated results
+    # into the real ssh-check cache under real-looking keys.
+    use_cache = not disable_cache and host_check_fn is None
+    check_hosts_reachable(
+        hosts, ssh_port=ssh_port, check_fn=host_check_fn,
+        cache=HostCheckCache() if use_cache else None)
     secret = os.environ.get("HOROVOD_SECRET_KEY") or \
         _secrets.token_hex(16)
     driver = DriverService(len(hosts), secret=secret.encode())
@@ -178,6 +298,10 @@ def main(argv: Optional[List[str]] = None) -> None:
     parser.add_argument("--start-timeout", type=float, default=None,
                         help="seconds to wait for ranks/hosts to start "
                              "(env HOROVOD_START_TIMEOUT)")
+    parser.add_argument("--disable-cache", action="store_true",
+                        help="re-probe ssh reachability of every host "
+                             "even if a recent check succeeded "
+                             "(reference: horovodrun --disable-cache)")
     parser.add_argument("--verbose", action="store_true")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="training command")
@@ -195,8 +319,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         os.environ.get("HOROVOD_START_TIMEOUT", "30"))
 
     if not args.hosts or all(
-            h in ("localhost", "127.0.0.1", socket.gethostname())
-            for h, _ in parse_hosts(args.hosts)):
+            h in _local_hosts() for h, _ in parse_hosts(args.hosts)):
         if args.hosts:
             total = sum(s for _, s in parse_hosts(args.hosts))
             if total != args.num_proc:
@@ -208,8 +331,13 @@ def main(argv: Optional[List[str]] = None) -> None:
     total = sum(s for _, s in hosts)
     if total != args.num_proc:
         parser.error(f"-np {args.num_proc} != total slots {total}")
-    sys.exit(run_multihost(hosts, command, ssh_port=args.ssh_port,
-                           start_timeout=start_timeout))
+    try:
+        sys.exit(run_multihost(hosts, command, ssh_port=args.ssh_port,
+                               start_timeout=start_timeout,
+                               disable_cache=args.disable_cache))
+    except RuntimeError as e:
+        print(f"hvdtpurun: {e}", file=sys.stderr)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -272,11 +272,6 @@ class TaskServer:
     def _launch(self, msg) -> int:
         assignment = msg["assignment"]
         controller = msg["controller"]
-        if self._reserved is not None:
-            # Release the reservation at the last instant; rank 0 binds
-            # it immediately on init.
-            self._reserved.close()
-            self._reserved = None
         procs = []
         for rank in assignment["ranks"]:
             env = dict(os.environ)
@@ -285,7 +280,23 @@ class TaskServer:
             env["HOROVOD_SIZE"] = str(assignment["size"])
             env["HOROVOD_CONTROLLER_ADDR"] = controller["addr"]
             env["HOROVOD_CONTROLLER_PORT"] = str(controller["port"])
-            procs.append(subprocess.Popen(msg["command"], env=env))
+            pass_fds = ()
+            if rank == 0 and self._reserved is not None:
+                # Hand the reserved listener to rank 0 as an inherited
+                # fd (socket-activation style): the endpoint published
+                # to every host can never be stolen, because the socket
+                # is never unbound between reservation and init.
+                fd = self._reserved.fileno()
+                os.set_inheritable(fd, True)
+                env["HOROVOD_CONTROLLER_FD"] = str(fd)
+                pass_fds = (fd,)
+            procs.append(subprocess.Popen(msg["command"], env=env,
+                                          close_fds=True,
+                                          pass_fds=pass_fds))
+        if self._reserved is not None:
+            # The child owns a duplicate now; drop ours.
+            self._reserved.close()
+            self._reserved = None
         code = 0
         for p in procs:
             p.wait()

@@ -4,13 +4,31 @@
 tasks with the horovod_tpu world wired up, returning results ordered
 by rank. Requires pyspark; without it, ``horovod_tpu.run.api.run``
 provides the identical contract on local processes.
+
+Startup shape mirrors the reference's driver service
+(reference: spark/driver/driver_service.py + spark/__init__.py:122-161):
+the Spark *driver* hosts a small rendezvous TCP service; every task
+registers with it, and the task holding partition 0 binds the
+coordinator listener FIRST and publishes the bound endpoint — the
+socket is handed straight to ``hvd.init`` (never closed and rebound),
+so the published port cannot be stolen in between. Each task also runs
+a parent-death watchdog (reference: spark/task/mpirun_exec_fn.py:26-38)
+so orphaned ranks exit instead of hanging the job.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import socket
+import threading
+import time
 from typing import Any, Callable, List, Optional
+
+from horovod_tpu.common import logging as hlog
+from horovod_tpu.common import network
+
+_TAG_RDV = 9
 
 
 def _require_pyspark():
@@ -24,6 +42,105 @@ def _require_pyspark():
             "num_proc=N).") from e
 
 
+def _start_parent_watchdog(poll_s: float = 1.0) -> threading.Thread:
+    """Kill this process when its parent (the Spark executor) dies
+    (reference: spark/task/mpirun_exec_fn.py:26-38). Reparenting to
+    init/subreaper changes os.getppid(); an orphaned rank would
+    otherwise sit in a collective forever and stall the world."""
+    parent = os.getppid()
+
+    def _watch():
+        while True:
+            time.sleep(poll_s)
+            if os.getppid() != parent:
+                hlog.warning("parent process died; exiting rank")
+                os._exit(1)
+
+    t = threading.Thread(target=_watch, name="hvd-parent-watchdog",
+                         daemon=True)
+    t.start()
+    return t
+
+
+class _Rendezvous:
+    """Driver-side endpoint exchange: partition 0 publishes the bound
+    coordinator endpoint; every task receives it. One thread, framed
+    HMAC channels — same transport as the control plane."""
+
+    def __init__(self, num_proc: int, secret: bytes = b""):
+        self._num = num_proc
+        self._secret = secret
+        self._server = network.listen(0)
+        self.port = self._server.getsockname()[1]
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self) -> None:
+        waiting = []
+        controller = None
+        served = 0
+        self._server.settimeout(1.0)
+        while served < self._num:
+            try:
+                sock, _ = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                ch = network.Channel(sock, self._secret)
+                tag, payload = ch.recv()
+                if tag != _TAG_RDV:
+                    raise ConnectionError(f"unexpected tag {tag}")
+                hello = json.loads(bytes(payload).decode())
+                if "controller" in hello:
+                    controller = hello["controller"]
+            except (ConnectionError, OSError, ValueError, KeyError) as e:
+                hlog.warning(f"spark rendezvous rejected connection: {e}")
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
+            waiting.append(ch)
+            if controller is not None:
+                blob = json.dumps({"controller": controller}).encode()
+                for w in waiting:
+                    try:
+                        w.send(blob, _TAG_RDV)
+                        w.close()
+                    except OSError:
+                        pass
+                    served += 1
+                waiting = []
+
+    def close(self) -> None:
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+
+def _exchange(driver_addr: str, driver_port: int, secret: bytes,
+              controller: Optional[dict],
+              timeout: float) -> dict:
+    """Task half of the rendezvous: publish (partition 0) or fetch."""
+    ch = network.connect(driver_addr, driver_port, secret,
+                         timeout=timeout, retry_deadline=timeout)
+    hello = {} if controller is None else {"controller": controller}
+    ch.send(json.dumps(hello).encode(), _TAG_RDV)
+    # Bound the wait for partition 0's publication: without this a
+    # straggling/unreachable partition 0 would leave every other task
+    # in an unbounded blocking recv (network.connect clears the socket
+    # timeout after connecting).
+    ch.sock.settimeout(timeout)
+    tag, payload = ch.recv()
+    if tag != _TAG_RDV:
+        raise ConnectionError(f"unexpected rendezvous tag {tag}")
+    ch.close()
+    return json.loads(bytes(payload).decode())["controller"]
+
+
 def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
         num_proc: Optional[int] = None,
         start_timeout: float = 60.0, verbose: int = 1) -> List[Any]:
@@ -32,7 +149,7 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
     horovod_tpu world whose rank order follows Spark partition ids,
     rank 0's host carrying the coordinator — the reference's host-hash
     grouping with rank 0 first (spark/__init__.py:144-154)."""
-    pyspark = _require_pyspark()
+    _require_pyspark()
     from pyspark.sql import SparkSession
 
     kwargs = kwargs or {}
@@ -41,44 +158,48 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
     if num_proc is None:
         num_proc = max(sc.defaultParallelism, 1)
 
-    # Stage 1: elect the coordinator — partition 0 reports a reachable
-    # address and a reserved port through the driver.
     from horovod_tpu.run.services import local_addresses
-    from horovod_tpu.common import network
 
-    def _elect(index, _it):
-        if index == 0:
-            srv = network.listen(0)
-            port = srv.getsockname()[1]
-            addr = local_addresses()[0]
-            srv.close()  # released; rank 0 rebinds at init
-            yield (addr, port)
+    secret_str = os.environ.get("HOROVOD_SECRET_KEY", "")
+    secret = secret_str.encode()
+    rendezvous = _Rendezvous(num_proc, secret)
+    driver_addr = local_addresses()[0]
+    driver_port = rendezvous.port
 
-    coord_addr, coord_port = sc.parallelize(
-        range(num_proc), num_proc).mapPartitionsWithIndex(
-            _elect).collect()[0]
-
-    secret = os.environ.get("HOROVOD_SECRET_KEY", "")
-
-    # Stage 2: run fn on every partition with the world wired up.
     def _task(index, _it):
+        _start_parent_watchdog()
+        listener = None
+        if index == 0:
+            # Bind FIRST, publish the bound endpoint, and hand the very
+            # same socket to init — no close/rebind window.
+            listener = network.listen(0)
+            controller = {"addr": local_addresses()[0],
+                          "port": listener.getsockname()[1]}
+        else:
+            controller = None
+        controller = _exchange(driver_addr, driver_port, secret,
+                               controller, start_timeout)
         os.environ["HOROVOD_RANK"] = str(index)
         os.environ["HOROVOD_SIZE"] = str(num_proc)
-        os.environ["HOROVOD_CONTROLLER_ADDR"] = coord_addr
-        os.environ["HOROVOD_CONTROLLER_PORT"] = str(coord_port)
+        os.environ["HOROVOD_CONTROLLER_ADDR"] = controller["addr"]
+        os.environ["HOROVOD_CONTROLLER_PORT"] = str(controller["port"])
         os.environ["HOROVOD_START_TIMEOUT"] = str(start_timeout)
-        if secret:
-            os.environ["HOROVOD_SECRET_KEY"] = secret
+        if secret_str:
+            os.environ["HOROVOD_SECRET_KEY"] = secret_str
         import horovod_tpu as hvd
-        hvd.init()
+        from horovod_tpu.common import basics
+        basics.init(coordinator_listener=listener)
         try:
             result = fn(*args, **kwargs)
         finally:
             hvd.shutdown()
         yield (index, result)
 
-    results = sc.parallelize(range(num_proc), num_proc) \
-        .mapPartitionsWithIndex(_task).collect()
+    try:
+        results = sc.parallelize(range(num_proc), num_proc) \
+            .mapPartitionsWithIndex(_task).collect()
+    finally:
+        rendezvous.close()
     # ordered by rank (reference: spark/__init__.py:195-199)
     return [r for _, r in sorted(results)]
 

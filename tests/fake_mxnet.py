@@ -1,0 +1,107 @@
+"""NDArray-protocol double of the mxnet surface horovod_tpu.mxnet uses.
+
+mxnet ships no TPU wheel and isn't in the image, but the adapter's
+contract is pure protocol: ``.asnumpy()``, ``mx.nd.array``, slice
+assignment, gluon ``Trainer``/``Parameter`` shapes. This module
+implements exactly that surface so the adapter code actually EXECUTES
+under a real multi-process world (scenario ``mxnet`` in
+tests/mp_scenarios.py) instead of existing as never-run staging code.
+
+Install with ``fake_mxnet.install()`` before importing
+horovod_tpu.mxnet.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as np
+
+
+class NDArray:
+    def __init__(self, data, dtype=None):
+        self._np = np.array(data, dtype=dtype)
+
+    @property
+    def dtype(self):
+        return self._np.dtype
+
+    @property
+    def shape(self):
+        return self._np.shape
+
+    def asnumpy(self) -> np.ndarray:
+        return self._np
+
+    def __setitem__(self, key, value):
+        v = value.asnumpy() if isinstance(value, NDArray) \
+            else np.asarray(value)
+        self._np[key] = v
+
+    def __repr__(self):
+        return f"FakeNDArray({self._np!r})"
+
+
+def _nd_array(data, dtype=None, ctx=None):
+    if isinstance(data, NDArray):
+        data = data.asnumpy()
+    return NDArray(np.asarray(data), dtype=dtype)
+
+
+class DeferredInitializationError(RuntimeError):
+    pass
+
+
+class Parameter:
+    """gluon Parameter double: deferred init until initialize()."""
+
+    def __init__(self, name, data, grad=None, grad_req="write",
+                 deferred=False):
+        self.name = name
+        self.grad_req = grad_req
+        self._data = NDArray(data)
+        self._grad = NDArray(grad if grad is not None
+                             else np.zeros_like(np.asarray(data)))
+        self._deferred = deferred
+
+    def initialize(self):
+        self._deferred = False
+
+    def data(self) -> NDArray:
+        if self._deferred:
+            raise DeferredInitializationError(self.name)
+        return self._data
+
+    def list_grad(self):
+        return [self._grad]
+
+
+class Trainer:
+    """gluon Trainer double: only what DistributedTrainer extends."""
+
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore=None):
+        assert kvstore is None, "horovod trainer must disable kvstore"
+        if hasattr(params, "values"):
+            params = list(params.values())
+        self._params = list(params)
+        self._optimizer = optimizer
+        self._optimizer_params = optimizer_params
+        self._scale = 1.0
+
+
+def install() -> None:
+    mx = types.ModuleType("mxnet")
+    mx.__version__ = "0.0-fake"
+    nd = types.ModuleType("mxnet.nd")
+    nd.array = _nd_array
+    nd.NDArray = NDArray
+    gluon = types.ModuleType("mxnet.gluon")
+    gluon.Trainer = Trainer
+    gluon.Parameter = Parameter
+    mx.nd = nd
+    mx.gluon = gluon
+    sys.modules["mxnet"] = mx
+    sys.modules["mxnet.nd"] = nd
+    sys.modules["mxnet.gluon"] = gluon

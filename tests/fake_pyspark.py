@@ -1,0 +1,101 @@
+"""Process-based double of the pyspark surface horovod_tpu.spark uses.
+
+The test image has no pyspark and installs are off, so this module
+models what Spark local mode actually does with a partition function:
+each partition executes in its own forked worker process and the
+"driver" collects the yielded rows. That preserves exactly what the
+Spark integration needs proven — real multi-process rendezvous,
+coordinator socket handoff, per-rank env, result ordering — without
+the Spark runtime itself (the reference asserts the same things
+against local-mode Spark, test/test_spark.py:51-69).
+
+Install with ``fake_pyspark.install()`` BEFORE importing
+horovod_tpu.spark's run() path; it registers ``pyspark`` and
+``pyspark.sql`` in sys.modules.
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import sys
+import types
+
+
+class _MappedRDD:
+    def __init__(self, parts, fn):
+        self._parts = parts
+        self._fn = fn
+
+    def collect(self):
+        ctx = multiprocessing.get_context("fork")
+        q = ctx.Queue()
+        fn = self._fn
+
+        def _worker(i, part):
+            try:
+                out = list(fn(i, iter(part)))
+                q.put((i, True, out))
+            except BaseException as e:  # surfaced in the driver
+                q.put((i, False, repr(e)))
+
+        procs = [ctx.Process(target=_worker, args=(i, part), daemon=True)
+                 for i, part in enumerate(self._parts)]
+        for p in procs:
+            p.start()
+        rows = {}
+        errors = []
+        for _ in procs:
+            i, ok, out = q.get(timeout=120)
+            if ok:
+                rows[i] = out
+            else:
+                errors.append((i, out))
+        for p in procs:
+            p.join(timeout=30)
+        if errors:
+            raise RuntimeError(f"partition failures: {errors}")
+        return [row for i in sorted(rows) for row in rows[i]]
+
+
+class _RDD:
+    def __init__(self, data, num_partitions):
+        data = list(data)
+        # one element per partition when counts match (the spark.run
+        # usage shape: parallelize(range(n), n))
+        self._parts = [[] for _ in range(num_partitions)]
+        for i, x in enumerate(data):
+            self._parts[i % num_partitions].append(x)
+
+    def mapPartitionsWithIndex(self, fn):
+        return _MappedRDD(self._parts, fn)
+
+
+class _SparkContext:
+    defaultParallelism = 2
+
+    def parallelize(self, data, num_partitions):
+        return _RDD(data, num_partitions)
+
+
+class _Session:
+    def __init__(self):
+        self.sparkContext = _SparkContext()
+
+
+class _Builder:
+    def getOrCreate(self):
+        return _Session()
+
+
+class SparkSession:
+    builder = _Builder()
+
+
+def install() -> None:
+    pyspark = types.ModuleType("pyspark")
+    pyspark.__version__ = "0.0-fake"
+    sql = types.ModuleType("pyspark.sql")
+    sql.SparkSession = SparkSession
+    pyspark.sql = sql
+    sys.modules["pyspark"] = pyspark
+    sys.modules["pyspark.sql"] = sql

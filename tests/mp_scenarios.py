@@ -227,6 +227,121 @@ def scenario_shm_collectives(hvd, rank, size):
     hvd.barrier(name="shm.bar")
 
 
+def scenario_subset_world(hvd, rank, size):
+    """hvd.init(comm=[1, 2]) on a 3-process launch: ranks 1 and 2 form
+    a 2-rank sub-world (renumbered 0 and 1, rank 1 hosting the
+    coordinator) and allreduce; rank 0 is not a member, comes up as a
+    size-1 world, and keeps working locally (reference:
+    common/__init__.py:58-84 init(comm=ranks))."""
+    assert size == 3, "scenario expects 3 launched processes"
+    hvd.init(comm=[1, 2])
+    if rank == 0:
+        # the abstaining process: local world, local collectives work
+        assert hvd.size() == 1 and hvd.rank() == 0
+        out = hvd.allreduce(np.full(4, 7.0, np.float32),
+                            average=False, name="solo.ar")
+        np.testing.assert_allclose(out, 7.0)
+    else:
+        assert hvd.size() == 2
+        assert hvd.rank() == rank - 1  # renumbered in list order
+        x = np.full(5, float(rank), np.float32)  # global ranks 1, 2
+        out = hvd.allreduce(x, average=False, name="sub.ar")
+        np.testing.assert_allclose(out, 3.0)  # 1 + 2, never rank 0's 7
+        b = hvd.broadcast(np.full(2, float(rank), np.float64),
+                          root_rank=1, name="sub.bc")
+        # sub-world root 1 == global rank 2
+        np.testing.assert_allclose(b, 2.0)
+
+
+scenario_subset_world.no_auto_init = True
+
+
+def scenario_mxnet(hvd, rank, size):
+    """Execute the whole MXNet adapter surface under a real 2-process
+    world via the NDArray-protocol double (tests/fake_mxnet.py):
+    collectives, in-place variants, parameter broadcast with deferred
+    init, DistributedOptimizer (scalar + aggregated-list update), and
+    DistributedTrainer._allreduce_grads (reference:
+    horovod/mxnet/__init__.py:38-140)."""
+    from tests import fake_mxnet
+    fake_mxnet.install()
+    import horovod_tpu.mxnet as hmx
+    nd = fake_mxnet
+
+    ssum = sum(range(1, size + 1))
+    x = nd.NDArray(np.full(4, float(rank + 1), np.float32))
+    out = hmx.allreduce(x, average=False, name="mx.ar")
+    assert isinstance(out, nd.NDArray)
+    np.testing.assert_allclose(out.asnumpy(), ssum)
+    assert out.dtype == np.float32
+
+    hmx.allreduce_(x, average=True, name="mx.ar_")
+    np.testing.assert_allclose(x.asnumpy(), ssum / size)
+
+    g = hmx.allgather(
+        nd.NDArray(np.full((rank + 1, 2), float(rank), np.float32)),
+        name="mx.ag")
+    assert g.asnumpy().shape == (sum(r + 1 for r in range(size)), 2)
+
+    b = hmx.broadcast(nd.NDArray(np.full(3, float(rank), np.float64)),
+                      root_rank=1, name="mx.bc")
+    np.testing.assert_allclose(b.asnumpy(), 1.0)
+
+    # parameter broadcast with one deferred-init parameter: skipped on
+    # the first pass, carried on the second after initialize()
+    params = {
+        "w": nd.Parameter("w", np.full(4, float(rank * 10 + 1))),
+        "late": nd.Parameter("late", np.full(2, float(rank * 10 + 2)),
+                             deferred=True),
+    }
+    hmx.broadcast_parameters(params, root_rank=0)
+    np.testing.assert_allclose(params["w"].data().asnumpy(), 1.0)
+    params["late"].initialize()
+    hmx.broadcast_parameters(params, root_rank=0)
+    np.testing.assert_allclose(params["late"].data().asnumpy(), 2.0)
+
+    # DistributedOptimizer: scalar-index and aggregated-list updates
+    class RecordingOpt:
+        def __init__(self):
+            self.calls = []
+
+        def update(self, index, weight, grad, state):
+            self.calls.append((index, grad))
+
+        def update_multi_precision(self, index, weight, grad, state):
+            self.calls.append(("mp", index, grad))
+
+    opt = hmx.DistributedOptimizer(RecordingOpt())
+    grad = nd.NDArray(np.full(3, float(rank + 1), np.float32))
+    opt.update(7, None, grad, None)
+    np.testing.assert_allclose(grad.asnumpy(), ssum / size)
+    grads = [nd.NDArray(np.full(2, float(rank + 1) * (i + 1),
+                                np.float32)) for i in range(2)]
+    opt.update_multi_precision([1, 2], None, grads, None)
+    for i, gr in enumerate(grads):
+        np.testing.assert_allclose(gr.asnumpy(),
+                                   ssum * (i + 1) / size)
+    assert len(opt._opt.calls) == 2
+
+    # DistributedTrainer: _allreduce_grads sums, _scale divides by size
+    ps = [nd.Parameter(f"p{i}", np.ones(3),
+                       grad=np.full(3, float(rank + 1) * (i + 1)))
+          for i in range(2)]
+    ps.append(nd.Parameter("frozen", np.ones(2),
+                           grad=np.full(2, 99.0), grad_req="null"))
+    trainer = hmx.DistributedTrainer(ps, RecordingOpt())
+    assert trainer._scale == 1.0 / size
+    trainer._allreduce_grads()
+    for i in range(2):
+        np.testing.assert_allclose(ps[i].list_grad()[0].asnumpy(),
+                                   ssum * (i + 1))
+    np.testing.assert_allclose(ps[2].list_grad()[0].asnumpy(), 99.0)
+
+    # unwrap guard: a wrapped optimizer must not double-reduce
+    t2 = hmx.DistributedTrainer(ps[:1], opt)
+    assert not isinstance(t2._optimizer, hmx.DistributedOptimizer)
+
+
 def scenario_autotune(hvd, rank, size):
     """End-to-end autotune under a real 2-process world: drive traffic
     until the coordinator's Bayesian tuner converges, then verify every
@@ -650,6 +765,43 @@ def scenario_xla_hierarchical(hvd_mod, rank, size):
     assert xla._mesh2d is not None, "hierarchical mesh not built"
 
 
+def scenario_xla_hier_allreduce_multihost(hvd_mod, rank, size):
+    """HOROVOD_HIERARCHICAL_ALLREDUCE on a forced 2-host topology
+    (2 ranks per fake host): the factored (cross, local) psum must be
+    the executable that actually compiled — a real two-level reduction,
+    not the degenerate cross_size==1 shape — and values must match the
+    flat path exactly (reference: NCCLHierarchicalAllreduce,
+    nccl_operations.cc:167-372)."""
+    assert size == 4, "scenario expects 4 ranks"
+    jax = _init_jax_distributed(rank, size)
+    import jax.numpy as jnp
+    from horovod_tpu.common import basics as _b
+
+    # exactly-representable values: the sum is bit-exact in f32
+    # regardless of reduction order, so this matches the flat path
+    # bit-for-bit.
+    x = jnp.full((6,), float(2 ** rank), jnp.float32)
+    out = hvd_mod.allreduce(x, average=False, name="hm.ar")
+    expected = float(sum(2 ** r for r in range(size)))
+    assert np.asarray(out).tolist() == [expected] * 6, np.asarray(out)
+
+    # integer dtype: bitwise-exact by construction
+    xi = np.full((5,), rank + 1, np.int32)
+    outi = hvd_mod.allreduce(jnp.asarray(xi), average=False,
+                             name="hm.ari")
+    assert np.asarray(outi).tolist() == [10] * 5
+
+    rt = _b.runtime()
+    xla = [b for b in rt.op_manager._backends if b.name == "xla_mesh"][0]
+    assert xla._mesh2d is not None, "hierarchical mesh not built"
+    assert xla._mesh2d.shape["cross"] == 2 and \
+        xla._mesh2d.shape["local"] == 2, dict(xla._mesh2d.shape)
+    # the compiled executables must be the (cross, local) factored ones
+    ar_axes = {k[4] for k in xla._cache if k[0] == "allreduce"}
+    assert ("cross", "local") in ar_axes, ar_axes
+    assert all(a == ("cross", "local") for a in ar_axes), ar_axes
+
+
 def scenario_xla_hierarchical_allgather(hvd_mod, rank, size):
     """HOROVOD_HIERARCHICAL_ALLGATHER on a forced 2-host topology
     (HOROVOD_HOSTNAME set by the harness: ranks 0,1 on hostA; 2,3 on
@@ -688,9 +840,10 @@ def main():
     os.environ["HOROVOD_CONTROLLER_PORT"] = str(port)
     os.environ.setdefault("HOROVOD_CYCLE_TIME", "1")
     import horovod_tpu as hvd
-    hvd.init()
+    fn = globals()[f"scenario_{scenario}"]
+    if not getattr(fn, "no_auto_init", False):
+        hvd.init()
     try:
-        fn = globals()[f"scenario_{scenario}"]
         fn(hvd, rank, size)
     finally:
         hvd.shutdown()

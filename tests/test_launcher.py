@@ -98,7 +98,8 @@ def test_multihost_driver_protocol():
         code = run_multihost(
             [("hostA", 2), ("hostB", 2)],
             [sys.executable, script],
-            env=_env(), spawn_fn=spawn, start_timeout=30.0)
+            env=_env(), spawn_fn=spawn, start_timeout=30.0,
+            host_check_fn=lambda h: True)
         assert code == 0
         for r in range(4):
             assert os.path.exists(os.path.join(tmp, f"rank{r}.ok")), \
@@ -118,6 +119,69 @@ def test_cli_local():
         assert proc.returncode == 0, proc.stderr.decode()
         assert os.path.exists(os.path.join(tmp, "rank0.ok"))
         assert os.path.exists(os.path.join(tmp, "rank1.ok"))
+
+
+def test_unreachable_host_fails_fast_with_named_host():
+    """A dead host must abort BEFORE anything is spawned, naming the
+    host (reference: run/run.py:44-100 threaded ssh pre-check) — not
+    surface later as a generic registration timeout."""
+    spawned = []
+
+    def spawn(host_index, driver_addr, driver_port, env):
+        spawned.append(host_index)
+
+    with pytest.raises(RuntimeError, match="deadhost.*unreachable|"
+                                           "unreachable.*deadhost"):
+        run_multihost(
+            [("hostA", 1), ("deadhost", 1)],
+            [sys.executable, "-c", "pass"],
+            env=_env(), spawn_fn=spawn, start_timeout=5.0,
+            host_check_fn=lambda h: h != "deadhost")
+    assert spawned == [], "task servers were spawned despite the " \
+                          "failed pre-check"
+
+
+def test_host_check_cache_skips_repeat_probes(tmp_path):
+    """Successful checks are cached (reference: run/util/cache.py 60-min
+    result cache); failures are always re-probed."""
+    from horovod_tpu.run.launch import HostCheckCache, \
+        check_hosts_reachable
+    calls = []
+
+    def check(h):
+        calls.append(h)
+        return h != "badhost"
+
+    path = str(tmp_path / "hostcheck.json")
+    hosts = [("alpha", 1), ("beta", 1)]
+    check_hosts_reachable(hosts, check_fn=check,
+                          cache=HostCheckCache(path=path))
+    assert sorted(calls) == ["alpha", "beta"]
+
+    # second run with a fresh cache object backed by the same file:
+    # both hosts hit the cache, no probes
+    calls.clear()
+    check_hosts_reachable(hosts, check_fn=check,
+                          cache=HostCheckCache(path=path))
+    assert calls == []
+
+    # an expired cache re-probes
+    calls.clear()
+    check_hosts_reachable(hosts, check_fn=check,
+                          cache=HostCheckCache(path=path, ttl_s=0.0))
+    assert sorted(calls) == ["alpha", "beta"]
+
+    # failures are never served from cache
+    calls.clear()
+    cache = HostCheckCache(path=path)
+    with pytest.raises(RuntimeError, match="badhost"):
+        check_hosts_reachable([("badhost", 1)], check_fn=check,
+                              cache=cache)
+    calls.clear()
+    with pytest.raises(RuntimeError, match="badhost"):
+        check_hosts_reachable([("badhost", 1)], check_fn=check,
+                              cache=cache)
+    assert calls == ["badhost"]
 
 
 def _fn_for_api_run(scale):

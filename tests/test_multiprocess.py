@@ -185,6 +185,18 @@ def test_scalar_broadcast():
     run_scenario("scalar_broadcast", 2)
 
 
+def test_rank_subset_init():
+    """init(comm=[1, 2]) on 3 processes: the 2-rank subset allreduces
+    while the third abstains in a size-1 world."""
+    run_scenario("subset_world", 3, timeout=120.0)
+
+
+def test_mxnet_adapter():
+    """The MXNet adapter executes end-to-end against the NDArray
+    protocol double under a real 2-process world."""
+    run_scenario("mxnet", 2, timeout=120.0)
+
+
 def test_checkpoint_resume(tmp_path_factory):
     import tempfile
     with tempfile.TemporaryDirectory() as tmp:
@@ -200,6 +212,17 @@ def test_xla_mesh_backend():
 def test_xla_hierarchical_allreduce():
     run_scenario("xla_hierarchical", 2, timeout=180.0,
                  extra_env={"HOROVOD_HIERARCHICAL_ALLREDUCE": "1"})
+
+
+def test_xla_hierarchical_allreduce_multihost():
+    """Forced 2-host topology (4 ranks): hierarchical allreduce must
+    compile and run the factored (cross, local) psum with values
+    matching the flat path bit-for-bit."""
+    run_scenario(
+        "xla_hier_allreduce_multihost", 4, timeout=240.0,
+        extra_env={"HOROVOD_HIERARCHICAL_ALLREDUCE": "1"},
+        per_rank_env=lambda rank: {
+            "HOROVOD_HOSTNAME": f"fakehost{rank // 2}"})
 
 
 def test_xla_hierarchical_allgather():
