@@ -58,12 +58,13 @@ class Topology:
 
     __slots__ = ("rank", "size", "local_rank", "local_size",
                  "cross_rank", "cross_size", "is_homogeneous",
-                 "local_sizes")
+                 "local_sizes", "local_roots")
 
     def __init__(self, rank: int, size: int, local_rank: int = 0,
                  local_size: int = 1, cross_rank: int = 0,
                  cross_size: int = 1, is_homogeneous: bool = True,
-                 local_sizes: Optional[List[int]] = None):
+                 local_sizes: Optional[List[int]] = None,
+                 local_roots: Optional[List[int]] = None):
         self.rank = rank
         self.size = size
         self.local_rank = local_rank
@@ -72,6 +73,9 @@ class Topology:
         self.cross_size = cross_size
         self.is_homogeneous = is_homogeneous
         self.local_sizes = local_sizes or [local_size]
+        # global rank of each host's local_rank-0 process, host order
+        self.local_roots = local_roots if local_roots is not None \
+            else [0]
 
 
 def compute_topology(rank: int, hostnames: List[str]) -> Topology:
@@ -91,11 +95,12 @@ def compute_topology(rank: int, hostnames: List[str]) -> Topology:
     cross_size = len(hosts_in_order)
     local_sizes = [sum(1 for h in hostnames if h == host)
                    for host in hosts_in_order]
+    local_roots = [hostnames.index(host) for host in hosts_in_order]
     is_homogeneous = all(s == local_sizes[0] for s in local_sizes)
     return Topology(rank=rank, size=size, local_rank=local_rank,
                     local_size=local_size, cross_rank=cross_rank,
                     cross_size=cross_size, is_homogeneous=is_homogeneous,
-                    local_sizes=local_sizes)
+                    local_sizes=local_sizes, local_roots=local_roots)
 
 
 class Controller:

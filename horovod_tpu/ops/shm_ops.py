@@ -48,7 +48,8 @@ import numpy as np
 
 from horovod_tpu import native as _native
 from horovod_tpu.common import logging as hlog
-from horovod_tpu.common.message import Response
+from horovod_tpu.common.controller import _my_hostname
+from horovod_tpu.common.message import Response, ResponseType
 from horovod_tpu.common.status import Status
 from horovod_tpu.ops.backend import CollectiveBackend
 from horovod_tpu.ops.socket_ops import (
@@ -73,38 +74,59 @@ class ShmBackend(CollectiveBackend):
         self._stride = 0
         self._gen = 0
         self._dead = False
-        want = True if config is None else getattr(config, "shm_enabled",
-                                                   True)
-        self._opt_in = want and os.path.isdir("/dev/shm")
+        self._opt_in = True if config is None \
+            else getattr(config, "shm_enabled", True)
 
     def enabled(self, entries, response) -> bool:
-        # Same-host check makes every per-host property (e.g. /dev/shm
-        # availability) automatically world-consistent.
+        """World-consistent by construction: topology is identical on
+        every rank, and anything that can genuinely fail per host
+        (segment creation, /dev/shm itself) is decided inside
+        establishment by a world-wide agree() vote."""
         t = getattr(self._ctl, "topology", None)
-        return (self._opt_in and not self._dead and t is not None
-                and t.size > 1 and t.local_size == t.size)
+        if not (self._opt_in and not self._dead and t is not None
+                and t.size > 1):
+            return False
+        if t.local_size == t.size:
+            return True  # same-host world: every collective
+        # Multi-host: the hierarchical local-reduce -> cross -> local-
+        # broadcast path (allreduce only), worthwhile when at least one
+        # host runs several ranks.
+        return (max(t.local_sizes) > 1 and response is not None
+                and response.response_type == ResponseType.ALLREDUCE)
+
+    @property
+    def _hier(self) -> bool:
+        t = self._ctl.topology
+        return t.local_size < t.size
 
     # -- segment lifecycle -------------------------------------------------
 
     def _segment_for(self, nbytes: int) -> Optional[Tuple[mmap.mmap, int]]:
         """Return (mmap, stride) able to hold one ``nbytes`` payload per
-        slot, (re)establishing through the control plane when the
+        LOCAL slot, (re)establishing through the control plane when the
         current segment is too small. All ranks call this at the same
-        negotiated response position with the same ``nbytes``."""
+        negotiated response position with the same ``nbytes``.
+
+        One segment per HOST, created by that host's local root and
+        advertised through a hostname-keyed path map broadcast by the
+        coordinator (a same-host world is the one-host special case).
+        """
         stride = _pad(nbytes)
-        if self._map is not None and self._stride >= stride:
+        solo = self._hier and self._ctl.topology.local_size == 1
+        if self._stride >= stride and (self._map is not None or solo):
             return self._map, self._stride
         ctl = self._ctl
+        t = ctl.topology
         # Grow generously so streams of slightly-increasing sizes don't
         # re-establish every op.
         stride = _pad(max(stride, 2 * self._stride))
-        total = stride * ctl.size * 2
+        total = stride * t.local_size * 2
         self._gen += 1
+        my_host = _my_hostname()
         new_map = None
         path = ""
-        ok = False
-        if ctl.is_coordinator:
-            ctl.gather_data(b"")  # everyone reached establishment
+        ok = True
+        if t.local_rank == 0 and not solo:
             path = f"/dev/shm/hvdtpu-{os.getpid()}-{self._gen}"
             try:
                 fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL,
@@ -114,29 +136,47 @@ class ShmBackend(CollectiveBackend):
                     new_map = mmap.mmap(fd, total)
                 finally:
                     os.close(fd)
-                ok = True
             except OSError as e:
-                hlog.warning(f"shm segment create failed: {e!r}", rank=0)
-            ctl.broadcast_data(json.dumps(
-                {"path": path if ok else "", "total": total}).encode())
+                hlog.warning(f"shm segment create failed: {e!r}",
+                             rank=ctl.rank)
+                path, ok = "", False
+            payload = json.dumps(
+                {"host": my_host, "path": path, "total": total}).encode()
         else:
-            ctl.gather_data(b"")
-            info = json.loads(bytes(ctl.broadcast_data(None)).decode())
-            if info["path"]:
+            payload = b""
+        gathered = ctl.gather_data(payload)
+        if gathered is not None:  # coordinator
+            host_map = {}
+            for data in gathered:
+                if len(data):
+                    info = json.loads(bytes(data).decode())
+                    host_map[info["host"]] = (info["path"],
+                                              info["total"])
+            blob = ctl.broadcast_data(json.dumps(host_map).encode())
+        else:
+            blob = ctl.broadcast_data(None)
+        if new_map is None and not solo:
+            # non-creators open their host's segment (solo hier hosts
+            # need no segment: there is nobody to share with)
+            host_map = json.loads(bytes(blob).decode())
+            entry = host_map.get(my_host, ("", 0))
+            if entry[0]:
                 try:
-                    fd = os.open(info["path"], os.O_RDWR)
+                    fd = os.open(entry[0], os.O_RDWR)
                     try:
-                        new_map = mmap.mmap(fd, info["total"])
+                        new_map = mmap.mmap(fd, entry[1])
                     finally:
                         os.close(fd)
-                    ok = True
                 except OSError as e:
                     hlog.warning(
                         f"shm segment open failed: {e!r}", rank=ctl.rank)
+                    ok = False
+            else:
+                ok = False
         agreed = ctl.agree(ok)
-        if ctl.is_coordinator and path:
-            # Every rank holds a mapping (or we are tearing down); the
-            # name can go away now — crash-safe cleanup.
+        if path:
+            # Every local rank holds a mapping (or we are tearing
+            # down); the name can go away now — crash-safe cleanup.
             try:
                 os.unlink(path)
             except OSError:
@@ -164,6 +204,12 @@ class ShmBackend(CollectiveBackend):
                 pass
         return self._map, self._stride
 
+    def _world_barrier(self) -> None:
+        if self._ctl.gather_data(b"") is not None:
+            self._ctl.broadcast_data(b"")
+        else:
+            self._ctl.broadcast_data(None)
+
     def _view(self, offset: int, dtype, count: int) -> np.ndarray:
         return np.frombuffer(self._map, dtype=dtype, count=count,
                              offset=offset)
@@ -183,29 +229,104 @@ class ShmBackend(CollectiveBackend):
         arrays = [_to_numpy(e.tensor) for e in entries]
         dtype = arrays[0].dtype
         fused, _ = _pack_fused(arrays, response)
+        if fused.size == 0:
+            # Nothing to move; every rank short-circuits identically
+            # (sizes are negotiated), so no control rounds are owed.
+            _unpack_fused(entries, arrays, np.empty(0, dtype=dtype),
+                          response)
+            return Status.OK()
         seg = self._segment_for(fused.nbytes)
         if seg is None:
             return self._fallback.execute_allreduce(entries, response)
         _, stride = seg
-        out_off = ctl.size * stride
-        if ctl.is_coordinator:
-            ctl.gather_data(b"")  # all slots written
-            out = self._view(out_off, dtype, fused.size)
-            out[:] = fused
-            for r in range(1, ctl.size):
-                src = self._view(r * stride, dtype, fused.size)
-                if not _native.sum_into(out, src):
-                    out += src
-            ctl.broadcast_data(b"")
-            result = out.copy()
+        if self._hier:
+            result = self._hier_allreduce(fused, dtype, stride)
         else:
-            slot = self._view(ctl.rank * stride, dtype, fused.size)
-            slot[:] = fused
-            ctl.gather_data(b"")
-            ctl.broadcast_data(None)
-            result = self._view(out_off, dtype, fused.size).copy()
+            out_off = ctl.size * stride
+            if ctl.is_coordinator:
+                ctl.gather_data(b"")  # all slots written
+                out = self._view(out_off, dtype, fused.size)
+                out[:] = fused
+                for r in range(1, ctl.size):
+                    src = self._view(r * stride, dtype, fused.size)
+                    if not _native.sum_into(out, src):
+                        out += src
+                ctl.broadcast_data(b"")
+                result = out.copy()
+            else:
+                slot = self._view(ctl.rank * stride, dtype, fused.size)
+                slot[:] = fused
+                ctl.gather_data(b"")
+                ctl.broadcast_data(None)
+                result = self._view(out_off, dtype, fused.size).copy()
         _unpack_fused(entries, arrays, result, response)
         return Status.OK()
+
+    def _hier_allreduce(self, fused: np.ndarray, dtype,
+                        stride: int) -> np.ndarray:
+        """Multi-host allreduce: local shm reduce -> cross-host
+        exchange among LOCAL ROOTS only -> local shm broadcast. The
+        exact decomposition of the reference's
+        ``NCCLHierarchicalAllreduce`` (nccl_operations.cc:167-372:
+        intra-node reduce, inter-node exchange on one participant per
+        node, intra-node broadcast), with cross-host bytes cut from
+        N*S to K*S for K hosts.
+
+        Three control rounds, identical on every rank:
+          1. barrier — all local slots written;
+          2. data gather (roots carry their host's sum, others empty)
+             + scatter (roots get the world sum back, others empty);
+          3. barrier — out regions written; locals read.
+        """
+        ctl = self._ctl
+        t = ctl.topology
+        lr, ls = t.local_rank, t.local_size
+        out_off = ls * stride
+
+        if lr != 0:
+            slot = self._view(lr * stride, dtype, fused.size)
+            slot[:] = fused
+        self._world_barrier()  # round 1: every host's slots complete
+
+        if lr == 0:
+            acc = np.array(fused, dtype=dtype, copy=True)
+            for r in range(1, ls):
+                src = self._view(r * stride, dtype, fused.size)
+                if not _native.sum_into(acc, src):
+                    acc += src
+            payload = acc
+        else:
+            payload = b""
+        gathered = ctl.gather_data(payload)  # round 2a
+        # Root membership comes from the topology, not payload lengths,
+        # so the protocol is size-independent.
+        roots = set(t.local_roots)
+        if gathered is not None:  # coordinator (always a local root)
+            total = acc
+            for r in range(1, ctl.size):
+                if r in roots:
+                    src = np.frombuffer(gathered[r], dtype=dtype)
+                    if not _native.sum_into(total, src):
+                        total += src
+            blob = memoryview(total).cast("B")
+            payloads = [blob if r in roots else b""
+                        for r in range(ctl.size)]
+            payloads[0] = b""  # our own copy is ``total`` already
+            ctl.scatter_data(payloads)  # round 2b
+            result = total
+        else:
+            data = ctl.scatter_data(None)  # round 2b
+            result = (np.frombuffer(bytearray(data), dtype=dtype)
+                      if lr == 0 else None)
+
+        if lr == 0 and ls > 1:
+            # solo hosts have no readers — skip the out-region copy
+            out = self._view(out_off, dtype, fused.size)
+            out[:] = result
+        self._world_barrier()  # round 3: out regions complete
+        if lr != 0:
+            result = self._view(out_off, dtype, fused.size).copy()
+        return result
 
     def execute_allgather(self, entries, response: Response) -> Status:
         ctl = self._ctl

@@ -382,6 +382,57 @@ def scenario_autotune(hvd, rank, size):
         assert abs(pm.cycle_time_ms() - tuned[1]) < 1e-4
 
 
+def scenario_shm_hier_allreduce(hvd, rank, size):
+    """Multi-host (fake-host) world: allreduce rides the hierarchical
+    shm path — local shm reduce, cross exchange among local roots,
+    local shm broadcast (reference: NCCLHierarchicalAllreduce,
+    nccl_operations.cc:167-372) — while other collectives stay on the
+    socket backend."""
+    from horovod_tpu.common import basics as _b
+    ssum = sum(range(1, size + 1))
+
+    x = np.arange(50_000, dtype=np.float64) + rank
+    out = hvd.allreduce(x, average=False, name="sh.ar")
+    np.testing.assert_allclose(
+        out, size * np.arange(50_000, dtype=np.float64)
+        + sum(range(size)))
+
+    rt = _b.runtime()
+    shm = [b for b in rt.op_manager._backends if b.name == "shm"][0]
+    if hvd.local_size() > 1:
+        assert shm._map is not None, "hier shm segment not established"
+    else:
+        # a solo host shares memory with nobody: no segment
+        assert shm._map is None
+    assert shm._hier, "topology should be multi-host"
+
+    # zero-element allreduce must not wedge the protocol
+    z = hvd.allreduce(np.empty(0, np.float32), average=False,
+                      name="sh.zero")
+    assert np.asarray(z).size == 0
+
+    # fused batch + average through the hierarchical path
+    handles = [hvd.allreduce_async(
+        np.full(3000, float(rank + 1) * (i + 1), np.float32),
+        average=True, name=f"sh.f/{i}") for i in range(4)]
+    for i, h in enumerate(handles):
+        np.testing.assert_allclose(
+            hvd.synchronize(h), ssum * (i + 1) / size, rtol=1e-6)
+
+    # segment growth in hier mode
+    big = np.full(400_000, float(rank + 1), np.float32)
+    np.testing.assert_allclose(
+        hvd.allreduce(big, average=False, name="sh.big"), ssum)
+
+    # non-allreduce collectives still work (socket backend path)
+    g = hvd.allgather(np.full((rank + 1, 2), float(rank), np.float32),
+                      name="sh.ag")
+    assert g.shape[0] == sum(r + 1 for r in range(size))
+    b = hvd.broadcast(np.full(3, float(rank), np.float64), root_rank=1,
+                      name="sh.bc")
+    np.testing.assert_allclose(b, 1.0)
+
+
 def scenario_timeline(hvd, rank, size):
     """Drive one of each collective so rank 0's timeline (enabled via
     HOROVOD_TIMELINE in the harness env) records the full vocabulary

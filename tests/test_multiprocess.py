@@ -126,12 +126,30 @@ def test_shm_establishment_failure_falls_back_to_socket():
 
 
 def test_shm_disabled_for_multihost_topology():
-    """Forced 2-host topology: shm must NOT be selected (ranks do not
-    actually share memory in production multi-host worlds)."""
+    """Forced 2-host topology with ONE rank per host: nothing to gain
+    from shared memory, the shm backend must stand down."""
     run_scenario(
         "shm_multihost_disabled", 2, timeout=120.0,
         per_rank_env=lambda rank: {
             "HOROVOD_HOSTNAME": f"fakehost{rank}"})
+
+
+def test_shm_hierarchical_allreduce_two_hosts():
+    """4 ranks on 2 fake hosts: allreduce takes the hierarchical
+    local-reduce -> cross-roots -> local-broadcast shm path."""
+    run_scenario(
+        "shm_hier_allreduce", 4, timeout=180.0,
+        per_rank_env=lambda rank: {
+            "HOROVOD_HOSTNAME": f"fakehost{rank // 2}"})
+
+
+def test_shm_hierarchical_allreduce_uneven_hosts():
+    """3 ranks split 2+1: the solo host's local reduce is the identity
+    and its root still joins the cross exchange."""
+    run_scenario(
+        "shm_hier_allreduce", 3, timeout=180.0,
+        per_rank_env=lambda rank: {
+            "HOROVOD_HOSTNAME": f"fakehost{min(rank, 1)}"})
 
 
 def test_shape_mismatch_error():
