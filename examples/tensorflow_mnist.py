@@ -1,0 +1,77 @@
+"""MNIST with the TensorFlow eager adapter
+(reference: examples/tensorflow_mnist_eager.py).
+
+Run:  python -m horovod_tpu.run -np 2 python examples/tensorflow_mnist.py
+"""
+
+import argparse
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+
+def build_model():
+    return tf.keras.Sequential([
+        tf.keras.layers.Input((28, 28, 1)),
+        tf.keras.layers.Conv2D(10, 5, activation="relu"),
+        tf.keras.layers.MaxPool2D(2),
+        tf.keras.layers.Conv2D(20, 5, activation="relu"),
+        tf.keras.layers.MaxPool2D(2),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(50, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=0.001)
+    parser.add_argument("--fp16-allreduce", action="store_true")
+    args = parser.parse_args()
+
+    hvd.init()
+    tf.random.set_seed(42)
+
+    model = build_model()
+    # lr scaled by world size (reference: tensorflow_mnist_eager.py)
+    opt = tf.keras.optimizers.Adam(args.lr * hvd.size())
+    compression = (hvd.Compression.fp16 if args.fp16_allreduce
+                   else hvd.Compression.none)
+    loss_fn = tf.keras.losses.SparseCategoricalCrossentropy(
+        from_logits=True)
+
+    rng = np.random.RandomState(100 + hvd.rank())  # sharded data
+    x = rng.rand(512, 28, 28, 1).astype(np.float32)
+    y = rng.randint(0, 10, 512).astype(np.int64)
+
+    first_batch = True
+    steps = len(x) // args.batch_size
+    for epoch in range(args.epochs):
+        for i in range(steps):
+            sl = slice(i * args.batch_size, (i + 1) * args.batch_size)
+            with tf.GradientTape() as tape:
+                logits = model(x[sl], training=True)
+                loss = loss_fn(y[sl], logits)
+            # the framework's gradient path: every grad allreduced
+            tape = hvd.DistributedGradientTape(tape,
+                                               compression=compression)
+            grads = tape.gradient(loss, model.trainable_variables)
+            opt.apply_gradients(zip(grads, model.trainable_variables))
+            if first_batch:
+                # after the first step so optimizer slots exist
+                # (reference: tensorflow_mnist_eager.py broadcast on
+                # first batch)
+                hvd.broadcast_variables(model.variables, root_rank=0)
+                hvd.broadcast_variables(opt.variables, root_rank=0)
+                first_batch = False
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss {float(loss):.4f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

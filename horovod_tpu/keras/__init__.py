@@ -26,15 +26,12 @@ from horovod_tpu.ops import Average, Sum  # noqa: F401
 from horovod_tpu.keras import callbacks  # noqa: F401
 
 
-def DistributedOptimizer(optimizer, compression=Compression.none,
-                         op: int = Average, name: Optional[str] = None):
-    """Wrap a Keras-3 optimizer so ``apply_gradients`` first averages
-    gradients across workers (reference:
+def _distributed_class(cls, compression, op: int):
+    """Subclass of optimizer class ``cls`` whose ``apply_gradients``
+    first averages gradients across workers (reference:
     _keras/__init__.py:20-70 create_distributed_optimizer, which
     overrides get_gradients; Keras 3's seam is apply_gradients)."""
     import keras
-
-    cls = optimizer.__class__
 
     def _host_allreduce(host: np.ndarray, idx: int) -> np.ndarray:
         comp, ctx = compression.compress(host)
@@ -84,17 +81,42 @@ def DistributedOptimizer(optimizer, compression=Compression.none,
             return super().apply_gradients(
                 zip(reduced, variables), *args, **kwargs)
 
-    # Re-class the live instance instead of rebuilding from config:
-    # a from_config round-trip would silently drop accumulated slot
-    # variables / iteration count on load_model-restored optimizers.
+    # Keep the base NAME so configs record e.g. "SGD", but leave the
+    # module as horovod_tpu.keras ON PURPOSE: a saved distributed model
+    # restored by a plain keras load fails loudly ("Could not locate
+    # class") instead of silently coming back undistributed — the same
+    # failure mode as the reference, whose hvd.load_model supplies the
+    # custom_objects mapping (reference: _keras/__init__.py:93-109).
     _Distributed.__name__ = cls.__name__
-    optimizer.__class__ = _Distributed
+    _Distributed.__qualname__ = cls.__qualname__
+    return _Distributed
+
+
+def DistributedOptimizer(optimizer, compression=Compression.none,
+                         op: int = Average, name: Optional[str] = None):
+    """Wrap a live Keras-3 optimizer instance; see _distributed_class.
+
+    The instance is re-classed rather than rebuilt from config: a
+    from_config round-trip would silently drop accumulated slot
+    variables / iteration count on load_model-restored optimizers."""
+    optimizer.__class__ = _distributed_class(
+        optimizer.__class__, compression, op)
     return optimizer
 
 
 def broadcast_global_variables(model, root_rank: int = 0) -> None:
     """Broadcast model (+ optimizer) weights from root
-    (reference: horovod/keras/__init__.py broadcast_global_variables)."""
+    (reference: horovod/keras/__init__.py broadcast_global_variables).
+    The reference took only (root_rank) and read the TF1 session's
+    global variables; Keras 3 has no such session, so the model must
+    be passed — calls in the old shape fail with guidance instead of
+    binding the rank to ``model``."""
+    if isinstance(model, int):
+        raise TypeError(
+            "broadcast_global_variables(root_rank) needs the model in "
+            "Keras 3: call broadcast_global_variables(model, "
+            "root_rank=...) or use callbacks."
+            "BroadcastGlobalVariablesCallback(root_rank).")
     weights = model.get_weights()
     new_weights = []
     for i, w in enumerate(weights):
@@ -113,12 +135,20 @@ def broadcast_global_variables(model, root_rank: int = 0) -> None:
 
 
 def load_model(filepath, custom_objects=None, compression=Compression.none):
-    """Load a Keras model and wrap its optimizer in DistributedOptimizer
-    (reference: _keras/__init__.py:93-109 load_model)."""
+    """Load a Keras model, resolving distributed optimizers saved under
+    their base names and wrapping plain ones (reference:
+    _keras/__init__.py:93-109 load_model + custom_objects factory)."""
     import keras
 
-    model = keras.models.load_model(filepath,
-                                    custom_objects=custom_objects)
+    cos = dict(custom_objects or {})
+    for attr in dir(keras.optimizers):
+        c = getattr(keras.optimizers, attr)
+        if (isinstance(c, type)
+                and issubclass(c, keras.optimizers.Optimizer)
+                and c is not keras.optimizers.Optimizer):
+            cos.setdefault(attr,
+                           _distributed_class(c, compression, Average))
+    model = keras.models.load_model(filepath, custom_objects=cos)
     if getattr(model, "optimizer", None) is not None and \
             not getattr(model.optimizer, "_hvd_wrapped", False):
         model.optimizer = DistributedOptimizer(model.optimizer,

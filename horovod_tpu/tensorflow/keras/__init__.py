@@ -1,0 +1,28 @@
+"""tf.keras facade (reference: horovod/tensorflow/keras/__init__.py —
+a thin binding of the shared ``horovod/_keras`` implementation to
+``tf.keras``; since TF 2.16 ``tf.keras`` IS Keras 3, so the shared
+implementation here is ``horovod_tpu.keras`` itself).
+
+Import as ``import horovod_tpu.tensorflow.keras as hvd`` in scripts
+written against the reference's ``horovod.tensorflow.keras``.
+"""
+
+from __future__ import annotations
+
+from horovod_tpu.common.basics import (  # noqa: F401
+    init, shutdown, initialized, rank, size, local_rank, local_size,
+)
+from horovod_tpu.common.compression import Compression  # noqa: F401
+from horovod_tpu.ops import Average, Sum  # noqa: F401
+
+from horovod_tpu.keras import (  # noqa: F401
+    DistributedOptimizer, broadcast_global_variables, load_model,
+)
+from horovod_tpu.tensorflow.keras import callbacks  # noqa: F401
+
+
+__all__ = [
+    "init", "shutdown", "initialized", "rank", "size", "local_rank",
+    "local_size", "Average", "Sum", "Compression", "callbacks",
+    "DistributedOptimizer", "broadcast_global_variables", "load_model",
+]

@@ -1,0 +1,14 @@
+"""tf.keras callbacks facade (reference:
+horovod/tensorflow/keras/callbacks.py — re-export of the shared
+``horovod/_keras/callbacks.py`` suite; with Keras 3 the shared suite is
+``horovod_tpu.keras.callbacks``)."""
+
+from horovod_tpu.keras.callbacks import (  # noqa: F401
+    BroadcastGlobalVariablesCallback, LearningRateScheduleCallback,
+    LearningRateWarmupCallback, MetricAverageCallback,
+)
+
+__all__ = [
+    "BroadcastGlobalVariablesCallback", "MetricAverageCallback",
+    "LearningRateScheduleCallback", "LearningRateWarmupCallback",
+]

@@ -708,6 +708,60 @@ def scenario_keras_optimizer(hvd_mod, rank, size):
         np.testing.assert_allclose(gathered[r], gathered[0], atol=1e-6)
 
 
+def scenario_tfkeras_facade(hvd_mod, rank, size):
+    """horovod_tpu.tensorflow.keras (the tf.keras facade, reference:
+    horovod/tensorflow/keras/__init__.py): DistributedOptimizer +
+    BroadcastGlobalVariablesCallback through model.fit, then a
+    save -> load_model round trip that re-wraps the optimizer."""
+    import os
+    import tempfile
+    os.environ.setdefault("KERAS_BACKEND", "tensorflow")
+    import tensorflow as tf
+    import horovod_tpu.tensorflow.keras as hvd
+
+    tf.keras.utils.set_random_seed(100 + rank)  # divergent init
+    model = tf.keras.Sequential([
+        tf.keras.layers.Input((4,)),
+        tf.keras.layers.Dense(3, activation="relu"),
+        tf.keras.layers.Dense(2),
+    ])
+    opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(0.05))
+    model.compile(optimizer=opt, loss="mse")
+    rng = np.random.RandomState(rank)
+    x = rng.randn(16, 4).astype(np.float32)
+    y = rng.randn(16, 2).astype(np.float32)
+    # the broadcast callback must erase the divergent initialization
+    model.fit(x, y, epochs=1, batch_size=8, verbose=0, callbacks=[
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0)])
+
+    flat = np.concatenate([w.reshape(-1) for w in model.get_weights()])
+    gathered = hvd_mod.allgather(flat.reshape(1, -1), name="tfk.check")
+    for r in range(size):
+        np.testing.assert_allclose(gathered[r], gathered[0], atol=1e-6)
+
+    # save/load round trip restores a DISTRIBUTED optimizer; a plain
+    # keras load of the same file must fail loudly (the reference's
+    # failure mode, never a silently-undistributed optimizer)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "model.keras")
+        model.save(path)
+        loaded = hvd.load_model(path)
+        assert getattr(loaded.optimizer, "_hvd_wrapped", False)
+        try:
+            tf.keras.models.load_model(path)
+            raise AssertionError("plain load should fail loudly")
+        except TypeError:
+            pass
+
+    # reference call shape broadcast_global_variables(root) fails with
+    # guidance, not a confusing attribute error
+    try:
+        hvd.broadcast_global_variables(0)
+        raise AssertionError("old call shape should raise TypeError")
+    except TypeError as e:
+        assert "BroadcastGlobalVariablesCallback" in str(e)
+
+
 def scenario_tf_tape(hvd_mod, rank, size):
     """DistributedGradientTape averages grads across ranks
     (reference analog: test_tensorflow.py:334 allreduce_grad)."""

@@ -199,6 +199,10 @@ def test_tf_distributed_gradient_tape():
     run_scenario("tf_tape", 2, timeout=180.0)
 
 
+def test_tfkeras_facade():
+    run_scenario("tfkeras_facade", 2, timeout=240.0)
+
+
 def test_scalar_broadcast():
     run_scenario("scalar_broadcast", 2)
 
