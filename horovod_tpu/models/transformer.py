@@ -38,6 +38,13 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     # attention_fn(q, k, v, causal) -> out; None = local causal attention.
     attention_fn: Optional[Callable] = None
+    # Mixture-of-experts: 0 = dense MLP everywhere; E > 0 replaces the
+    # MLP of every ``moe_every``-th block with a Switch-style top-1
+    # MoE of E experts (expert parallelism: horovod_tpu.parallel
+    # shards the leading expert dim over a mesh axis).
+    num_experts: int = 0
+    moe_every: int = 2
+    expert_capacity_factor: float = 1.25
 
     @property
     def embed_dim(self) -> int:
@@ -117,8 +124,86 @@ class MLP(nn.Module):
                         name="down")(h)
 
 
+class MoEMLP(nn.Module):
+    """Switch-style top-1 mixture-of-experts MLP (the public
+    GShard / Switch Transformer pattern): fp32 router, one-hot
+    dispatch/combine einsums with a fixed per-expert capacity so the
+    whole layer is static-shaped and jit-friendly. Expert weights
+    carry a leading expert dimension that the sharding rules
+    (parallel/sharding.py moe rules) place on a mesh axis — GSPMD then
+    inserts the token all-to-alls that an NCCL-based expert-parallel
+    implementation would hand-code. The load-balancing auxiliary term
+    is sowed under ``intermediates/moe_aux`` (see
+    ``moe_aux_loss``)."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        E = cfg.num_experts
+        B, S, D = x.shape
+        H = cfg.mlp_ratio * cfg.embed_dim
+        # GShard-style token GROUPS (one per batch row): capacity and
+        # the dispatch one-hots scale with S, not B*S, keeping the
+        # layer's memory linear in the token count.
+        C = max(1, int(cfg.expert_capacity_factor * S / E))
+
+        # Router in fp32: softmax over experts must not quantize.
+        gate_logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
+                               name="router")(x.astype(jnp.float32))
+        probs = jax.nn.softmax(gate_logits, axis=-1)          # (B,S,E)
+        expert_idx = jnp.argmax(probs, axis=-1)               # (B,S)
+        gate = jnp.take_along_axis(probs, expert_idx[..., None],
+                                   axis=-1)[..., 0]           # (B,S)
+        onehot = jax.nn.one_hot(expert_idx, E,
+                                dtype=jnp.float32)            # (B,S,E)
+
+        # Switch load-balance aux: E * sum_e f_e * P_e where f_e is the
+        # fraction of tokens routed to e and P_e the mean router prob.
+        self.sow("intermediates", "moe_aux",
+                 E * jnp.sum(jnp.mean(onehot, axis=(0, 1))
+                             * jnp.mean(probs, axis=(0, 1))))
+
+        # Position of each token within its expert's capacity buffer
+        # (per group); overflow tokens are dropped (contribute zero,
+        # like Switch).
+        pos = jnp.cumsum(onehot, axis=1) * onehot - 1.0
+        keep = ((pos >= 0) & (pos < C)).astype(jnp.float32)
+        disp = jax.nn.one_hot(pos.astype(jnp.int32), C,
+                              dtype=jnp.float32) \
+            * (onehot * keep)[..., None]                      # (B,S,E,C)
+
+        expert_in = jnp.einsum("bsec,bsd->becd",
+                               disp.astype(cfg.dtype),
+                               x.astype(cfg.dtype))           # (B,E,C,D)
+        w1 = self.param("w1", nn.initializers.lecun_normal(),
+                        (E, D, H), jnp.float32).astype(cfg.dtype)
+        w2 = self.param("w2", nn.initializers.lecun_normal(),
+                        (E, H, D), jnp.float32).astype(cfg.dtype)
+        h = nn.gelu(jnp.einsum("becd,edh->bech", expert_in, w1))
+        expert_out = jnp.einsum("bech,ehd->becd", h, w2)      # (B,E,C,D)
+
+        combine = (disp * gate[..., None, None]).astype(cfg.dtype)
+        return jnp.einsum("bsec,becd->bsd", combine, expert_out)
+
+
+def moe_aux_loss(intermediates) -> jnp.ndarray:
+    """Sum of the sowed Switch load-balancing terms; add
+    ``alpha * moe_aux_loss(...)`` (alpha ~ 0.01) to the task loss when
+    training MoE configs (apply with ``mutable=['intermediates']``)."""
+    leaves = [v for path, v in
+              jax.tree_util.tree_flatten_with_path(intermediates)[0]
+              if "moe_aux" in "/".join(str(p) for p in path)]
+    total = jnp.zeros((), jnp.float32)
+    for leaf in leaves:
+        total = total + jnp.sum(jnp.asarray(leaf))
+    return total
+
+
 class Block(nn.Module):
     cfg: TransformerConfig
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, positions):
@@ -127,7 +212,10 @@ class Block(nn.Module):
                                        dtype=cfg.dtype, name=name,
                                        param_dtype=jnp.float32)
         x = x + Attention(cfg, name="attn")(ln("ln1")(x), positions)
-        x = x + MLP(cfg, name="mlp")(ln("ln2")(x))
+        if self.use_moe:
+            x = x + MoEMLP(cfg, name="moe")(ln("ln2")(x))
+        else:
+            x = x + MLP(cfg, name="mlp")(ln("ln2")(x))
         return x
 
 
@@ -145,7 +233,10 @@ class TransformerLM(nn.Module):
         x = nn.Embed(cfg.vocab_size, cfg.embed_dim, dtype=cfg.dtype,
                      name="embed")(tokens)
         for i in range(cfg.num_layers):
-            x = Block(cfg, name=f"block_{i}")(x, positions)
+            use_moe = (cfg.num_experts > 0
+                       and i % cfg.moe_every == cfg.moe_every - 1)
+            x = Block(cfg, use_moe=use_moe, name=f"block_{i}")(
+                x, positions)
         x = nn.LayerNorm(use_bias=False, dtype=cfg.dtype,
                          param_dtype=jnp.float32, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False,

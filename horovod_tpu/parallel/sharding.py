@@ -45,11 +45,29 @@ def _path_str(path) -> str:
 
 
 def infer_sharding(params, rules: ShardingRules, mesh):
-    """Map a parameter pytree to a pytree of NamedShardings."""
+    """Map a parameter pytree to a pytree of NamedShardings, rejecting
+    indivisible placements with an actionable error (e.g. an expert
+    axis larger than num_experts) instead of a deep device_put
+    failure."""
     from jax.sharding import NamedSharding
 
     def one(path, leaf):
-        spec = rules.spec_for(_path_str(path), getattr(leaf, "shape", None))
+        p = _path_str(path)
+        spec = rules.spec_for(p, getattr(leaf, "shape", None))
+        shape = getattr(leaf, "shape", None)
+        if shape is not None:
+            for dim, axes in zip(shape, tuple(spec)):
+                if axes is None:
+                    continue
+                axes = (axes,) if isinstance(axes, str) else axes
+                n = int(np.prod([mesh.shape[a] for a in axes]))
+                if n > 1 and dim % n != 0:
+                    raise ValueError(
+                        f"parameter {p} (shape {tuple(shape)}) cannot "
+                        f"shard dim of size {dim} over mesh axes "
+                        f"{axes} (total size {n}); pick an axis whose "
+                        f"size divides the dimension (for MoE: an "
+                        f"expert axis dividing num_experts).")
         return NamedSharding(mesh, spec)
 
     return jax.tree_util.tree_map_with_path(one, params)
@@ -61,14 +79,31 @@ def shard_params(params, rules: ShardingRules, mesh):
     return jax.tree_util.tree_map(jax.device_put, params, shardings)
 
 
-def transformer_tp_rules(model_axis: str = "model") -> ShardingRules:
+def transformer_tp_rules(model_axis: str = "model",
+                         expert_axis: "str | None" = None
+                         ) -> ShardingRules:
     """Megatron-style sharding for models/transformer.py: column-split
     the fan-out matmuls (qkv, mlp up), row-split the fan-in matmuls
     (attn out, mlp down) so each block needs one psum on exit; XLA
-    inserts it from these annotations."""
+    inserts it from these annotations.
+
+    With ``expert_axis`` set, MoE expert weights additionally shard
+    their leading expert dimension over that axis (expert parallelism —
+    GSPMD inserts the token all-to-alls), composed with the Megatron
+    split of each expert's hidden dimension over ``model_axis``. The
+    fp32 router stays replicated. ``expert_axis`` may name any mesh
+    axis, including the data axis (GShard's experts-over-dp layout)."""
     from jax.sharding import PartitionSpec as P
     m = model_axis
-    return ShardingRules([
+    rules = []
+    if expert_axis is not None:
+        e = expert_axis
+        rules += [
+            (r"moe/w1$",             P(e, None, m)),
+            (r"moe/w2$",             P(e, m, None)),
+            (r"moe/router/kernel$",  P()),
+        ]
+    rules += [
         (r"embed/embedding$",        P(None, m)),
         (r"attn/(q|k|v)/kernel$",    P(None, m, None)),
         (r"attn/o/kernel$",          P(m, None, None)),
@@ -76,7 +111,8 @@ def transformer_tp_rules(model_axis: str = "model") -> ShardingRules:
         (r"mlp/down/kernel$",        P(m, None)),
         (r"lm_head/kernel$",         P(None, m)),
         # layernorms and everything else: replicated (default)
-    ])
+    ]
+    return ShardingRules(rules)
 
 
 def resnet_dp_rules() -> ShardingRules:

@@ -31,6 +31,7 @@ class TrainerConfig:
     data_axis: str = "data"
     model_axis: Optional[str] = "model"   # None = no tensor parallelism
     seq_axis: Optional[str] = None        # None = no sequence parallelism
+    expert_axis: Optional[str] = None     # None = no expert parallelism
     # Sequence parallelism needs a ring attention_fn in the model config
     # (parallel.make_ring_attention) — injected there, not a flag here,
     # because the attention implementation lives in the module tree.
@@ -55,9 +56,16 @@ class Trainer:
         self.tx = tx
         self.config = config
         if rules is None:
-            rules = (transformer_tp_rules(config.model_axis)
-                     if config.model_axis and config.model_axis
-                     in mesh.axis_names else ShardingRules([]))
+            m = (config.model_axis
+                 if config.model_axis
+                 and config.model_axis in mesh.axis_names else None)
+            ep = (config.expert_axis
+                  if config.expert_axis
+                  and config.expert_axis in mesh.axis_names else None)
+            # EP works with or without TP: PartitionSpec treats a None
+            # axis entry as replicated, so the rules compose naturally.
+            rules = (transformer_tp_rules(m, expert_axis=ep)
+                     if (m or ep) else ShardingRules([]))
         self.rules = rules
         self.loss_fn = loss_fn or _default_lm_loss
         if batch_spec is None:
@@ -111,8 +119,18 @@ class Trainer:
         return self.step_fn()(state, batch)
 
 
+_MOE_AUX_WEIGHT = 0.01  # Switch Transformer's alpha
+
+
 def _default_lm_loss(apply_fn, params, batch):
-    from horovod_tpu.models.transformer import lm_loss
+    """Next-token LM loss + the Switch load-balancing auxiliary for MoE
+    configs (sowed by MoEMLP; zero for dense models). Without the aux
+    term a top-1 router collapses onto one expert and the fixed
+    capacity silently drops the overflow tokens."""
+    from horovod_tpu.models.transformer import lm_loss, moe_aux_loss
     tokens = batch["tokens"] if isinstance(batch, dict) else batch
-    logits = apply_fn(params, tokens)
-    return lm_loss(logits, tokens)
+    logits, mutated = apply_fn(params, tokens,
+                               mutable=["intermediates"])
+    loss = lm_loss(logits, tokens)
+    aux = moe_aux_loss(mutated.get("intermediates", {}))
+    return loss + _MOE_AUX_WEIGHT * aux

@@ -227,6 +227,29 @@ def scenario_shm_collectives(hvd, rank, size):
     hvd.barrier(name="shm.bar")
 
 
+def scenario_rank_death(hvd, rank, size):
+    """A rank dying abruptly mid-job must surface on the survivors as
+    a clean shutdown error on the next collective — never a hang
+    (reference analog: shutdown fan-out + SHUT_DOWN_ERROR callbacks,
+    operations.cc:898-913; under mpirun the dead orted kills the world,
+    here the library itself detects the dead control channel)."""
+    import time
+    from horovod_tpu.common.status import HorovodInternalError
+    x = np.full(50, float(rank + 1), np.float32)
+    out = hvd.allreduce(x, average=False, name="rd.ok")
+    np.testing.assert_allclose(out, sum(range(1, size + 1)))
+    if rank == 1:
+        os._exit(0)  # abrupt death; 0 so the harness reads it as clean
+    time.sleep(0.5)
+    try:
+        hvd.allreduce(x, average=False, name="rd.after")
+        raise AssertionError("collective after a rank death must fail")
+    except HorovodInternalError:
+        pass
+    # shutdown after the world collapsed stays idempotent
+    hvd.shutdown()
+
+
 def scenario_subset_world(hvd, rank, size):
     """hvd.init(comm=[1, 2]) on a 3-process launch: ranks 1 and 2 form
     a 2-rank sub-world (renumbered 0 and 1, rank 1 hosting the

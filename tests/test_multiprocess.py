@@ -207,6 +207,12 @@ def test_scalar_broadcast():
     run_scenario("scalar_broadcast", 2)
 
 
+def test_rank_death_fails_survivors_cleanly():
+    """Kill one of three ranks mid-job: the other two must error out
+    with HorovodInternalError on their next collective, not hang."""
+    run_scenario("rank_death", 3, timeout=60.0)
+
+
 def test_rank_subset_init():
     """init(comm=[1, 2]) on 3 processes: the 2-rank subset allreduces
     while the third abstains in a size-1 world."""

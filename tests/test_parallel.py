@@ -327,3 +327,151 @@ def test_flash_attention_stats_values():
     l_ref = np.exp(logits - m_ref[..., None]).sum(-1)
     np.testing.assert_allclose(np.asarray(m), m_ref, atol=1e-5)
     np.testing.assert_allclose(np.asarray(l), l_ref, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Expert parallelism (MoE)
+# ---------------------------------------------------------------------------
+
+def test_moe_matches_per_token_reference():
+    """MoEMLP's dispatch/combine einsums == routing each token through
+    its argmax expert directly (capacity ample, nothing dropped)."""
+    from horovod_tpu.models.transformer import MoEMLP, TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=64, num_layers=1, num_heads=2,
+                            head_dim=4, mlp_ratio=2, dtype=jnp.float32,
+                            num_experts=4, expert_capacity_factor=4.0)
+    layer = MoEMLP(cfg)
+    x = jax.random.normal(jax.random.key(0), (2, 8, cfg.embed_dim),
+                          jnp.float32)
+    variables = layer.init(jax.random.key(1), x)
+    y = layer.apply(variables, x)
+
+    p = variables["params"]
+    wr = np.asarray(p["router"]["kernel"], np.float64)
+    w1 = np.asarray(p["w1"], np.float64)
+    w2 = np.asarray(p["w2"], np.float64)
+    xt = np.asarray(x, np.float64).reshape(-1, cfg.embed_dim)
+    logits = xt @ wr
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    idx = probs.argmax(-1)
+    ref = np.zeros_like(xt)
+    gelu = lambda v: 0.5 * v * (1 + np.tanh(
+        np.sqrt(2 / np.pi) * (v + 0.044715 * v ** 3)))
+    for n in range(xt.shape[0]):
+        e = idx[n]
+        ref[n] = probs[n, e] * (gelu(xt[n] @ w1[e]) @ w2[e])
+    np.testing.assert_allclose(np.asarray(y).reshape(-1, cfg.embed_dim),
+                               ref, rtol=2e-4, atol=2e-5)
+
+
+def test_moe_capacity_drops_overflow_tokens():
+    """With capacity 1 and every token routed to one expert, only the
+    first token per expert survives; the rest combine to zero."""
+    from horovod_tpu.models.transformer import MoEMLP, TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=64, num_layers=1, num_heads=2,
+                            head_dim=4, mlp_ratio=2, dtype=jnp.float32,
+                            num_experts=2,
+                            expert_capacity_factor=2 / 8.0)  # C = 1
+    layer = MoEMLP(cfg)
+    x = jnp.tile(jax.random.normal(jax.random.key(0),
+                                   (1, 1, cfg.embed_dim)), (1, 4, 1))
+    variables = layer.init(jax.random.key(1), x)
+    y = np.asarray(layer.apply(variables, x))[0]
+    # identical tokens -> same expert; capacity 1 keeps only token 0
+    assert np.any(y[0] != 0.0)
+    np.testing.assert_allclose(y[1:], 0.0)
+
+
+def test_trainer_dp_tp_ep_step_runs_and_shards_experts():
+    """dp x tp x ep on the 8-device CPU mesh: expert weights sharded
+    over the expert axis (composed with the per-expert Megatron split),
+    the step runs, and the loss improves."""
+    import optax
+    from horovod_tpu.models.transformer import TransformerConfig
+
+    mesh = spmd.create_mesh({"data": 2, "expert": 2, "model": 2})
+    cfg = TransformerConfig(vocab_size=64, num_layers=2, num_heads=4,
+                            head_dim=8, max_seq_len=16,
+                            dtype=jnp.float32, num_experts=2,
+                            moe_every=2)
+    trainer = Trainer(TransformerLM(cfg), mesh, optax.adam(1e-2),
+                      TrainerConfig(data_axis="data", model_axis="model",
+                                    expert_axis="expert"))
+    tokens = np.tile(np.arange(16, dtype=np.int32)[None], (8, 1))
+    batch = {"tokens": tokens}
+    state = trainer.init(jax.random.key(0), batch)
+
+    moe_params = state["params"]["params"]["block_1"]["moe"]
+    w1_sharding = moe_params["w1"].sharding
+    assert w1_sharding.spec == P("expert", None, "model"), w1_sharding
+    router_sharding = moe_params["router"]["kernel"].sharding
+    assert router_sharding.spec == P(), router_sharding
+
+    losses = []
+    for _ in range(5):
+        state, loss = trainer.train_step(state, batch)
+        losses.append(float(loss))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
+def test_moe_aux_loss_sowed():
+    from horovod_tpu.models.transformer import (
+        TransformerConfig, TransformerLM, moe_aux_loss,
+    )
+    cfg = TransformerConfig(vocab_size=64, num_layers=2, num_heads=2,
+                            head_dim=4, dtype=jnp.float32,
+                            num_experts=2, moe_every=2)
+    model = TransformerLM(cfg)
+    tokens = jnp.zeros((2, 8), jnp.int32)
+    variables = model.init(jax.random.key(0), tokens)
+    _, inter = model.apply(variables, tokens,
+                           mutable=["intermediates"])
+    aux = moe_aux_loss(inter["intermediates"])
+    # perfectly balanced routing gives aux == 1.0; anything routed
+    # gives a finite positive value >= 1 for top-1 switch gating
+    assert float(aux) >= 1.0 - 1e-3
+
+
+def test_ep_without_tp_still_shards_experts():
+    """expert_axis without model_axis must still emit expert rules
+    (PartitionSpec treats the absent model split as replicated)."""
+    import optax
+    from horovod_tpu.models.transformer import TransformerConfig
+
+    mesh = spmd.create_mesh({"data": 2, "expert": 4})
+    cfg = TransformerConfig(vocab_size=64, num_layers=2, num_heads=2,
+                            head_dim=4, dtype=jnp.float32,
+                            num_experts=4, moe_every=2)
+    trainer = Trainer(TransformerLM(cfg), mesh, optax.sgd(1e-2),
+                      TrainerConfig(data_axis="data", model_axis=None,
+                                    expert_axis="expert"))
+    batch = {"tokens": np.tile(np.arange(8, dtype=np.int32)[None],
+                               (4, 1))}
+    state = trainer.init(jax.random.key(0), batch)
+    w1 = state["params"]["params"]["block_1"]["moe"]["w1"]
+    assert w1.sharding.spec == P("expert", None, None), w1.sharding
+    state, loss = trainer.train_step(state, batch)
+    assert np.isfinite(float(loss))
+
+
+def test_indivisible_expert_axis_fails_with_clear_error():
+    """An expert axis larger than num_experts must fail at init with an
+    actionable message, not a deep device_put error."""
+    import optax
+    import pytest as _pytest
+    from horovod_tpu.models.transformer import TransformerConfig
+
+    mesh = spmd.create_mesh({"data": 1, "expert": 8})
+    cfg = TransformerConfig(vocab_size=64, num_layers=2, num_heads=2,
+                            head_dim=4, dtype=jnp.float32,
+                            num_experts=2, moe_every=2)
+    trainer = Trainer(TransformerLM(cfg), mesh, optax.sgd(1e-2),
+                      TrainerConfig(data_axis="data", model_axis=None,
+                                    expert_axis="expert"))
+    batch = {"tokens": np.zeros((1, 8), np.int32)}
+    with _pytest.raises(ValueError, match="num_experts"):
+        trainer.init(jax.random.key(0), batch)
