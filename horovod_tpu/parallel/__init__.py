@@ -5,9 +5,11 @@ parallelism via allreduce (reference: horovod/tensorflow/__init__.py:151
 DistributedOptimizer; SURVEY §2.3) — and no sequence/long-context
 support at all. These are first-class here:
 
-- ``sharding``        — rule-based parameter sharding (tensor parallelism)
+- ``sharding``        — rule-based parameter sharding (tensor + expert
+                        parallelism)
 - ``ring_attention``  — sequence/context parallelism for long sequences
-- ``trainer``         — composes dp x tp x sp into one jitted train step
+- ``pipeline``        — GPipe-style pipeline parallelism over a mesh axis
+- ``trainer``         — composes dp x tp x sp x ep into one jitted step
 """
 
 from horovod_tpu.parallel.sharding import (
@@ -16,10 +18,14 @@ from horovod_tpu.parallel.sharding import (
 from horovod_tpu.parallel.ring_attention import (
     ring_attention, make_ring_attention,
 )
+from horovod_tpu.parallel.pipeline import (
+    make_pipeline_apply, pipeline_stages,
+)
 from horovod_tpu.parallel.trainer import Trainer, TrainerConfig
 
 __all__ = [
     "ShardingRules", "infer_sharding", "transformer_tp_rules",
     "ring_attention", "make_ring_attention",
+    "pipeline_stages", "make_pipeline_apply",
     "Trainer", "TrainerConfig",
 ]
