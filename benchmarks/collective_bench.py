@@ -328,10 +328,19 @@ def main() -> None:
               f"{f['us_per_batch']} us/batch  bus {f['busbw_MBps']} MB/s")
         sweeps[variant] = coll
 
+    def _median_world(mode, size, runs=3):
+        """Whole-world repeats: a single world can land entirely inside
+        one of this host's multi-second stall windows (see module
+        docstring), so the scaling legs take the median of three."""
+        vals = [_run_world(mode, size)["steps_per_sec"]
+                for _ in range(runs)]
+        return {"steps_per_sec": sorted(vals)[len(vals) // 2],
+                "runs": vals}
+
     print(f"== scaling (data-parallel MLP, real compute on "
           f"{cores} core(s)) ==", flush=True)
-    t1 = _run_world("train", 1)
-    tn = _run_world("train", np_)
+    t1 = _median_world("train", 1)
+    tn = _median_world("train", np_)
     eff = tn["steps_per_sec"] / t1["steps_per_sec"]
     ideal = min(cores, np_) / np_
     print(f"  np=1: {t1['steps_per_sec']} steps/s   "
@@ -343,8 +352,8 @@ def main() -> None:
 
     print(f"== scaling (fixed {FIXED_COMPUTE_S * 1e3:.0f} ms compute — "
           f"parallelizable, isolates comm overhead) ==", flush=True)
-    f1 = _run_world("fixed_compute", 1)
-    fn = _run_world("fixed_compute", np_)
+    f1 = _median_world("fixed_compute", 1)
+    fn = _median_world("fixed_compute", np_)
     fc_eff = fn["steps_per_sec"] / f1["steps_per_sec"]
     print(f"  np=1: {f1['steps_per_sec']} steps/s   "
           f"np={np_}: {fn['steps_per_sec']} steps/s   "

@@ -69,6 +69,12 @@ class Config:
     # mpi_operations.cc:179-329). HOROVOD_TPU_SHM=0 forces sockets.
     shm_enabled: bool = True
 
+    # Idle backoff for the background loop (TPU-native extension): after
+    # a grace period of empty cycles the negotiation sleep ramps toward
+    # this cap instead of waking every cycle_time_ms forever; enqueue
+    # snaps it awake immediately. 0 disables (reference behavior).
+    idle_backoff_ms: float = 25.0
+
     # Hierarchical collectives (reference: operations.cc:822-841); on TPU
     # this selects ICI×DCN mesh-axis-factored collectives (read by the
     # spmd hierarchical helpers; the flat TCP/XLA backends ignore it).
@@ -133,6 +139,8 @@ class Config:
         c.ring_threshold_bytes = _env_int(
             "HOROVOD_TPU_RING_THRESHOLD", c.ring_threshold_bytes)
         c.shm_enabled = _env_bool("HOROVOD_TPU_SHM", c.shm_enabled)
+        c.idle_backoff_ms = _env_float(
+            "HOROVOD_TPU_IDLE_BACKOFF", c.idle_backoff_ms)
         c.hierarchical_allreduce = _env_bool(
             "HOROVOD_HIERARCHICAL_ALLREDUCE", c.hierarchical_allreduce)
         c.hierarchical_allgather = _env_bool(

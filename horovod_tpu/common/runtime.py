@@ -85,6 +85,18 @@ class Runtime:
         self._error: Optional[Exception] = None
         # Autotune plumbing: bytes reduced this cycle.
         self._cycle_bytes = 0
+        # Idle backoff: after _IDLE_GRACE empty cycles the loop ramps
+        # its sleep toward config.idle_backoff_ms instead of spinning
+        # the negotiation at full cycle rate forever (the reference
+        # wakes every cycle_time_ms regardless, operations.cc:987-995 —
+        # needless wakeups on a TPU host whose hot path is in-jit).
+        # ``_wake`` snaps the loop awake the moment work arrives or
+        # shutdown is requested, so pickup latency IMPROVES over a
+        # fixed cycle; each rank's sleep is local, and a straggling
+        # rank only delays the blocking gather, never deadlocks it.
+        self._idle_cycles = 0
+        self._cycle_count = 0  # lifetime cycles (observability/tests)
+        self._wake = threading.Event()
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:
@@ -95,6 +107,7 @@ class Runtime:
 
     def request_shutdown(self) -> None:
         self._shutdown_requested.set()
+        self._wake.set()
 
     def join(self, timeout: Optional[float] = None) -> None:
         if self._thread is not None:
@@ -131,6 +144,7 @@ class Runtime:
             # its handle cannot hang forever.
             if self.tensor_table.pop_entry_if_present(entry.tensor_name):
                 return Status.Aborted(SHUT_DOWN_ERROR)
+        self._wake.set()  # snap an idle-backed-off loop awake
         return Status.OK()
 
     # -- the loop --------------------------------------------------------
@@ -159,10 +173,13 @@ class Runtime:
             except Exception:
                 pass
 
+    _IDLE_GRACE = 16  # empty cycles before the backoff ramp starts
+
     def _run_loop_once(self) -> bool:
         """One negotiation cycle; returns False to exit
         (reference: operations.cc:986-1338)."""
         t0 = time.monotonic()
+        self._cycle_count += 1
         self.timeline.mark_cycle_start()
 
         requests = self.tensor_table.pop_messages()
@@ -194,11 +211,26 @@ class Runtime:
             self.parameter_manager.on_cycle(self._cycle_bytes)
             self._cycle_bytes = 0
             cycle_time_ms = self.parameter_manager.cycle_time_ms()
+        if resp_list.responses or requests:
+            # Local submissions count as activity too: a rank whose own
+            # tensor is still negotiating (peers not yet submitted)
+            # must keep cycling at full rate or the blocking gather
+            # makes the whole world pay its backoff sleep.
+            self._idle_cycles = 0
+        else:
+            self._idle_cycles += 1
         elapsed = time.monotonic() - t0
         sleep_s = cycle_time_ms / 1000.0 - elapsed
+        backoff_ms = getattr(self.config, "idle_backoff_ms", 0.0)
+        if backoff_ms > 0 and self._idle_cycles > self._IDLE_GRACE:
+            ramp = (cycle_time_ms / 1000.0
+                    * (self._idle_cycles - self._IDLE_GRACE))
+            sleep_s = max(sleep_s, min(backoff_ms / 1000.0, ramp))
         if sleep_s > 0:
-            # Wake early if shutdown is requested so exit latency stays low.
-            self._shutdown_requested.wait(sleep_s)
+            # Wake early on shutdown OR new local work (enqueue sets
+            # _wake) so backoff never adds submit latency.
+            self._wake.wait(sleep_s)
+        self._wake.clear()
         return True
 
     def _coordinate(self, gathered: List[bytes]) -> ResponseList:

@@ -151,3 +151,63 @@ class TestCompression:
         x = np.arange(4, dtype=np.int64)
         c, ctx = Compression.fp16.compress(x)
         assert c.dtype == np.int64
+
+
+class TestIdleBackoff:
+    def test_idle_loop_backs_off_and_wakes_on_enqueue(self, monkeypatch):
+        """After the grace period the negotiation loop must slow to the
+        backoff cap instead of waking every cycle, and an enqueue must
+        snap it awake (so submit latency never pays the backoff)."""
+        import time
+        import horovod_tpu as hvd
+        from horovod_tpu.common import basics as _b
+        hvd.shutdown()
+        monkeypatch.setenv("HOROVOD_CYCLE_TIME", "1")
+        monkeypatch.setenv("HOROVOD_TPU_IDLE_BACKOFF", "25")
+        hvd.init()
+        try:
+            rt = _b.runtime()
+            time.sleep(0.3)  # pass the grace period
+            c0 = rt._cycle_count
+            time.sleep(0.5)
+            idle_rate = rt._cycle_count - c0
+            # 1 ms cycles would be ~500; the 25 ms cap bounds it to ~20
+            assert idle_rate < 120, idle_rate
+            # wake-on-enqueue: completion far faster than the backoff
+            # window would allow if the loop stayed asleep
+            t0 = time.monotonic()
+            out = hvd.allreduce(np.ones(4, np.float32), average=False,
+                                name="wake.test")
+            latency = time.monotonic() - t0
+            np.testing.assert_allclose(out, 1.0)
+            assert latency < 1.0, latency
+        finally:
+            hvd.shutdown()
+
+    def test_backoff_disabled_keeps_full_cycle_rate(self, monkeypatch):
+        """Relative comparison (same process, back to back) so host
+        slowness cancels out: the backoff-off loop must cycle several
+        times faster than the backed-off loop."""
+        import time
+        import horovod_tpu as hvd
+        from horovod_tpu.common import basics as _b
+
+        def idle_rate(backoff_ms):
+            hvd.shutdown()
+            monkeypatch.setenv("HOROVOD_CYCLE_TIME", "1")
+            monkeypatch.setenv("HOROVOD_TPU_IDLE_BACKOFF",
+                               str(backoff_ms))
+            hvd.init()
+            try:
+                rt = _b.runtime()
+                time.sleep(0.3)  # pass the grace period
+                c0 = rt._cycle_count
+                t0 = time.monotonic()
+                time.sleep(0.5)
+                return (rt._cycle_count - c0) / (time.monotonic() - t0)
+            finally:
+                hvd.shutdown()
+
+        rate_off = idle_rate(0)
+        rate_on = idle_rate(25)
+        assert rate_off > 3 * rate_on, (rate_off, rate_on)
