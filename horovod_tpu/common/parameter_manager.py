@@ -62,8 +62,11 @@ class ParameterManager:
     def apply_synced(self, fusion_threshold_bytes: int,
                      cycle_time_ms: float) -> None:
         """Workers adopt the coordinator's tuned values (reference:
-        SyncParams, parameter_manager.cc:64-78)."""
-        if not self._is_coordinator and fusion_threshold_bytes > 0:
+        SyncParams, parameter_manager.cc:64-78). The untuned-trailer
+        sentinel is cycle_time_ms == 0: real tuned cycle times are
+        bounded >= 1 ms, while a FUSION threshold of 0 MB is a
+        legitimate tuned value (fusion off) and must still be adopted."""
+        if not self._is_coordinator and cycle_time_ms > 0:
             self._current = np.asarray(
                 [fusion_threshold_bytes / _MB, cycle_time_ms])
 

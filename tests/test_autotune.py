@@ -118,3 +118,12 @@ class TestParameterManager:
         pm.apply_synced(32 << 20, 7.5)
         assert pm.fusion_threshold_bytes() == 32 << 20
         assert pm.cycle_time_ms() == 7.5
+        # a tuned fusion threshold of 0 MB (fusion off) is legitimate
+        # and must be adopted — only cycle_time 0 marks an untuned
+        # trailer (regression: the 0-threshold sentinel collision)
+        pm.apply_synced(0, 100.0)
+        assert pm.fusion_threshold_bytes() == 0
+        assert pm.cycle_time_ms() == 100.0
+        before = pm.fusion_threshold_bytes(), pm.cycle_time_ms()
+        pm.apply_synced(0, 0.0)  # untuned trailer: ignored
+        assert (pm.fusion_threshold_bytes(), pm.cycle_time_ms()) == before
