@@ -45,6 +45,7 @@ class TransformerConfig:
     num_experts: int = 0
     moe_every: int = 2
     expert_capacity_factor: float = 1.25
+    moe_top_k: int = 1  # 1 = Switch; 2 = GShard-style top-2 gating
 
     @property
     def embed_dim(self) -> int:
@@ -142,6 +143,10 @@ class MoEMLP(nn.Module):
     def __call__(self, x):
         cfg = self.cfg
         E = cfg.num_experts
+        if cfg.moe_top_k > E:
+            raise ValueError(
+                f"moe_top_k={cfg.moe_top_k} exceeds num_experts={E}; "
+                f"a token cannot be routed to more experts than exist")
         B, S, D = x.shape
         H = cfg.mlp_ratio * cfg.embed_dim
         # GShard-style token GROUPS (one per batch row): capacity and
@@ -153,26 +158,45 @@ class MoEMLP(nn.Module):
         gate_logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
                                name="router")(x.astype(jnp.float32))
         probs = jax.nn.softmax(gate_logits, axis=-1)          # (B,S,E)
-        expert_idx = jnp.argmax(probs, axis=-1)               # (B,S)
-        gate = jnp.take_along_axis(probs, expert_idx[..., None],
-                                   axis=-1)[..., 0]           # (B,S)
-        onehot = jax.nn.one_hot(expert_idx, E,
-                                dtype=jnp.float32)            # (B,S,E)
 
-        # Switch load-balance aux: E * sum_e f_e * P_e where f_e is the
-        # fraction of tokens routed to e and P_e the mean router prob.
+        # Top-k choice loop (k=1: Switch; k=2: GShard). Each choice
+        # masks out the experts already chosen; gates renormalize over
+        # the chosen set; capacity positions continue per expert across
+        # choices (GShard's choice-major packing: all first choices
+        # claim capacity before any second choice).
+        left = probs
+        onehots, gates = [], []
+        for _ in range(max(1, cfg.moe_top_k)):
+            idx = jnp.argmax(left, axis=-1)                   # (B,S)
+            oh = jax.nn.one_hot(idx, E, dtype=jnp.float32)    # (B,S,E)
+            onehots.append(oh)
+            gates.append(jnp.sum(probs * oh, axis=-1))        # (B,S)
+            left = left * (1.0 - oh)
+        if cfg.moe_top_k > 1:
+            # GShard renormalizes over the chosen pair; Switch (k=1)
+            # keeps the raw router probability as the gate.
+            denom = sum(gates) + 1e-9
+            gates = [g / denom for g in gates]
+
+        # Load-balance aux over the FIRST choice (the Switch term).
         self.sow("intermediates", "moe_aux",
-                 E * jnp.sum(jnp.mean(onehot, axis=(0, 1))
+                 E * jnp.sum(jnp.mean(onehots[0], axis=(0, 1))
                              * jnp.mean(probs, axis=(0, 1))))
 
-        # Position of each token within its expert's capacity buffer
-        # (per group); overflow tokens are dropped (contribute zero,
-        # like Switch).
-        pos = jnp.cumsum(onehot, axis=1) * onehot - 1.0
-        keep = ((pos >= 0) & (pos < C)).astype(jnp.float32)
-        disp = jax.nn.one_hot(pos.astype(jnp.int32), C,
-                              dtype=jnp.float32) \
-            * (onehot * keep)[..., None]                      # (B,S,E,C)
+        # Per-choice positions within each expert's capacity buffer
+        # (per group); overflow tokens are dropped (contribute zero).
+        disp = jnp.zeros(x.shape[:2] + (E, C), jnp.float32)   # (B,S,E,C)
+        combine = jnp.zeros_like(disp)
+        claimed = jnp.zeros(x.shape[:1] + (1, E), jnp.float32)  # (B,1,E)
+        for oh, gate in zip(onehots, gates):
+            pos = (jnp.cumsum(oh, axis=1) - 1.0 + claimed) * oh
+            keep = ((pos >= 0) & (pos < C)).astype(jnp.float32) * oh
+            choice_disp = jax.nn.one_hot(
+                pos.astype(jnp.int32), C, dtype=jnp.float32) \
+                * keep[..., None]
+            disp = disp + choice_disp
+            combine = combine + choice_disp * gate[..., None, None]
+            claimed = claimed + jnp.sum(oh, axis=1, keepdims=True)
 
         expert_in = jnp.einsum("bsec,bsd->becd",
                                disp.astype(cfg.dtype),
@@ -184,8 +208,8 @@ class MoEMLP(nn.Module):
         h = nn.gelu(jnp.einsum("becd,edh->bech", expert_in, w1))
         expert_out = jnp.einsum("bech,ehd->becd", h, w2)      # (B,E,C,D)
 
-        combine = (disp * gate[..., None, None]).astype(cfg.dtype)
-        return jnp.einsum("bsec,becd->bsd", combine, expert_out)
+        return jnp.einsum("bsec,becd->bsd", combine.astype(cfg.dtype),
+                          expert_out)
 
 
 def moe_aux_loss(intermediates) -> jnp.ndarray:

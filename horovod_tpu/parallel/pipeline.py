@@ -29,7 +29,9 @@ def pipeline_stages(block_fn: Callable, stacked_params, x,
     """Run ``x`` through the pipeline. MUST be called inside a
     ``shard_map`` whose mesh has ``axis``; ``stacked_params`` is the
     per-device slice of the stage-stacked parameter pytree (leading
-    stage dim of size 1 locally), ``x`` the full (replicated) batch.
+    stage dim of size 1 locally), ``x`` this shard's batch slice —
+    replicated over the STAGE axis, and per-data-shard when composed
+    with a data axis (make_pipeline_apply's ``data_axis``).
 
     ``block_fn(params, x) -> x`` applies one stage. Returns the full
     batch output, replicated across the stage axis.
@@ -84,13 +86,18 @@ def pipeline_stages(block_fn: Callable, stacked_params, x,
 
 
 def make_pipeline_apply(mesh, block_fn: Callable, *,
-                        num_microbatches: int, axis: str = "stage"):
+                        num_microbatches: int, axis: str = "stage",
+                        data_axis: str = None):
     """jitted (stacked_params, x) -> y running the GPipe schedule over
     ``mesh``'s ``axis``. ``stacked_params`` leaves carry a leading
-    stage dimension equal to the axis size; the batch is replicated in
-    and out (compose dp/tp/sp via the other mesh axes of the specs in
-    the caller's shard_map if needed — this helper covers the pure-pp
-    composition)."""
+    stage dimension equal to the axis size.
+
+    With ``data_axis`` set the batch dim additionally shards over that
+    axis (dp x pp): each data shard streams its own microbatches
+    through the stages, parameters stay replicated across the data
+    axis, and shard_map's transpose inserts the gradient all-reduce
+    over ``data_axis`` — no manual psum, same as the Trainer's dp
+    story. ``num_microbatches`` must divide the per-data-shard batch."""
     from jax.sharding import PartitionSpec as P
 
     def apply(stacked_params, x):
@@ -101,11 +108,13 @@ def make_pipeline_apply(mesh, block_fn: Callable, *,
     def shard_specs(tree):
         return jax.tree_util.tree_map(lambda _: P(axis), tree)
 
+    batch_spec = P(data_axis) if data_axis else P()
+
     def run(stacked_params, x):
         f = jax.shard_map(
             apply, mesh=mesh,
-            in_specs=(shard_specs(stacked_params), P()),
-            out_specs=P(), check_vma=False)
+            in_specs=(shard_specs(stacked_params), batch_spec),
+            out_specs=batch_spec, check_vma=False)
         return f(stacked_params, x)
 
     return jax.jit(run)

@@ -143,6 +143,16 @@ def test_shm_hierarchical_allreduce_two_hosts():
             "HOROVOD_HOSTNAME": f"fakehost{rank // 2}"})
 
 
+@pytest.mark.parametrize("scenario", [
+    "allreduce", "allreduce_fused", "allgather", "broadcast",
+    "alltoall", "reducescatter"])
+def test_socket_backend_forced(scenario):
+    """With shm disabled, every collective still runs correctly on the
+    raw TCP socket backend (its default-world coverage moved to shm
+    when that plane became the same-host default)."""
+    run_scenario(scenario, 2, extra_env={"HOROVOD_TPU_SHM": "0"})
+
+
 def test_shm_hierarchical_allreduce_uneven_hosts():
     """3 ranks split 2+1: the solo host's local reduce is the identity
     and its root still joins the cross exchange."""
