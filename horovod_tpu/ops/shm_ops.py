@@ -17,15 +17,17 @@ alias each other across a cycle boundary:
 
 with every slot ``stride`` bytes (page-padded to the largest negotiated
 payload so far; the segment re-establishes and grows when an op
-outgrows it). Invariants that make the single ready/done round trip
-per op safe:
+outgrows it). Invariants that make the sync rounds safe:
 
   * a rank writes ONLY its own slot, and only at the start of its own
     execute — which is provably after it finished reading the previous
     op's result;
-  * the out region is written ONLY by the coordinator, ONLY between
-    the ready-gather completing (all ranks wrote + stopped reading)
-    and the done-broadcast;
+  * the out region is written only between a completed world gather
+    (all ranks wrote their slots + stopped reading the previous op)
+    and the round that releases readers. Writers per path: the
+    coordinator alone on the small-op single-round path; each rank's
+    DISJOINT 1/N slice between the two barriers of the large-op
+    slice-parallel path; local roots on the hierarchical path;
   * results are copied out of the segment before the op returns, so
     user-visible outputs never alias shared pages.
 
@@ -57,6 +59,11 @@ from horovod_tpu.ops.socket_ops import (
 )
 
 _PAGE = 4096
+# Same-host allreduces at or above this size split the reduction work
+# across ranks (slice-parallel sum) instead of summing on the
+# coordinator; below it the single-round coordinator sum wins on
+# latency.
+_PARALLEL_SUM_BYTES = 1 << 20
 
 
 def _pad(nbytes: int) -> int:
@@ -241,6 +248,8 @@ class ShmBackend(CollectiveBackend):
         _, stride = seg
         if self._hier:
             result = self._hier_allreduce(fused, dtype, stride)
+        elif fused.nbytes >= _PARALLEL_SUM_BYTES:
+            result = self._parallel_sum_allreduce(fused, dtype, stride)
         else:
             out_off = ctl.size * stride
             if ctl.is_coordinator:
@@ -261,6 +270,35 @@ class ShmBackend(CollectiveBackend):
                 result = self._view(out_off, dtype, fused.size).copy()
         _unpack_fused(entries, arrays, result, response)
         return Status.OK()
+
+    def _parallel_sum_allreduce(self, fused: np.ndarray, dtype,
+                                stride: int) -> np.ndarray:
+        """Large-payload same-host allreduce with the REDUCTION work
+        split across ranks: every rank writes its slot, then sums its
+        1/N slice of all slots into the out region (the reduce-scatter
+        + all-gather of a ring, rendered on shared memory). Costs one
+        extra sync round vs the coordinator-sum path but divides the
+        sum's memory-bandwidth load N ways — the same reason the
+        reference's hierarchical ops spread work over ranks."""
+        ctl = self._ctl
+        size = ctl.size
+        out_off = size * stride
+        slot = self._view(ctl.rank * stride, dtype, fused.size)
+        slot[:] = fused
+        self._world_barrier()  # round A: all slots written
+        # exact integer split: contiguous, gap-free, overlap-free
+        lo = ctl.rank * fused.size // size
+        hi = (ctl.rank + 1) * fused.size // size
+        if hi > lo:
+            out = self._view(out_off, dtype, fused.size)
+            acc = out[lo:hi]
+            acc[:] = self._view(0, dtype, fused.size)[lo:hi]
+            for r in range(1, size):
+                src = self._view(r * stride, dtype, fused.size)[lo:hi]
+                if not _native.sum_into(acc, src):
+                    acc += src
+        self._world_barrier()  # round B: every slice summed
+        return self._view(out_off, dtype, fused.size).copy()
 
     def _hier_allreduce(self, fused: np.ndarray, dtype,
                         stride: int) -> np.ndarray:
