@@ -221,6 +221,16 @@ class ShmBackend(CollectiveBackend):
         return np.frombuffer(self._map, dtype=dtype, count=count,
                              offset=offset)
 
+    def _sum_slots(self, acc: np.ndarray, ranks, stride: int, dtype,
+                   count: int, lo: int = 0) -> None:
+        """acc += sum of slot[r][lo:lo+len(acc)] for r in ranks (native
+        kernel with numpy fallback) — the one accumulation loop every
+        reduction path shares."""
+        for r in ranks:
+            src = self._view(r * stride, dtype, count)[lo:lo + acc.size]
+            if not _native.sum_into(acc, src):
+                acc += src
+
     def close(self) -> None:
         if self._map is not None:
             try:
@@ -256,10 +266,8 @@ class ShmBackend(CollectiveBackend):
                 ctl.gather_data(b"")  # all slots written
                 out = self._view(out_off, dtype, fused.size)
                 out[:] = fused
-                for r in range(1, ctl.size):
-                    src = self._view(r * stride, dtype, fused.size)
-                    if not _native.sum_into(out, src):
-                        out += src
+                self._sum_slots(out, range(1, ctl.size), stride, dtype,
+                                fused.size)
                 ctl.broadcast_data(b"")
                 result = out.copy()
             else:
@@ -293,10 +301,8 @@ class ShmBackend(CollectiveBackend):
             out = self._view(out_off, dtype, fused.size)
             acc = out[lo:hi]
             acc[:] = self._view(0, dtype, fused.size)[lo:hi]
-            for r in range(1, size):
-                src = self._view(r * stride, dtype, fused.size)[lo:hi]
-                if not _native.sum_into(acc, src):
-                    acc += src
+            self._sum_slots(acc, range(1, size), stride, dtype,
+                            fused.size, lo=lo)
         self._world_barrier()  # round B: every slice summed
         return self._view(out_off, dtype, fused.size).copy()
 
@@ -328,10 +334,8 @@ class ShmBackend(CollectiveBackend):
 
         if lr == 0:
             acc = np.array(fused, dtype=dtype, copy=True)
-            for r in range(1, ls):
-                src = self._view(r * stride, dtype, fused.size)
-                if not _native.sum_into(acc, src):
-                    acc += src
+            self._sum_slots(acc, range(1, ls), stride, dtype,
+                            fused.size)
             payload = acc
         else:
             payload = b""
@@ -492,10 +496,8 @@ class ShmBackend(CollectiveBackend):
             ctl.gather_data(b"")
             out = self._view(out_off, arr.dtype, arr.size)
             out[:] = arr.reshape(-1)
-            for r in range(1, size):
-                src = self._view(r * stride, arr.dtype, arr.size)
-                if not _native.sum_into(out, src):
-                    out += src
+            self._sum_slots(out, range(1, size), stride, arr.dtype,
+                            arr.size)
             ctl.broadcast_data(b"")
         else:
             slot = self._view(ctl.rank * stride, arr.dtype, arr.size)

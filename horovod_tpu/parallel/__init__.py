@@ -23,9 +23,18 @@ from horovod_tpu.parallel.pipeline import (
 )
 from horovod_tpu.parallel.trainer import Trainer, TrainerConfig
 
+
+def __getattr__(name):
+    # Lazy: pipelined_lm pulls in flax (an optional extra); the rest of
+    # this package must stay importable with jax alone.
+    if name == "PipelinedLM":
+        from horovod_tpu.parallel.pipelined_lm import PipelinedLM
+        return PipelinedLM
+    raise AttributeError(name)
+
 __all__ = [
     "ShardingRules", "infer_sharding", "transformer_tp_rules",
     "ring_attention", "make_ring_attention",
-    "pipeline_stages", "make_pipeline_apply",
+    "pipeline_stages", "make_pipeline_apply", "PipelinedLM",
     "Trainer", "TrainerConfig",
 ]

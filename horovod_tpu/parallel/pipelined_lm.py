@@ -1,0 +1,116 @@
+"""The flagship TransformerLM with its block tower pipelined.
+
+Composes the GPipe machinery (parallel/pipeline.py) with the real
+model: per-block parameters are re-stacked into
+``(n_stages, layers_per_stage, ...)``, the stage function scans its
+layers locally, microbatches stream between stages over the ``stage``
+mesh axis, and the embedding / final-norm / head stay outside the
+pipelined region (replicated — they are a sliver of the FLOPs).
+Optionally composes with data parallelism over a second axis.
+
+Parameters come from a stock ``TransformerLM.init`` and are
+re-assembled with ``from_transformer_params`` — so checkpoints move
+freely between the sequential and pipelined forms, and the equivalence
+test can demand identical logits.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.models.transformer import (
+    Block, TransformerConfig, TransformerLM,
+)
+from horovod_tpu.parallel.pipeline import make_pipeline_apply
+
+
+class PipelinedLM:
+    """dp x pp rendering of TransformerLM over a mesh.
+
+    ``cfg.num_layers`` must divide evenly into the stage-axis size, and
+    the tower must be homogeneous (``num_experts == 0``: MoE blocks
+    alternate structure with dense blocks, which a stage-stacked
+    pipeline cannot stack).
+    """
+
+    def __init__(self, cfg: TransformerConfig, mesh, *,
+                 num_microbatches: int, stage_axis: str = "stage",
+                 data_axis: Optional[str] = None):
+        if cfg.num_experts != 0:
+            raise ValueError(
+                "PipelinedLM needs a homogeneous block tower; MoE "
+                "configs (num_experts > 0) alternate block structure "
+                "and cannot be stage-stacked")
+        n_stages = mesh.shape[stage_axis]
+        if cfg.num_layers % n_stages != 0:
+            raise ValueError(
+                f"num_layers={cfg.num_layers} must divide evenly over "
+                f"{n_stages} pipeline stages")
+        self.cfg = cfg
+        self.mesh = mesh
+        self.n_stages = n_stages
+        self.layers_per_stage = cfg.num_layers // n_stages
+        self._block = Block(cfg)
+        self._embed = nn.Embed(cfg.vocab_size, cfg.embed_dim,
+                               dtype=cfg.dtype, name="embed")
+        self._ln_f = nn.LayerNorm(use_bias=False, dtype=cfg.dtype,
+                                  param_dtype=jnp.float32, name="ln_f")
+        self._head = nn.Dense(cfg.vocab_size, use_bias=False,
+                              dtype=jnp.float32, name="lm_head")
+
+        block = self._block
+
+        def stage_fn(stage_params, h):
+            # positions derived per microbatch (batch-size agnostic)
+            pos = jnp.broadcast_to(
+                jnp.arange(h.shape[1], dtype=jnp.int32)[None],
+                h.shape[:2])
+
+            def layer(h, layer_params):
+                return block.apply({"params": layer_params}, h, pos), None
+
+            h, _ = lax.scan(layer, h, stage_params)
+            return h
+
+        self._run_tower = make_pipeline_apply(
+            mesh, stage_fn, num_microbatches=num_microbatches,
+            axis=stage_axis, data_axis=data_axis)
+
+    # ------------------------------------------------------------------
+    def from_transformer_params(self, variables):
+        """Re-stack a stock ``TransformerLM.init`` result into the
+        pipelined layout: blocks -> (n_stages, layers_per_stage, ...)."""
+        p = variables["params"]
+        blocks = [p[f"block_{i}"] for i in range(self.cfg.num_layers)]
+        lps = self.layers_per_stage
+
+        def stack(*leaves):
+            return jnp.stack(leaves).reshape(
+                (self.n_stages, lps) + leaves[0].shape)
+
+        return {
+            "embed": p["embed"],
+            "blocks": jax.tree_util.tree_map(stack, *blocks),
+            "ln_f": p["ln_f"],
+            "lm_head": p["lm_head"],
+        }
+
+    def init(self, rng, tokens):
+        lm = TransformerLM(self.cfg)
+        return self.from_transformer_params(
+            jax.jit(lm.init)(rng, tokens))
+
+    def apply(self, params, tokens):
+        """tokens [B, S] -> logits [B, S, vocab] — same contract (and,
+        given re-stacked identical parameters, the same values) as
+        ``TransformerLM.apply``."""
+        x = self._embed.apply({"params": params["embed"]}, tokens)
+        x = self._run_tower(params["blocks"], x)
+        x = self._ln_f.apply({"params": params["ln_f"]}, x)
+        return self._head.apply({"params": params["lm_head"]},
+                                x.astype(jnp.float32))
