@@ -47,9 +47,9 @@ def _as_buffer(payload):
     """Normalize a data-plane payload to a flat byte view. Callers may
     pass numpy arrays straight through (zero-copy send path); the
     control plane still deals in bytes."""
-    if payload is None or isinstance(payload, (bytes, bytearray)):
-        return payload
-    return memoryview(payload).cast("B")
+    if payload is None:
+        return None
+    return network.as_byte_view(payload)
 
 
 class Topology:

@@ -30,6 +30,17 @@ _DIGEST_LEN = 32
 _INLINE_SEND = 16 * 1024
 
 
+def as_byte_view(payload):
+    """Flat byte view over any C-contiguous buffer; bytes pass through.
+    Centralizes the zero-size guard: ``memoryview.cast`` rejects N-D
+    zero-size views ("zeros in shape or strides"), so empty buffers
+    normalize to ``b""``."""
+    if isinstance(payload, (bytes, bytearray)):
+        return payload
+    mv = memoryview(payload)
+    return mv.cast("B") if mv.nbytes else b""
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray(n)
     view = memoryview(buf)
@@ -69,8 +80,7 @@ class Channel:
         """``payload`` is any C-contiguous buffer (bytes, bytearray,
         memoryview, numpy array) — large buffers are written straight
         from their memory, never copied into a bytes object."""
-        if not isinstance(payload, (bytes, bytearray)):
-            payload = memoryview(payload).cast("B")
+        payload = as_byte_view(payload)
         n = len(payload)
         hdr = _HDR.pack(n, tag)
         if self.secret:
@@ -108,7 +118,7 @@ class Channel:
         smaller. Returns (tag, payload_nbytes)."""
         hdr = _recv_exact(self.sock, _HDR.size)
         n, tag = _HDR.unpack(hdr)
-        view = memoryview(buf).cast("B")
+        view = memoryview(as_byte_view(buf))
         if n > len(view):
             raise ConnectionError(
                 f"frame of {n} bytes overflows {len(view)}-byte buffer")

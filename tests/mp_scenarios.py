@@ -227,6 +227,43 @@ def scenario_shm_collectives(hvd, rank, size):
     hvd.barrier(name="shm.bar")
 
 
+def scenario_edge_shapes(hvd, rank, size):
+    """Zero-size and 0-d tensors through the collectives: negotiated
+    like anything else, correct shapes out, no wedged protocol. Run
+    under both the shm and socket planes by the harness."""
+    z = hvd.allreduce(np.empty(0, np.float32), average=False,
+                      name="e.zero")
+    assert np.asarray(z).shape == (0,)
+
+    out = hvd.allreduce(np.asarray(3.0 * (rank + 1), np.float64),
+                        average=False, name="e.scalar")
+    assert np.asarray(out).shape == ()
+    assert float(out) == 3.0 * sum(range(1, size + 1))
+
+    # every rank empty
+    g = hvd.allgather(np.empty((0, 4), np.float32), name="e.ag0")
+    assert np.asarray(g).shape == (0, 4)
+
+    # SOME ranks empty (rank 0 contributes nothing)
+    g = hvd.allgather(np.full((rank, 2), float(rank), np.float32),
+                      name="e.ag_some")
+    assert np.asarray(g).shape == (sum(range(size)), 2)
+    offset = 0
+    for r in range(size):
+        np.testing.assert_allclose(np.asarray(g)[offset:offset + r],
+                                   float(r))
+        offset += r
+
+    b = hvd.broadcast(np.empty(0, np.float64), root_rank=size - 1,
+                      name="e.bc0")
+    assert np.asarray(b).shape == (0,)
+
+    # the world still works afterwards
+    out = hvd.allreduce(np.full(5, float(rank + 1), np.float32),
+                        average=False, name="e.after")
+    np.testing.assert_allclose(out, sum(range(1, size + 1)))
+
+
 def scenario_rank_death(hvd, rank, size):
     """A rank dying abruptly mid-job must surface on the survivors as
     a clean shutdown error on the next collective — never a hang

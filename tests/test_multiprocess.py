@@ -217,6 +217,14 @@ def test_scalar_broadcast():
     run_scenario("scalar_broadcast", 2)
 
 
+@pytest.mark.parametrize("plane", ["shm", "socket"])
+def test_edge_shapes(plane):
+    """Zero-size and 0-d tensors through every collective, on both
+    host data planes."""
+    extra = {} if plane == "shm" else {"HOROVOD_TPU_SHM": "0"}
+    run_scenario("edge_shapes", 3, extra_env=extra)
+
+
 def test_rank_death_fails_survivors_cleanly():
     """Kill one of three ranks mid-job: the other two must error out
     with HorovodInternalError on their next collective, not hang."""
