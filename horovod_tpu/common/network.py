@@ -32,12 +32,26 @@ _INLINE_SEND = 16 * 1024
 
 def as_byte_view(payload):
     """Flat byte view over any C-contiguous buffer; bytes pass through.
-    Centralizes the zero-size guard: ``memoryview.cast`` rejects N-D
+    Centralizes two portability guards: ``memoryview.cast`` rejects N-D
     zero-size views ("zeros in shape or strides"), so empty buffers
-    normalize to ``b""``."""
+    normalize to ``b""``; and numpy extension dtypes (ml_dtypes
+    bfloat16 and friends) don't speak the buffer protocol, so those
+    arrays are reinterpreted as uint8 bytes first (a view, not a
+    copy — writability is preserved for recv_into)."""
     if isinstance(payload, (bytes, bytearray)):
         return payload
-    mv = memoryview(payload)
+    try:
+        mv = memoryview(payload)
+    except (ValueError, TypeError):
+        import numpy as np
+        if not getattr(payload, "flags", None) or \
+                not payload.flags.c_contiguous:
+            # an ascontiguousarray here would be a silent COPY —
+            # receive paths would fill the copy and drop the data
+            raise TypeError(
+                "as_byte_view needs a C-contiguous buffer for "
+                "extension-dtype arrays")
+        mv = memoryview(payload.view(np.uint8))
     return mv.cast("B") if mv.nbytes else b""
 
 

@@ -264,6 +264,28 @@ def scenario_edge_shapes(hvd, rank, size):
     np.testing.assert_allclose(out, sum(range(1, size + 1)))
 
 
+def scenario_bf16_host_path(hvd, rank, size):
+    """bfloat16 — the TPU-native wire/accumulate dtype — through the
+    host collectives (native sum kernel or numpy/ml_dtypes fallback)."""
+    import ml_dtypes
+    # careful: bf16 * python-int silently promotes to f32 (ml_dtypes
+    # weak promotion) — cast LAST so the wire dtype really is bf16
+    x = np.full(64, float(rank + 1)).astype(ml_dtypes.bfloat16)
+    out = hvd.allreduce(x, average=False, name="bf.ar")
+    assert np.asarray(out).dtype == ml_dtypes.bfloat16, \
+        np.asarray(out).dtype
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               sum(range(1, size + 1)))
+    g = hvd.allgather(
+        np.full((2, 3), float(rank)).astype(ml_dtypes.bfloat16),
+        name="bf.ag")
+    assert np.asarray(g).shape == (2 * size, 3)
+    assert np.asarray(g).dtype == ml_dtypes.bfloat16
+    b = hvd.broadcast(np.full(4, float(rank)).astype(ml_dtypes.bfloat16),
+                      root_rank=1, name="bf.bc")
+    np.testing.assert_allclose(np.asarray(b, np.float32), 1.0)
+
+
 def scenario_rank_death(hvd, rank, size):
     """A rank dying abruptly mid-job must surface on the survivors as
     a clean shutdown error on the next collective — never a hang

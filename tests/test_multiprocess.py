@@ -21,10 +21,8 @@ def _free_port() -> int:
     return port
 
 
-def run_scenario(scenario: str, size: int, timeout: float = 90.0,
-                 extra_env=None, per_rank_env=None):
-    port = _free_port()
-    procs = []
+def _base_env(extra_env=None):
+    """Worker-process env hygiene shared by every spawning test."""
     base = dict(os.environ)
     base["PYTHONPATH"] = REPO + os.pathsep + base.get("PYTHONPATH", "")
     base.setdefault("JAX_PLATFORMS", "cpu")
@@ -33,6 +31,14 @@ def run_scenario(scenario: str, size: int, timeout: float = 90.0,
     base.pop("PALLAS_AXON_POOL_IPS", None)
     if extra_env:
         base.update(extra_env)
+    return base
+
+
+def run_scenario(scenario: str, size: int, timeout: float = 90.0,
+                 extra_env=None, per_rank_env=None):
+    port = _free_port()
+    procs = []
+    base = _base_env(extra_env)
     for rank in range(size):
         env = dict(base)
         if per_rank_env:
@@ -215,6 +221,45 @@ def test_tfkeras_facade():
 
 def test_scalar_broadcast():
     run_scenario("scalar_broadcast", 2)
+
+
+@pytest.mark.parametrize("plane", ["shm", "socket"])
+def test_bf16_host_path(plane):
+    extra = {} if plane == "shm" else {"HOROVOD_TPU_SHM": "0"}
+    run_scenario("bf16_host_path", 2, extra_env=extra)
+
+
+def test_secret_mismatch_fails_init_loudly():
+    """Ranks with different HOROVOD_SECRET_KEY must fail init with
+    authentication/timeout errors, never connect or hang (reference
+    analog: the launcher's per-run HMAC secret contract)."""
+    port = _free_port()
+    base = _base_env({"HOROVOD_CONTROLLER_ADDR": "127.0.0.1",
+                      "HOROVOD_CONTROLLER_PORT": str(port),
+                      "HOROVOD_SIZE": "2",
+                      "HOROVOD_START_TIMEOUT": "6"})
+    code = "import horovod_tpu as hvd; hvd.init()"
+    procs = []
+    for rank in range(2):
+        env = dict(base, HOROVOD_RANK=str(rank),
+                   HOROVOD_SECRET_KEY="alpha" if rank == 0 else "beta")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", code], env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=60)
+            outs.append(out.decode())
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    assert all(p.returncode != 0 for p in procs), outs
+    assert "ranks connected" in outs[0] or "Timeout" in outs[0], outs[0]
+    assert ("ConnectionError" in outs[1] or "HMAC" in outs[1]
+            or "closed" in outs[1]), outs[1]
 
 
 @pytest.mark.parametrize("plane", ["shm", "socket"])

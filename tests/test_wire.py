@@ -69,3 +69,62 @@ def test_unicode_tensor_names():
     rl = RequestList([req])
     out = wire.parse_request_list(wire.serialize_request_list(rl))
     assert out.requests[0].tensor_name == "层/グラデーション∇"
+
+
+def test_randomized_roundtrips():
+    """Seeded fuzz over the codec: arbitrary ranks/dtypes/shapes/
+    scales/unicode names must survive serialize -> parse exactly."""
+    import numpy as np
+    from horovod_tpu.common.message import (
+        DataType, Request, RequestList, RequestType, Response,
+        ResponseList, ResponseType,
+    )
+    from horovod_tpu.common import wire
+
+    rng = np.random.RandomState(7)
+    req_types = [RequestType.ALLREDUCE, RequestType.ALLGATHER,
+                 RequestType.BROADCAST, RequestType.ALLTOALL,
+                 RequestType.REDUCESCATTER, RequestType.BARRIER]
+    dtypes = list(DataType)
+    names = ["t", "grad/層/0", "a.b-c_d", "🙂/émoji", "x" * 200]
+    for _ in range(60):
+        reqs = [Request(
+            request_rank=int(rng.randint(0, 1 << 20)),
+            request_type=req_types[rng.randint(len(req_types))],
+            tensor_type=dtypes[rng.randint(len(dtypes))],
+            tensor_name=names[rng.randint(len(names))]
+            + str(rng.randint(1000)),
+            root_rank=int(rng.randint(-1, 64)),
+            device=int(rng.randint(-1, 8)),
+            tensor_shape=[int(s) for s in
+                          rng.randint(0, 1 << 16,
+                                      size=rng.randint(0, 6))],
+            prescale_factor=float(rng.randn()),
+            postscale_factor=float(rng.randn()),
+        ) for _ in range(rng.randint(0, 8))]
+        rl = RequestList(reqs, shutdown=bool(rng.randint(2)))
+        assert wire.parse_request_list(
+            wire.serialize_request_list(rl)) == rl
+
+        resps = [Response(
+            response_type=ResponseType(
+                [ResponseType.ALLREDUCE, ResponseType.ALLGATHER,
+                 ResponseType.BROADCAST, ResponseType.ERROR][
+                     rng.randint(4)]),
+            tensor_names=[f"n{j}.{rng.randint(100)}"
+                          for j in range(rng.randint(0, 5))],
+            error_message="e" * rng.randint(0, 50),
+            devices=[int(d) for d in
+                     rng.randint(0, 8, size=rng.randint(0, 4))],
+            tensor_sizes=[int(s) for s in
+                          rng.randint(0, 1 << 30,
+                                      size=rng.randint(0, 4))],
+            prescale_factor=float(rng.randn()),
+            postscale_factor=float(rng.randn()),
+        ) for _ in range(rng.randint(0, 5))]
+        rsl = ResponseList(resps, shutdown=bool(rng.randint(2)),
+                           tuned_cycle_time_ms=float(abs(rng.randn())),
+                           tuned_fusion_threshold_bytes=int(
+                               rng.randint(0, 1 << 26)))
+        assert wire.parse_response_list(
+            wire.serialize_response_list(rsl)) == rsl
