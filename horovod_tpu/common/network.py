@@ -51,7 +51,9 @@ def as_byte_view(payload):
             raise TypeError(
                 "as_byte_view needs a C-contiguous buffer for "
                 "extension-dtype arrays")
-        mv = memoryview(payload.view(np.uint8))
+        # reshape(-1) first: a 0-d array can't change dtype, and the
+        # reshape of a contiguous array is a view (writability kept)
+        mv = memoryview(payload.reshape(-1).view(np.uint8))
     return mv.cast("B") if mv.nbytes else b""
 
 

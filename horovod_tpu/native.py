@@ -26,6 +26,7 @@ _tried = False
 _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
 _SO_PATH = os.path.join(_NATIVE_DIR, "libhvdtpu.so")
+_SRC_PATH = os.path.join(_NATIVE_DIR, "hvdtpu.cc")
 
 
 def _build() -> bool:
@@ -101,9 +102,19 @@ def get() -> Optional[ctypes.CDLL]:
         if os.environ.get("HOROVOD_NATIVE", "1") == "0" or \
                 os.environ.get("HOROVOD_TPU_NATIVE", "1") in ("0", "false"):
             return None
-        if not os.path.exists(_SO_PATH) and not _build():
-            hlog.debug("native core unavailable; using Python paths")
-            return None
+        stale = (os.path.exists(_SO_PATH)
+                 and os.path.exists(_SRC_PATH)
+                 and os.path.getmtime(_SRC_PATH)
+                 > os.path.getmtime(_SO_PATH))
+        if (not os.path.exists(_SO_PATH) or stale) and not _build():
+            if not os.path.exists(_SO_PATH):
+                hlog.debug("native core unavailable; using Python paths")
+                return None
+            # rebuild of a stale .so failed: keep using the old one —
+            # dtype-ABI extensions degrade gracefully (sum_into returns
+            # False for codes the old library rejects)
+            hlog.warning("native core rebuild failed; using stale "
+                         "library")
         try:
             lib = ctypes.CDLL(_SO_PATH)
             _configure(lib)
@@ -117,7 +128,7 @@ def get() -> Optional[ctypes.CDLL]:
 # -- numpy-facing wrappers ----------------------------------------------
 
 _DTYPE_CODES = {"float32": 0, "float64": 1, "int32": 2, "int64": 3,
-                "uint8": 4, "float16": 5}
+                "uint8": 4, "float16": 5, "bfloat16": 6}
 
 
 def sum_into(acc, src) -> bool:

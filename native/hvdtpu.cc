@@ -403,6 +403,31 @@ int hvd_sum_into(void* acc, const void* src, int64_t count, int dtype) {
         a[i] = f2h(h2f(a[i]) + h2f(s[i]));
       return 0;
     }
+    case 6: {
+      // bfloat16 — the TPU-native wire/accumulate dtype: upper 16 bits
+      // of an f32. Accumulate in f32, round to nearest-even on the way
+      // back (role-parity with the fp16 sum above; reference analog:
+      // common/half.cc:42-77).
+      uint16_t* a = static_cast<uint16_t*>(acc);
+      const uint16_t* s = static_cast<const uint16_t*>(src);
+      auto b2f = [](uint16_t v) -> float {
+        uint32_t f = uint32_t(v) << 16;
+        float out;
+        memcpy(&out, &f, 4);
+        return out;
+      };
+      auto f2b = [](float x) -> uint16_t {
+        uint32_t f;
+        memcpy(&f, &x, 4);
+        if ((f & 0x7fffffffu) > 0x7f800000u)
+          return uint16_t((f >> 16) | 0x0040u);  // quiet NaN
+        uint32_t rounding = 0x7fffu + ((f >> 16) & 1u);
+        return uint16_t((f + rounding) >> 16);
+      };
+      for (int64_t i = 0; i < count; i++)
+        a[i] = f2b(b2f(a[i]) + b2f(s[i]));
+      return 0;
+    }
     default:
       return -EINVAL;
   }

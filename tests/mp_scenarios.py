@@ -267,7 +267,10 @@ def scenario_edge_shapes(hvd, rank, size):
 def scenario_bf16_host_path(hvd, rank, size):
     """bfloat16 — the TPU-native wire/accumulate dtype — through the
     host collectives (native sum kernel or numpy/ml_dtypes fallback)."""
-    import ml_dtypes
+    try:
+        import ml_dtypes
+    except ImportError:
+        return  # numpy-only install: nothing to test
     # careful: bf16 * python-int silently promotes to f32 (ml_dtypes
     # weak promotion) — cast LAST so the wire dtype really is bf16
     x = np.full(64, float(rank + 1)).astype(ml_dtypes.bfloat16)

@@ -152,3 +152,26 @@ def test_frame_transport_rejects_bad_hmac():
     assert rc != 0  # EBADMSG
     t.join()
     a.close(); b.close()
+
+
+def test_sum_into_bfloat16_matches_numpy_rne():
+    """Native bf16 sum (f32 accumulate + round-to-nearest-even) must
+    agree bitwise with ml_dtypes' own bf16 addition."""
+    ml_dtypes = pytest.importorskip("ml_dtypes")
+    rng = np.random.RandomState(11)
+    a = (rng.randn(4096) * 3).astype(ml_dtypes.bfloat16)
+    b = (rng.randn(4096) * 3).astype(ml_dtypes.bfloat16)
+    ref = a.copy()
+    ref += b  # ml_dtypes: f32 math + RNE cast
+    acc = a.copy()
+    assert native.sum_into(acc, b), "native bf16 sum unavailable"
+    assert acc.tobytes() == ref.tobytes(), "bitwise mismatch vs RNE"
+    # specials survive
+    sp = np.array([np.inf, -np.inf, np.nan, 0.0],
+                  ml_dtypes.bfloat16)
+    add = np.array([1.0, 1.0, 1.0, -0.0], ml_dtypes.bfloat16)
+    acc = sp.copy()
+    assert native.sum_into(acc, add)
+    out = np.asarray(acc, np.float32)
+    assert np.isposinf(out[0]) and np.isneginf(out[1])
+    assert np.isnan(out[2]) and out[3] == 0.0
