@@ -483,7 +483,15 @@ def flash_attention(q, k, v, causal: bool = True,
 
     ``q_offset``/``k_offset`` (python ints or traced scalars) are the
     global positions of element 0, shifting the causal mask — ring
-    attention's rotated kv blocks use this."""
+    attention's rotated kv blocks use this.
+
+    Precision: the in-kernel dots follow jax's matmul-precision config,
+    like every other TPU matmul — bf16 multiplies with f32 accumulation
+    by default (measured ~1e-2 vs a float64 reference at S=512, i.e.
+    BETTER than a dense attention at the same default). Wrap the call
+    in ``jax.default_matmul_precision("float32")`` for ~2e-6 agreement
+    at several times the MXU cost; the context reaches inside the
+    pallas kernel (verified on v5e silicon)."""
     seq_q, seq_k = q.shape[1], k.shape[1]
     bq = min(block_q, seq_q)
     bk = min(block_k, seq_k)

@@ -103,6 +103,14 @@ def test_barrier():
     run_scenario("barrier", 2)
 
 
+def test_wide_world_smoke():
+    """12 ranks on one host: the coordinator's fan-in (native poll
+    gather), the shm plane, and FUSED batches all hold up beyond the
+    2-4 rank worlds the rest of the suite uses."""
+    run_scenario("allreduce", 12, timeout=180.0)
+    run_scenario("allreduce_fused", 12, timeout=180.0)
+
+
 @pytest.mark.parametrize("size", [3, 4])
 def test_ring_allreduce(size):
     """Large payloads take the 2-phase ring data plane (threshold
