@@ -150,3 +150,28 @@ def test_shard_batch_and_shardings(mesh):
     batch = {"x": np.zeros((16, 3), np.float32)}
     out = spmd.shard_batch(mesh, batch)
     assert out["x"].sharding.spec == P("data")
+
+
+def test_create_hybrid_mesh_axis_order(monkeypatch):
+    """DCN axes lead the mesh (outer/slower network outermost); ICI
+    axes follow — the contract the hierarchical collectives assume.
+    Real multi-slice construction needs multi-slice hardware, so the
+    device grid is injected."""
+    import numpy as np
+    import jax
+    from jax.experimental import mesh_utils
+    from horovod_tpu import spmd
+
+    captured = {}
+
+    def fake_hybrid(ici_shape, dcn_mesh_shape):
+        captured["ici"] = tuple(ici_shape)
+        captured["dcn"] = tuple(dcn_mesh_shape)
+        return np.array(jax.devices()[:8]).reshape(2, 2, 2)
+
+    monkeypatch.setattr(mesh_utils, "create_hybrid_device_mesh",
+                        fake_hybrid)
+    mesh = spmd.create_hybrid_mesh({"seq": 2, "model": 2}, {"data": 2})
+    assert captured == {"ici": (2, 2), "dcn": (2,)}
+    assert mesh.axis_names == ("data", "seq", "model")
+    assert dict(mesh.shape) == {"data": 2, "seq": 2, "model": 2}
