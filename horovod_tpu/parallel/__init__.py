@@ -18,6 +18,9 @@ from horovod_tpu.parallel.sharding import (
 from horovod_tpu.parallel.ring_attention import (
     ring_attention, make_ring_attention,
 )
+from horovod_tpu.parallel.ulysses import (
+    make_ulysses_attention, ulysses_attention,
+)
 from horovod_tpu.parallel.pipeline import (
     make_pipeline_apply, pipeline_stages,
 )
@@ -35,6 +38,7 @@ def __getattr__(name):
 __all__ = [
     "ShardingRules", "infer_sharding", "transformer_tp_rules",
     "ring_attention", "make_ring_attention",
+    "ulysses_attention", "make_ulysses_attention",
     "pipeline_stages", "make_pipeline_apply", "PipelinedLM",
     "Trainer", "TrainerConfig",
 ]

@@ -247,13 +247,20 @@ def make_ring_attention(mesh, data_axis: str = "data",
     from jax.sharding import PartitionSpec as P
 
     spec = P(data_axis, seq_axis, model_axis, None)
+    cache = {}
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-             out_specs=spec, check_vma=False)
-    def _sharded(q, k, v):
-        return ring_attention(q, k, v, causal=True, axis=seq_axis)
+    def _build(causal: bool):
+        @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                 out_specs=spec, check_vma=False)
+        def _sharded(q, k, v):
+            return ring_attention(q, k, v, causal=causal,
+                                  axis=seq_axis)
+        return _sharded
 
     def attention_fn(q, k, v, causal=True):
-        return _sharded(q, k, v)
+        causal = bool(causal)
+        if causal not in cache:
+            cache[causal] = _build(causal)
+        return cache[causal](q, k, v)
 
     return attention_fn

@@ -1,0 +1,84 @@
+"""All-to-all (Ulysses-style) sequence/context parallelism.
+
+The second of the two standard long-context decompositions (the first,
+ring attention, lives in ring_attention.py): instead of rotating kv
+shards around the ring, one ``lax.all_to_all`` re-shards q/k/v from
+sequence-sharded to HEAD-sharded, every device runs ordinary
+full-sequence attention on its 1/p of the heads, and a second
+all-to-all restores sequence sharding (the public DeepSpeed-Ulysses
+pattern). Two a2a hops of S·H·D/p elements replace the ring's p-1
+rotation steps — favorable when the head count divides the axis and
+the interconnect prefers fewer, larger transfers; ring attention wins
+when heads are scarce (H < p) or holding the full sequence per device
+is the binding memory constraint. Causality is exact: each device sees
+the whole sequence, so no cross-shard mask bookkeeping exists at all.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+from jax import lax
+
+
+def ulysses_attention(q, k, v, *, axis: str = "seq",
+                      causal: bool = True, attn_fn=None):
+    """Inside shard_map: q, k, v [B, S/p, H, D] sequence-sharded over
+    ``axis`` → full-sequence attention on H/p heads → [B, S/p, H, D].
+    The head count must divide the axis size."""
+    p = lax.axis_size(axis)
+    heads = q.shape[2]
+    if heads % p != 0:
+        raise ValueError(
+            f"ulysses attention needs heads ({heads}) divisible by the "
+            f"'{axis}' axis size ({p}); use ring attention for H < p")
+    if attn_fn is None:
+        from horovod_tpu.models.transformer import best_attention
+        attn_fn = best_attention
+
+    import jax.numpy as jnp
+
+    # One inbound all-to-all for all three tensors (stacked), one
+    # outbound for the result: [.., B, S/p, H, D] -> [.., B, S, H/p, D];
+    # tiled a2a concatenates received sequence blocks in rank order =
+    # global order.
+    stacked = jnp.stack([q, k, v])
+    moved = lax.all_to_all(stacked, axis, split_axis=3, concat_axis=2,
+                           tiled=True)
+    out = attn_fn(moved[0], moved[1], moved[2], causal)
+    return lax.all_to_all(out, axis, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def make_ulysses_attention(mesh, data_axis: str = "data",
+                           seq_axis: str = "seq",
+                           attn_fn: Optional[object] = None):
+    """Build an ``attention_fn`` for TransformerConfig that runs
+    Ulysses sequence parallelism as a manual-sharding island inside an
+    otherwise GSPMD-partitioned jit: batch over ``data_axis``, sequence
+    over ``seq_axis``. Heads stay unsharded at the boundary (they are
+    the exchange currency), so this composes with dp and — through the
+    per-head split inside the island — occupies the role tensor
+    parallelism plays for attention."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(data_axis, seq_axis, None, None)
+    cache = {}
+
+    def _build(causal: bool):
+        @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                 out_specs=spec, check_vma=False)
+        def _sharded(q, k, v):
+            return ulysses_attention(q, k, v, axis=seq_axis,
+                                     causal=causal, attn_fn=attn_fn)
+        return _sharded
+
+    def attention_fn(q, k, v, causal=True):
+        causal = bool(causal)
+        if causal not in cache:
+            cache[causal] = _build(causal)
+        return cache[causal](q, k, v)
+
+    return attention_fn
