@@ -30,6 +30,10 @@ def main():
     p.add_argument("--seq", type=int, default=None, help="sp axis size")
     p.add_argument("--model-par", type=int, default=None,
                    help="tp axis size")
+    p.add_argument("--sp-mode", choices=["ring", "ulysses"],
+                   default="ring",
+                   help="sequence-parallel flavor: kv ring rotation or "
+                        "all-to-all head exchange")
     args = p.parse_args()
 
     n = len(jax.devices())
@@ -40,8 +44,18 @@ def main():
     mesh = spmd.create_mesh({"data": dp, "seq": sp, "model": tp})
     print(f"mesh: data={dp} seq={sp} model={tp}")
 
-    attn = make_ring_attention(mesh, data_axis="data", seq_axis="seq",
-                               model_axis="model" if tp > 1 else None)
+    if args.sp_mode == "ulysses":
+        from horovod_tpu.parallel import make_ulysses_attention
+        if tp > 1:
+            p.error("--sp-mode ulysses is incompatible with "
+                    "--model-par > 1 (the head dim is ulysses' "
+                    "exchange currency); use --sp-mode ring with tp")
+        attn = make_ulysses_attention(mesh, data_axis="data",
+                                      seq_axis="seq")
+    else:
+        attn = make_ring_attention(
+            mesh, data_axis="data", seq_axis="seq",
+            model_axis="model" if tp > 1 else None)
     cfg = TransformerConfig(
         vocab_size=32000, num_layers=args.layers, num_heads=args.heads,
         head_dim=args.head_dim, max_seq_len=args.seq_len,

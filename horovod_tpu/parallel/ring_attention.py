@@ -235,6 +235,29 @@ def ring_attention(q, k, v, causal: bool = True, axis: str = "seq",
     return _ring_einsum(q, k, v, causal, axis)
 
 
+def _cached_sharded_attention(mesh, spec, inner):
+    """Shared wrapper for the sequence-parallel attention factories
+    (ring + ulysses): one manual-sharding island per causal value
+    (bounded cache of two) so the returned attention_fn honors its
+    ``causal`` argument instead of baking one mask in."""
+    cache = {}
+
+    def _build(causal: bool):
+        @partial(jax.shard_map, mesh=mesh, in_specs=(spec,) * 3,
+                 out_specs=spec, check_vma=False)
+        def _sharded(q, k, v):
+            return inner(q, k, v, causal)
+        return _sharded
+
+    def attention_fn(q, k, v, causal=True):
+        causal = bool(causal)
+        if causal not in cache:
+            cache[causal] = _build(causal)
+        return cache[causal](q, k, v)
+
+    return attention_fn
+
+
 def make_ring_attention(mesh, data_axis: str = "data",
                         seq_axis: str = "seq",
                         model_axis: Optional[str] = "model"):
@@ -246,21 +269,7 @@ def make_ring_attention(mesh, data_axis: str = "data",
     touches the network."""
     from jax.sharding import PartitionSpec as P
 
-    spec = P(data_axis, seq_axis, model_axis, None)
-    cache = {}
-
-    def _build(causal: bool):
-        @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-                 out_specs=spec, check_vma=False)
-        def _sharded(q, k, v):
-            return ring_attention(q, k, v, causal=causal,
-                                  axis=seq_axis)
-        return _sharded
-
-    def attention_fn(q, k, v, causal=True):
-        causal = bool(causal)
-        if causal not in cache:
-            cache[causal] = _build(causal)
-        return cache[causal](q, k, v)
-
-    return attention_fn
+    return _cached_sharded_attention(
+        mesh, P(data_axis, seq_axis, model_axis, None),
+        lambda q, k, v, causal: ring_attention(q, k, v, causal=causal,
+                                               axis=seq_axis))

@@ -16,7 +16,6 @@ the whole sequence, so no cross-shard mask bookkeeping exists at all.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
@@ -64,21 +63,10 @@ def make_ulysses_attention(mesh, data_axis: str = "data",
     parallelism plays for attention."""
     from jax.sharding import PartitionSpec as P
 
-    spec = P(data_axis, seq_axis, None, None)
-    cache = {}
+    from horovod_tpu.parallel.ring_attention import \
+        _cached_sharded_attention
 
-    def _build(causal: bool):
-        @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-                 out_specs=spec, check_vma=False)
-        def _sharded(q, k, v):
-            return ulysses_attention(q, k, v, axis=seq_axis,
-                                     causal=causal, attn_fn=attn_fn)
-        return _sharded
-
-    def attention_fn(q, k, v, causal=True):
-        causal = bool(causal)
-        if causal not in cache:
-            cache[causal] = _build(causal)
-        return cache[causal](q, k, v)
-
-    return attention_fn
+    return _cached_sharded_attention(
+        mesh, P(data_axis, seq_axis, None, None),
+        lambda q, k, v, causal: ulysses_attention(
+            q, k, v, axis=seq_axis, causal=causal, attn_fn=attn_fn))
