@@ -75,7 +75,7 @@ class Runtime:
         # detached finalizer threads while this loop keeps negotiating
         # (reference: cuda_operations.cc:148-179).
         self.finalizer = None
-        if getattr(config, "async_completion", True):
+        if config.async_completion:
             from horovod_tpu.common.finalizer import Finalizer
             self.finalizer = Finalizer()
             op_manager.attach_finalizer(self.finalizer)
@@ -221,7 +221,7 @@ class Runtime:
             self._idle_cycles += 1
         elapsed = time.monotonic() - t0
         sleep_s = cycle_time_ms / 1000.0 - elapsed
-        backoff_ms = getattr(self.config, "idle_backoff_ms", 0.0)
+        backoff_ms = self.config.idle_backoff_ms
         if backoff_ms > 0 and self._idle_cycles > self._IDLE_GRACE:
             ramp = (cycle_time_ms / 1000.0
                     * (self._idle_cycles - self._IDLE_GRACE))

@@ -81,8 +81,7 @@ class ShmBackend(CollectiveBackend):
         self._stride = 0
         self._gen = 0
         self._dead = False
-        self._opt_in = True if config is None \
-            else getattr(config, "shm_enabled", True)
+        self._opt_in = True if config is None else config.shm_enabled
 
     def enabled(self, entries, response) -> bool:
         """World-consistent by construction: topology is identical on
@@ -212,10 +211,9 @@ class ShmBackend(CollectiveBackend):
         return self._map, self._stride
 
     def _world_barrier(self) -> None:
-        if self._ctl.gather_data(b"") is not None:
-            self._ctl.broadcast_data(b"")
-        else:
-            self._ctl.broadcast_data(None)
+        # the socket backend's empty gather/broadcast round IS a world
+        # barrier; one implementation serves both uses
+        self._fallback.execute_barrier((), None)
 
     def _view(self, offset: int, dtype, count: int) -> np.ndarray:
         return np.frombuffer(self._map, dtype=dtype, count=count,

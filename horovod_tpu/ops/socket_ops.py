@@ -79,8 +79,13 @@ def _unpack_fused(entries, arrays, result: np.ndarray, response: Response):
     MemcpyOutFusionBuffer, collective_operations.cc:35-63). ``result``
     must be safe for entries to alias (fresh or already copied)."""
     if response.postscale_factor != 1.0:
-        result = result * np.asarray(response.postscale_factor,
-                                     result.dtype)
+        factor = np.asarray(response.postscale_factor, result.dtype)
+        if result.flags.writeable:
+            # postscale (the averaging hot path) in place: every caller
+            # hands a fresh buffer, so this saves a payload-size copy
+            np.multiply(result, factor, out=result)
+        else:
+            result = result * factor
     offset = 0
     for e, a in zip(entries, arrays):
         n = a.size
