@@ -264,6 +264,55 @@ def scenario_edge_shapes(hvd, rank, size):
     np.testing.assert_allclose(out, sum(range(1, size + 1)))
 
 
+def scenario_mixed_op_storm(hvd, rank, size):
+    """30 mixed collectives submitted asynchronously in a DIFFERENT
+    random order on every rank: the coordinator must serialize them
+    into one agreed schedule and complete every handle with the right
+    value — the core negotiation promise (reference spirit:
+    test_torch.py's out-of-order and partial-participation legs)."""
+    rng = np.random.RandomState(1000 + rank)  # per-rank order!
+    ssum = sum(range(1, size + 1))
+
+    jobs = []
+    for i in range(10):
+        jobs.append(("ar", i))
+        jobs.append(("bc", i))
+        jobs.append(("ag", i))
+    order = rng.permutation(len(jobs))
+
+    handles = {}
+    for idx in order:
+        kind, i = jobs[idx]
+        if kind == "ar":
+            handles[("ar", i)] = hvd.allreduce_async(
+                np.full(64 + i, float(rank + 1) * (i + 1), np.float64),
+                average=False, name=f"storm.ar{i}")
+        elif kind == "bc":
+            handles[("bc", i)] = hvd.broadcast_async(
+                np.full(8, float(rank * 100 + i), np.float32),
+                root_rank=i % size, name=f"storm.bc{i}")
+        else:
+            handles[("ag", i)] = hvd.allgather_async(
+                np.full((rank + 1, 2), float(rank * 10 + i),
+                        np.float32), name=f"storm.ag{i}")
+
+    for i in range(10):
+        np.testing.assert_allclose(
+            hvd.synchronize(handles[("ar", i)]), ssum * (i + 1))
+        np.testing.assert_allclose(
+            hvd.synchronize(handles[("bc", i)]),
+            float((i % size) * 100 + i))
+        g = hvd.synchronize(handles[("ag", i)])
+        assert np.asarray(g).shape == (sum(r + 1 for r in range(size)),
+                                       2)
+        offset = 0
+        for r in range(size):
+            np.testing.assert_allclose(
+                np.asarray(g)[offset:offset + r + 1],
+                float(r * 10 + i))
+            offset += r + 1
+
+
 def scenario_bf16_host_path(hvd, rank, size):
     """bfloat16 — the TPU-native wire/accumulate dtype — through the
     host collectives (native sum kernel or numpy/ml_dtypes fallback)."""

@@ -232,6 +232,14 @@ def test_scalar_broadcast():
 
 
 @pytest.mark.parametrize("plane", ["shm", "socket"])
+def test_mixed_op_storm(plane):
+    """Async mixed-type collectives in per-rank-random submission
+    order, on both host planes."""
+    extra = {} if plane == "shm" else {"HOROVOD_TPU_SHM": "0"}
+    run_scenario("mixed_op_storm", 3, timeout=120.0, extra_env=extra)
+
+
+@pytest.mark.parametrize("plane", ["shm", "socket"])
 def test_bf16_host_path(plane):
     extra = {} if plane == "shm" else {"HOROVOD_TPU_SHM": "0"}
     run_scenario("bf16_host_path", 2, extra_env=extra)
