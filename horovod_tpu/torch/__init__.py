@@ -44,14 +44,72 @@ def _from_numpy(arr, like):
 
 # -- tensor-level ops (reference: horovod/torch/mpi_ops.py) -------------
 
-def allreduce(tensor, op: int = Average, name: Optional[str] = None,
-              compression=Compression.none,
-              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+def _allreduce_impl(tensor, op, name, compression, prescale_factor,
+                    postscale_factor):
     comp, ctx = compression.compress(_to_numpy(tensor))
     out = _ops.allreduce(comp, op=op, name=name,
                          prescale_factor=prescale_factor,
                          postscale_factor=postscale_factor)
     return _from_numpy(np.asarray(compression.decompress(out, ctx)), tensor)
+
+
+_GRAD_FN = []
+
+
+def _allreduce_grad_fn():
+    """Lazily-built autograd Function (torch import stays optional):
+    the gradient of an allreduce is the allreduce of the gradient with
+    the same op semantics (reference: HorovodAllreduce,
+    horovod/torch/mpi_ops.py:110-121)."""
+    if not _GRAD_FN:
+        import torch
+
+        class _AllreduceGrad(torch.autograd.Function):
+            @staticmethod
+            def forward(ctx, tensor, op, name, compression, pre, post):
+                # Resolve the auto-name HERE so backward can derive a
+                # deterministic grad-op name: backward-node execution
+                # order may differ across ranks, so the global noname
+                # counter must not be what pairs the gradient
+                # collectives.
+                if name is None:
+                    name = _ops._auto_name("allreduce")
+                ctx.op, ctx.pre, ctx.post = op, pre, post
+                ctx.compression = compression
+                ctx.name = name
+                return _allreduce_impl(tensor, op, name, compression,
+                                       pre, post)
+
+            @staticmethod
+            def backward(ctx, grad):
+                # Recurse through the PUBLIC allreduce so double
+                # backward (create_graph=True) stays differentiable,
+                # like the reference's HorovodAllreduce recursion.
+                g = allreduce(grad, op=ctx.op, name=f"{ctx.name}.grad",
+                              compression=ctx.compression,
+                              prescale_factor=ctx.pre,
+                              postscale_factor=ctx.post)
+                return g, None, None, None, None, None
+
+        _GRAD_FN.append(_AllreduceGrad)
+    return _GRAD_FN[0]
+
+
+def allreduce(tensor, op: int = Average, name: Optional[str] = None,
+              compression=Compression.none,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    """Autograd flows through: for a tensor that requires grad, the
+    backward pass allreduces the upstream gradient with identical op
+    semantics (reference: test_horovod_allreduce_grad,
+    test_torch.py:377)."""
+    import torch
+    if torch.is_grad_enabled() and getattr(tensor, "requires_grad",
+                                           False):
+        return _allreduce_grad_fn().apply(
+            tensor, op, name, compression, prescale_factor,
+            postscale_factor)
+    return _allreduce_impl(tensor, op, name, compression,
+                           prescale_factor, postscale_factor)
 
 
 def allreduce_(tensor, op: int = Average, name: Optional[str] = None):

@@ -754,6 +754,48 @@ def scenario_torch_optimizer(hvd_mod, rank, size):
     assert isinstance(g.get("nesterov", False), bool)
 
 
+def scenario_torch_allreduce_grad(hvd_mod, rank, size):
+    """Gradient flows THROUGH hvd.allreduce (reference:
+    test_horovod_allreduce_grad, test_torch.py:377): the backward of a
+    sum-allreduce sums the upstream gradients, average averages them."""
+    import torch
+    import horovod_tpu.torch as hvd
+
+    x = torch.full((5,), float(rank + 1), requires_grad=True)
+    y = hvd.allreduce(x, op=hvd.Sum, name="g.sum")
+    assert torch.allclose(y, torch.full((5,),
+                                        float(sum(range(1, size + 1)))))
+    y.sum().backward()
+    # upstream ones, sum-allreduced across ranks -> size
+    assert torch.allclose(x.grad, torch.full((5,), float(size))), x.grad
+
+    x2 = torch.full((3,), float(rank + 1), requires_grad=True)
+    hvd.allreduce(x2, op=hvd.Average, name="g.avg").sum().backward()
+    # upstream ones, averaged -> ones
+    assert torch.allclose(x2.grad, torch.ones(3)), x2.grad
+
+    # no-grad tensors keep the plain (non-autograd) path
+    z = torch.full((4,), float(rank + 1))
+    out = hvd.allreduce(z, op=hvd.Sum, name="g.nograd")
+    assert not out.requires_grad
+
+    # double backward (gradient-penalty style): when the upstream
+    # gradient itself carries a graph (nonlinear loss), the backward
+    # recursion must keep it differentiable instead of silently
+    # cutting the second order at the collective
+    ssum = sum(range(1, size + 1))
+    x3 = torch.full((2,), float(rank + 1), requires_grad=True)
+    y3 = hvd.allreduce(x3, op=hvd.Sum, name="g.dd")
+    loss = (y3 ** 2).sum()
+    (g,) = torch.autograd.grad(loss, x3, create_graph=True)
+    # g = sum-allreduce(2*y3) = 2 * size * ssum  (y3 == ssum everywhere)
+    assert torch.allclose(g, torch.full((2,), 2.0 * size * ssum)), g
+    assert g.requires_grad, "create_graph lost through the collective"
+    (g2,) = torch.autograd.grad(g.sum(), x3)
+    # two nested sum-allreduces of ones: 2 * size * size
+    assert torch.allclose(g2, torch.full((2,), 2.0 * size * size)), g2
+
+
 def scenario_torch_adam_state(hvd_mod, rank, size):
     """broadcast_optimizer_state with tuple hyperparameters (Adam's
     betas) and materialized per-param state incl. int step counters —

@@ -211,6 +211,12 @@ def test_jax_adapter_host_path():
     run_scenario("jax_adapter", 2)
 
 
+def test_torch_allreduce_grad():
+    """Backward through hvd.allreduce matches the reference's autograd
+    semantics."""
+    run_scenario("torch_allreduce_grad", 2, timeout=120.0)
+
+
 def test_torch_adam_state_broadcast():
     run_scenario("torch_adam_state", 2, timeout=120.0)
 
