@@ -52,11 +52,43 @@ def allreduce(tensor, op: int = Average, name: Optional[str] = None,
             values = values / size()
         return tf.IndexedSlices(values, indices,
                                 dense_shape=tensor.dense_shape)
-    host = _to_numpy(tensor)
-    comp, ctx = compression.compress(host)
-    out = _ops.allreduce(comp, op=op, name=name)
-    return _to_tf(np.asarray(compression.decompress(np.asarray(out), ctx),
-                             dtype=host.dtype), tensor)
+    resolved = name if name is not None else _ops._auto_name("allreduce")
+
+    def _host_allreduce(t, op_name):
+        host = _to_numpy(t)
+        comp, ctx = compression.compress(host)
+        out = _ops.allreduce(comp, op=op, name=op_name)
+        # `like` must always carry a dtype: the input may be a plain
+        # Python scalar/list, which has none — the numpy view does.
+        return _to_tf(
+            np.asarray(compression.decompress(np.asarray(out), ctx),
+                       dtype=host.dtype), host)
+
+    if (tf.executing_eagerly()
+            and (tf.is_tensor(tensor)
+                 or isinstance(tensor, tf.Variable))
+            and tensor.dtype.is_floating):
+        # Variables differentiate like tensors; convert so the
+        # custom_gradient sees one input kind.
+        tensor = tf.convert_to_tensor(tensor)
+        # Differentiable under GradientTape (reference: the registered
+        # gradient of HorovodAllreduce, tensorflow/mpi_ops.py — the
+        # gradient of an allreduce is the allreduce of the gradient).
+        # The grad op's name derives from the forward's: backward
+        # execution order may differ across ranks, so the auto-name
+        # counter must not pair the gradient collectives.
+        @tf.custom_gradient
+        def _op(x):
+            y = _host_allreduce(x, resolved)
+
+            def grad(dy):
+                return allreduce(dy, op=op, name=f"{resolved}.grad",
+                                 compression=compression)
+
+            return y, grad
+
+        return _op(tensor)
+    return _host_allreduce(tensor, resolved)
 
 
 def allgather(tensor, name: Optional[str] = None):

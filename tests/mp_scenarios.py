@@ -956,6 +956,44 @@ def scenario_tf_tape(hvd_mod, rank, size):
     np.testing.assert_allclose(bcast.numpy(), [1.0] * 4)
 
 
+def scenario_tf_allreduce_grad(hvd_mod, rank, size):
+    """Gradient flows through the standalone TF allreduce under
+    GradientTape (reference: the registered HorovodAllreduce gradient,
+    tensorflow/mpi_ops.py)."""
+    import tensorflow as tf
+    import horovod_tpu.tensorflow as hvd
+
+    x = tf.constant([float(rank + 1)] * 4)
+    with tf.GradientTape() as tape:
+        tape.watch(x)
+        y = hvd.allreduce(x, op=hvd.Sum, name="tg.ar")
+        loss = tf.reduce_sum(y)
+    assert np.allclose(y.numpy(), sum(range(1, size + 1)))
+    g = tape.gradient(loss, x)
+    # upstream ones, sum-allreduced -> size
+    assert np.allclose(g.numpy(), float(size)), g.numpy()
+
+    # average semantics in the gradient too
+    x2 = tf.constant([float(rank + 1)] * 3)
+    with tf.GradientTape() as tape:
+        tape.watch(x2)
+        loss = tf.reduce_sum(hvd.allreduce(x2, op=hvd.Average,
+                                           name="tg.avg"))
+    assert np.allclose(tape.gradient(loss, x2).numpy(), 1.0)
+
+    # variables differentiate exactly like tensors
+    v = tf.Variable([float(rank + 1)] * 2)
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_sum(hvd.allreduce(v, op=hvd.Sum,
+                                           name="tg.var"))
+    assert np.allclose(tape.gradient(loss, v).numpy(),
+                       float(size)), "variable gradient lost"
+
+    # python scalars still work on the plain path
+    s = hvd.allreduce(3.0 * (rank + 1), op=hvd.Sum, name="tg.scalar")
+    assert np.allclose(np.asarray(s), 3.0 * sum(range(1, size + 1)))
+
+
 def scenario_scalar_broadcast(hvd_mod, rank, size):
     """0-d tensors must round-trip broadcast with shape intact
     (regression: ascontiguousarray promotes 0-d to (1,))."""

@@ -229,6 +229,10 @@ def test_tf_distributed_gradient_tape():
     run_scenario("tf_tape", 2, timeout=180.0)
 
 
+def test_tf_allreduce_grad():
+    run_scenario("tf_allreduce_grad", 2, timeout=180.0)
+
+
 def test_tfkeras_facade():
     run_scenario("tfkeras_facade", 2, timeout=240.0)
 
