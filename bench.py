@@ -79,7 +79,7 @@ def main() -> None:
 
     devices = jax.devices()
     n_dev = len(devices)
-    per_chip_batch = 256
+    per_chip_batch = int(os.environ.get("HVD_BENCH_BATCH", "256"))
     batch = per_chip_batch * n_dev
     image_size = 224
     # Timed in chunks with a value fetch per chunk: on the experimental

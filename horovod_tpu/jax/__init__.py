@@ -41,6 +41,9 @@ from horovod_tpu.ops import (  # noqa: F401
 )
 from horovod_tpu.common.compression import Compression  # noqa: F401
 from horovod_tpu import spmd as _spmd
+from horovod_tpu.spmd import (  # noqa: F401
+    zero_optimizer, zero_state_specs, sharded_clip_by_global_norm,
+)
 
 
 def DistributedOptimizer(tx, op: int = _spmd.Average,
@@ -167,5 +170,6 @@ __all__ = [
     "synchronize", "Average", "Sum", "Compression",
     "DistributedOptimizer", "allreduce_gradients",
     "broadcast_parameters", "broadcast_optimizer_state",
-    "broadcast_train_state",
+    "broadcast_train_state", "zero_optimizer", "zero_state_specs",
+    "sharded_clip_by_global_norm",
 ]

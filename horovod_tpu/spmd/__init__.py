@@ -253,10 +253,16 @@ def shard_batch(mesh, batch, axis: AxisName = "data"):
     return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), batch)
 
 
+# Imported last: zero.py uses the mesh helpers defined above.
+from horovod_tpu.spmd.zero import (  # noqa: E402
+    zero_optimizer, zero_state_specs, sharded_clip_by_global_norm,
+)
+
 __all__ = [
     "Average", "Sum", "Min", "Max",
     "create_mesh", "create_hybrid_mesh", "mesh_rank", "mesh_size",
     "allreduce", "allgather", "broadcast", "alltoall", "reducescatter",
     "allreduce_gradients", "broadcast_variables",
     "batch_sharding", "replicated_sharding", "shard_batch",
+    "zero_optimizer", "zero_state_specs", "sharded_clip_by_global_norm",
 ]

@@ -1,0 +1,171 @@
+"""ZeRO-1 sharded optimizer — TPU-native extension beyond the reference.
+
+The reference's DistributedOptimizer keeps a full replica of the
+optimizer state on every worker and allreduces full gradients
+(reference: horovod/tensorflow/__init__.py:151-249,
+horovod/torch/__init__.py:95-147). On TPU the profitable data-parallel
+refinement is ZeRO stage 1: reduce-scatter each gradient so every mesh
+rank reduces only its 1/n shard, run the optimizer update on that shard
+(so first/second-moment state is 1/n the size per chip), and all-gather
+the parameter updates. Total bytes on the wire equal a ring allreduce
+(reduce-scatter + all-gather), but optimizer-state HBM drops by the
+data-axis size — the headroom that lets a bigger model or batch fit.
+
+Everything here runs *inside* a shard_map/pjit-traced step with the mesh
+axis in scope, like the rest of horovod_tpu.spmd: XLA sees the
+reduce-scatter and all-gather as plain collectives it can schedule onto
+ICI and overlap with the surrounding compute.
+
+The optimizer state is genuinely device-varying (each rank holds its
+own moment shard), so it must cross the shard_map boundary with a
+sharded spec — ``P(axis)`` on the moment vectors, ``P()`` on replicated
+scalars like Adam's step count. :func:`zero_state_specs` computes that
+spec tree; under it, host materialization of the state gathers every
+rank's shard (the full flattened moments — checkpointable), and
+``device_put`` with the same spec restores each rank's shard exactly.
+"""
+
+from __future__ import annotations
+
+from horovod_tpu.spmd import (
+    Average, Sum, AxisName, allgather, mesh_rank, mesh_size,
+    reducescatter,
+)
+
+
+def _pad_flat(x, n: int):
+    """``x`` flattened and zero-padded to a multiple of ``n``."""
+    import jax.numpy as jnp
+
+    flat = x.reshape(-1)
+    k = -(-flat.size // n)
+    if n * k != flat.size:
+        flat = jnp.pad(flat, (0, n * k - flat.size))
+    return flat, k
+
+
+def _shard_leaf(x, axis: AxisName):
+    """This rank's 1-D shard of ``x``: flatten, zero-pad to a multiple
+    of the axis size, take the rank'th contiguous slice."""
+    import jax
+
+    flat, k = _pad_flat(x, mesh_size(axis))
+    return jax.lax.dynamic_slice_in_dim(flat, mesh_rank(axis) * k, k)
+
+
+def zero_optimizer(tx, op: int = Average, axis: AxisName = "data"):
+    """Wrap an optax GradientTransformation in a ZeRO-1 sharded update.
+
+    Returns an optax-compatible transformation whose ``init`` and
+    ``update`` must run inside a shard_map/pjit context with ``axis`` in
+    scope (jit a tiny shard_map'd init once to build the state ahead of
+    the first step — see docs/zero.md). ``update`` requires ``params``.
+
+    Semantics: gradients are reduce-scattered over ``axis`` (mean for
+    ``Average``, the DistributedOptimizer default; plain sum for
+    ``Sum``), ``tx`` updates this rank's parameter shard, and the
+    resulting update shards are all-gathered so the returned ``updates``
+    pytree matches the full parameter shapes — drop-in for
+    ``optax.apply_updates``.
+
+    The state is per-rank (each rank's moment shard): pass it through
+    shard_map with the specs from :func:`zero_state_specs`, never
+    ``P()``.
+
+    Caveat: ``tx`` sees *shards*, so transforms that mix information
+    across the whole pytree (e.g. ``optax.clip_by_global_norm``) would
+    compute per-rank-different statistics. Use
+    :func:`sharded_clip_by_global_norm` inside the chain instead — it
+    restores the true global norm with a psum over ``axis``.
+    """
+    import jax
+    import optax
+
+    if op not in (Average, Sum):
+        raise ValueError(f"zero_optimizer supports Average/Sum (got {op})")
+
+    def _grad_shard(g):
+        flat, _ = _pad_flat(g, mesh_size(axis))
+        return reducescatter(flat, op=op, axis=axis)
+
+    def init_fn(params):
+        return tx.init(jax.tree_util.tree_map(
+            lambda p: _shard_leaf(p, axis), params))
+
+    def update_fn(grads, state, params=None, **extra):
+        if params is None:
+            raise ValueError("zero_optimizer.update requires params")
+        grad_shards = jax.tree_util.tree_map(_grad_shard, grads)
+        param_shards = jax.tree_util.tree_map(
+            lambda p: _shard_leaf(p, axis), params)
+        upd_shards, new_state = tx.update(grad_shards, state,
+                                          param_shards, **extra)
+
+        def _unshard(u, ref):
+            full = allgather(u, axis=axis)
+            return full[:ref.size].reshape(ref.shape).astype(ref.dtype)
+
+        updates = jax.tree_util.tree_map(_unshard, upd_shards, params)
+        return updates, new_state
+
+    return optax.GradientTransformationExtraArgs(init_fn, update_fn)
+
+
+def zero_state_specs(tx, params, axis_size: int, axis: AxisName = "data"):
+    """PartitionSpec tree for the :func:`zero_optimizer` state: the
+    spec to use wherever the state crosses a shard_map boundary
+    (in_specs/out_specs) or is placed on the mesh (device_put).
+
+    Works host-side, before any state exists: the state *structure* is
+    derived with ``jax.eval_shape`` of ``tx.init`` on this rank's shard
+    shapes (``ceil(size/axis_size)`` elements per leaf). Moment shards
+    are 1-D and get ``P(axis)`` — globally they concatenate into the
+    full flattened (padded) moment vector, so host reads see all ranks'
+    state and checkpoints round-trip. 0-d leaves (Adam's step count,
+    schedule counters) are replicated and get ``P()``.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def shard_struct(p):
+        k = -(-p.size // axis_size)
+        return jax.ShapeDtypeStruct((k,), p.dtype)
+
+    abs_state = jax.eval_shape(
+        tx.init, jax.tree_util.tree_map(shard_struct, params))
+    return jax.tree_util.tree_map(
+        lambda leaf: P(axis) if leaf.ndim >= 1 else P(), abs_state)
+
+
+def sharded_clip_by_global_norm(max_norm: float, axis: AxisName = "data"):
+    """``optax.clip_by_global_norm`` for gradient *shards*: each rank
+    holds a disjoint 1/n piece of the reduced gradient (the
+    :func:`zero_optimizer` inner view), so the true global norm is the
+    psum over ``axis`` of per-shard sums of squares. Chain it ahead of
+    the inner optimizer: ``zero_optimizer(optax.chain(
+    sharded_clip_by_global_norm(1.0), optax.adam(lr)))``."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None, **extra):
+        del params, extra
+        leaves = jax.tree_util.tree_leaves(updates)
+        local_sq = sum(jnp.sum(jnp.square(u.astype(jnp.float32)))
+                       for u in leaves)
+        g_norm = jnp.sqrt(jax.lax.psum(local_sq, axis))
+        scale = jnp.minimum(1.0, max_norm / jnp.maximum(g_norm, 1e-16))
+        clipped = jax.tree_util.tree_map(
+            lambda u: (u.astype(jnp.float32) * scale).astype(u.dtype),
+            updates)
+        return clipped, state
+
+    return optax.GradientTransformationExtraArgs(init_fn, update_fn)
+
+
+__all__ = ["zero_optimizer", "zero_state_specs",
+           "sharded_clip_by_global_norm"]
