@@ -83,6 +83,9 @@ def zero_optimizer(tx, op: int = Average, axis: AxisName = "data"):
 
     if op not in (Average, Sum):
         raise ValueError(f"zero_optimizer supports Average/Sum (got {op})")
+    # The wrapper advertises ExtraArgs; make the inner tx honor that
+    # contract too (plain transformations would TypeError on **extra).
+    tx = optax.with_extra_args_support(tx)
 
     def _grad_shard(g):
         flat, _ = _pad_flat(g, mesh_size(axis))

@@ -313,6 +313,80 @@ def scenario_mixed_op_storm(hvd, rank, size):
             offset += r + 1
 
 
+def scenario_kitchen_sink(hvd, rank, size):
+    """Every auxiliary subsystem enabled at once — autotune (+log),
+    timeline (+cycle marks), hierarchical shm over a fake 2-host
+    topology, stall checker armed — under mixed per-rank-shuffled
+    traffic with a mid-stream coordinator ERROR and recovery. The
+    artifacts (timeline JSON, autotune CSV) are verified by the
+    spawning test after shutdown."""
+    from horovod_tpu.common import basics as _b
+    from horovod_tpu.common.status import HorovodInternalError
+
+    rt = _b.runtime()
+    assert rt.parameter_manager is not None, "autotune must be active"
+    assert rt.timeline.enabled or rank != 0
+
+    ssum = sum(range(1, size + 1))
+    rng = np.random.RandomState(77 + rank)  # per-rank order!
+    for round_ in range(20):
+        jobs = [("ar", i) for i in range(4)] + \
+               [("bc", i) for i in range(4)] + \
+               [("ag", i) for i in range(2)] + \
+               [("rs", i) for i in range(2)]
+        handles = {}
+        for idx in rng.permutation(len(jobs)):
+            kind, i = jobs[idx]
+            tag = f"ks{round_}.{kind}{i}"
+            if kind == "ar":
+                handles[(kind, i)] = hvd.allreduce_async(
+                    np.full(300 + i, float(rank + 1) * (i + 1),
+                            np.float64), average=False, name=tag)
+            elif kind == "bc":
+                handles[(kind, i)] = hvd.broadcast_async(
+                    np.full(16, float(rank * 10 + i), np.float32),
+                    root_rank=i % size, name=tag)
+            elif kind == "ag":
+                handles[(kind, i)] = hvd.allgather_async(
+                    np.full((rank + 1, 3), float(rank + i), np.float32),
+                    name=tag)
+            else:
+                handles[(kind, i)] = hvd.reducescatter_async(
+                    np.arange(size * 4, dtype=np.float64) + rank,
+                    name=tag)
+        for (kind, i), h in handles.items():
+            out = np.asarray(hvd.synchronize(h))
+            if kind == "ar":
+                np.testing.assert_allclose(
+                    out, np.full(300 + i, ssum * (i + 1)))
+            elif kind == "bc":
+                np.testing.assert_allclose(
+                    out, float((i % size) * 10 + i))
+            elif kind == "ag":
+                assert out.shape == (sum(r + 1 for r in range(size)), 3)
+            else:
+                base = size * np.arange(size * 4) + sum(range(size))
+                np.testing.assert_allclose(
+                    out, base[rank * 4:(rank + 1) * 4])
+
+        if round_ == 3:
+            # coordinator ERROR mid-storm: mismatched shapes...
+            shape = (4, 5) if rank == 0 else (4, 6)
+            try:
+                hvd.allreduce(np.ones(shape, np.float32), name="ks.bad")
+            except HorovodInternalError:
+                pass
+            else:
+                raise AssertionError("expected HorovodInternalError")
+            # ...and the world keeps negotiating afterwards
+            np.testing.assert_allclose(
+                hvd.allreduce(np.ones(5, np.float32), average=False,
+                              name="ks.recover"),
+                size * np.ones(5))
+
+    hvd.barrier(name="ks.done")
+
+
 def scenario_bf16_host_path(hvd, rank, size):
     """bfloat16 — the TPU-native wire/accumulate dtype — through the
     host collectives (native sum kernel or numpy/ml_dtypes fallback)."""

@@ -249,6 +249,47 @@ def test_mixed_op_storm(plane):
     run_scenario("mixed_op_storm", 3, timeout=120.0, extra_env=extra)
 
 
+def test_kitchen_sink_all_subsystems(tmp_path):
+    """Cross-subsystem integration: autotune (+log), timeline (+cycle
+    marks), hierarchical shm over a fake 2-host topology, and the stall
+    inspector armed — all in one 4-rank world under shuffled mixed
+    traffic with a mid-stream coordinator ERROR. Afterwards both
+    artifacts must be well-formed: the timeline is valid Chrome-tracing
+    JSON with negotiation + execution + cycle vocabulary, and the
+    autotune CSV has sample rows."""
+    timeline = str(tmp_path / "ks_timeline.json")
+    atlog = str(tmp_path / "ks_autotune.csv")
+    run_scenario(
+        "kitchen_sink", 4, timeout=300.0,
+        extra_env={
+            "HOROVOD_TIMELINE": timeline,
+            "HOROVOD_TIMELINE_MARK_CYCLES": "1",
+            "HOROVOD_AUTOTUNE": "1",
+            "HOROVOD_AUTOTUNE_LOG": atlog,
+            # first CSV row needs (warmup+3)*10 busy cycles; trim the
+            # warmup so the storm's traffic crosses the line quickly
+            "HOROVOD_AUTOTUNE_WARMUP_SAMPLES": "1",
+            "HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
+            "HOROVOD_HIERARCHICAL_ALLGATHER": "1",
+            "HOROVOD_STALL_CHECK_TIME_SECONDS": "60",
+        },
+        per_rank_env=lambda rank: {
+            "HOROVOD_HOSTNAME": f"fakehost{rank // 2}"})
+
+    import json
+    with open(timeline) as f:
+        events = json.load(f)
+    names = {e.get("name") for e in events}
+    for required in ("NEGOTIATE_ALLREDUCE", "NEGOTIATE_BROADCAST",
+                     "NEGOTIATE_ALLGATHER", "ALLREDUCE", "BROADCAST",
+                     "CYCLE_START"):
+        assert required in names, (required, sorted(names)[:40])
+
+    with open(atlog) as f:
+        rows = [ln for ln in f.read().splitlines() if ln.strip()]
+    assert len(rows) >= 2, rows  # header + at least one sample
+
+
 @pytest.mark.parametrize("plane", ["shm", "socket"])
 def test_bf16_host_path(plane):
     extra = {} if plane == "shm" else {"HOROVOD_TPU_SHM": "0"}

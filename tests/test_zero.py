@@ -177,6 +177,31 @@ def test_zero_requires_params(mesh):
             out_specs=P(), check_vma=False))(_per_rank_grads())
 
 
+def test_zero_accepts_extra_args(mesh):
+    """The ExtraArgs contract: unknown keyword args must be accepted
+    and ignored even when the inner tx is a plain transformation."""
+    tx = optax.sgd(0.1)
+    ztx = spmd.zero_optimizer(tx)
+    specs = spmd.zero_state_specs(tx, _params(), N)
+    grad_specs = jax.tree_util.tree_map(lambda _: P("data"), _params())
+
+    def step(p, state, g_stacked):
+        g = jax.tree_util.tree_map(lambda t: t[0], g_stacked)
+        updates, state = ztx.update(g, state, p, value=jnp.float32(1.0))
+        return optax.apply_updates(p, updates), state
+
+    params = _params()
+    init_f = jax.jit(jax.shard_map(
+        ztx.init, mesh=mesh, in_specs=(P(),), out_specs=specs,
+        check_vma=False))
+    step_f = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P(), specs, grad_specs),
+        out_specs=(P(), specs), check_vma=False))
+    p2, _ = step_f(params, init_f(params), _per_rank_grads())
+    want, _ = _run_reference(lambda: optax.sgd(0.1), n_steps=1)
+    _tree_close(p2, want, rtol=1e-5, atol=1e-6)
+
+
 def test_zero_rejects_min_max():
     with pytest.raises(ValueError, match="Average/Sum"):
         spmd.zero_optimizer(optax.sgd(0.1), op=spmd.Min)
