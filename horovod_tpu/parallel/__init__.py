@@ -13,7 +13,7 @@ support at all. These are first-class here:
 """
 
 from horovod_tpu.parallel.sharding import (
-    ShardingRules, infer_sharding, transformer_tp_rules,
+    ShardingRules, fsdp_sharding, infer_sharding, transformer_tp_rules,
 )
 from horovod_tpu.parallel.ring_attention import (
     ring_attention, make_ring_attention,
@@ -36,7 +36,8 @@ def __getattr__(name):
     raise AttributeError(name)
 
 __all__ = [
-    "ShardingRules", "infer_sharding", "transformer_tp_rules",
+    "ShardingRules", "fsdp_sharding", "infer_sharding",
+    "transformer_tp_rules",
     "ring_attention", "make_ring_attention",
     "ulysses_attention", "make_ulysses_attention",
     "pipeline_stages", "make_pipeline_apply", "PipelinedLM",

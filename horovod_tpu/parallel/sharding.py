@@ -118,3 +118,53 @@ def transformer_tp_rules(model_axis: str = "model",
 def resnet_dp_rules() -> ShardingRules:
     """ResNet is pure data-parallel: every parameter replicated."""
     return ShardingRules([])
+
+
+def fsdp_sharding(params, mesh, axis: str = "data",
+                  base=None, min_size: int = 1024):
+    """FSDP-style (ZeRO-3) parameter sharding via GSPMD: augment each
+    parameter's sharding with ``axis`` on its largest still-replicated
+    divisible dimension. jit-ing the step with these input shardings
+    makes XLA all-gather weights just-in-time for each layer's compute
+    and reduce-scatter its gradients — the FSDP schedule — and
+    ``tx.init`` under jit propagates the same sharding onto the
+    optimizer moments, so parameter + optimizer memory drop by the axis
+    size. (No reference analog — beyond-parity, like ZeRO-1 in
+    horovod_tpu.spmd.zero; this is the GSPMD/pjit rendering where the
+    compiler owns the gather/scatter schedule.)
+
+    ``base``: optional pytree of NamedShardings (e.g. from
+    :func:`infer_sharding` with tensor-parallel rules) to compose with —
+    dims already claimed by other axes are left alone. Leaves smaller
+    than ``min_size`` elements (biases, layernorm scales) stay put:
+    gathering them costs more than replicating.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = mesh.shape[axis]
+
+    def one(leaf, base_sh):
+        shape = getattr(leaf, "shape", None)
+        if shape is None or int(np.prod(shape)) < min_size:
+            return base_sh
+        spec = list(base_sh.spec)
+        spec += [None] * (len(shape) - len(spec))
+        used = set()
+        for entry in spec:
+            if entry is not None:
+                used.update((entry,) if isinstance(entry, str) else entry)
+        if axis in used:  # e.g. experts already sharded over this axis
+            return base_sh
+        candidates = [d for d in range(len(shape))
+                      if spec[d] is None and shape[d] % n == 0
+                      and shape[d] >= n]
+        if not candidates:
+            return base_sh
+        best = max(candidates, key=lambda d: shape[d])
+        spec[best] = axis
+        return NamedSharding(mesh, P(*spec))
+
+    if base is None:
+        base = jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh, P()), params)
+    return jax.tree_util.tree_map(one, params, base)

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from horovod_tpu.parallel.sharding import (
-    ShardingRules, infer_sharding, transformer_tp_rules,
+    ShardingRules, fsdp_sharding, infer_sharding, transformer_tp_rules,
 )
 
 
@@ -32,6 +32,11 @@ class TrainerConfig:
     model_axis: Optional[str] = "model"   # None = no tensor parallelism
     seq_axis: Optional[str] = None        # None = no sequence parallelism
     expert_axis: Optional[str] = None     # None = no expert parallelism
+    fsdp_axis: Optional[str] = None       # None = no parameter sharding
+    # (fsdp_axis may equal data_axis: classic FSDP shards weights over
+    # the data ranks; GSPMD inserts the per-layer all-gathers and the
+    # gradient reduce-scatters, and optimizer state follows the
+    # parameter shardings — see parallel.sharding.fsdp_sharding)
     # Sequence parallelism needs a ring attention_fn in the model config
     # (parallel.make_ring_attention) — injected there, not a flag here,
     # because the attention implementation lives in the module tree.
@@ -86,9 +91,26 @@ class Trainer:
 
         params = jax.jit(self.module.init)(rng, inputs)
         self._param_shardings = infer_sharding(params, self.rules, self.mesh)
+        fa = self.config.fsdp_axis
+        if fa is not None:
+            if fa not in self.mesh.axis_names:
+                raise ValueError(
+                    f"fsdp_axis {fa!r} is not a mesh axis "
+                    f"{self.mesh.axis_names}; parameters would silently "
+                    f"stay replicated")
+            self._param_shardings = fsdp_sharding(
+                params, self.mesh, axis=fa, base=self._param_shardings)
         params = jax.tree_util.tree_map(jax.device_put, params,
                                         self._param_shardings)
-        opt_state = jax.jit(self.tx.init)(params)
+        # Optimizer moments must be co-sharded with their parameters
+        # (XLA does not propagate input shardings through zeros_like, so
+        # an unconstrained init would replicate them — forfeiting the
+        # fsdp/tp memory win). Pin out_shardings by matching each state
+        # leaf to its parameter via path suffix + shape.
+        opt_shardings = _opt_state_shardings(
+            self.tx, params, self._param_shardings, self.mesh)
+        opt_state = jax.jit(self.tx.init,
+                            out_shardings=opt_shardings)(params)
         return {"params": params, "opt_state": opt_state,
                 "step": jnp.zeros((), jnp.int32)}
 
@@ -117,6 +139,36 @@ class Trainer:
         batch = jax.tree_util.tree_map(
             lambda a: jax.device_put(a, self.batch_sharding), batch)
         return self.step_fn()(state, batch)
+
+
+def _opt_state_shardings(tx, params, param_shardings, mesh):
+    """NamedSharding tree for ``tx.init(params)``: param-shaped state
+    leaves (Adam/momentum moments, keyed by the same sub-paths as the
+    parameter tree) take their parameter's sharding; everything else
+    (step counters, scalars) is replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    flat_sh = jax.tree_util.tree_leaves(
+        param_shardings, is_leaf=lambda x: isinstance(x, NamedSharding))
+    # Longest key first: "...['z']['w']" must win over a bare "...['w']"
+    # when both are suffixes of a state leaf's path and shapes collide.
+    keyed = sorted(
+        ((jax.tree_util.keystr(path), leaf.shape, sh)
+         for (path, leaf), sh in zip(flat, flat_sh)),
+        key=lambda t: len(t[0]), reverse=True)
+
+    abs_state = jax.eval_shape(tx.init, params)
+    replicated = NamedSharding(mesh, P())
+
+    def one(path, leaf):
+        ks = jax.tree_util.keystr(path)
+        for pks, shape, sh in keyed:
+            if ks.endswith(pks) and getattr(leaf, "shape", None) == shape:
+                return sh
+        return replicated
+
+    return jax.tree_util.tree_map_with_path(one, abs_state)
 
 
 _MOE_AUX_WEIGHT = 0.01  # Switch Transformer's alpha
