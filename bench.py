@@ -33,7 +33,6 @@ from __future__ import annotations
 
 import json
 import os
-import time
 
 BASELINE_IMG_PER_SEC_PER_DEVICE = 103.55
 
@@ -166,20 +165,18 @@ def main() -> None:
     except Exception:
         hw_step_flops = None
 
-    for _ in range(warmup_steps):
-        params, batch_stats, opt_state, loss = train_step(
-            params, batch_stats, opt_state, images, labels)
-    float(loss)  # real sync (see note above)
+    from horovod_tpu.utils.timing import steady_state_sec_per_step
 
-    chunk_dts = []
-    for _ in range(chunks):
-        t0 = time.perf_counter()
-        for _ in range(chunk_steps):
-            params, batch_stats, opt_state, loss = train_step(
-                params, batch_stats, opt_state, images, labels)
-        float(loss)
-        chunk_dts.append(time.perf_counter() - t0)
-    sec_per_step = float(np.median(chunk_dts)) / chunk_steps
+    st = {"p": params, "bs": batch_stats, "os": opt_state}
+
+    def one_step():
+        st["p"], st["bs"], st["os"], loss = train_step(
+            st["p"], st["bs"], st["os"], images, labels)
+        return loss
+
+    sec_per_step = steady_state_sec_per_step(
+        one_step, lambda l: float(l), warmup_steps=warmup_steps,
+        chunks=chunks, chunk_steps=chunk_steps)
 
     img_per_sec = batch / sec_per_step
     per_chip = img_per_sec / n_dev

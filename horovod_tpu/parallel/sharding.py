@@ -126,10 +126,11 @@ def fsdp_sharding(params, mesh, axis: str = "data",
     parameter's sharding with ``axis`` on its largest still-replicated
     divisible dimension. jit-ing the step with these input shardings
     makes XLA all-gather weights just-in-time for each layer's compute
-    and reduce-scatter its gradients — the FSDP schedule — and
-    ``tx.init`` under jit propagates the same sharding onto the
-    optimizer moments, so parameter + optimizer memory drop by the axis
-    size. (No reference analog — beyond-parity, like ZeRO-1 in
+    and reduce-scatter its gradients — the FSDP schedule. Optimizer
+    moments do NOT inherit these shardings automatically (XLA won't
+    propagate them through ``zeros_like``): pin ``out_shardings`` when
+    jitting ``tx.init``, as ``Trainer.init`` does, so parameter +
+    optimizer memory both drop by the axis size. (No reference analog — beyond-parity, like ZeRO-1 in
     horovod_tpu.spmd.zero; this is the GSPMD/pjit rendering where the
     compiler owns the gather/scatter schedule.)
 

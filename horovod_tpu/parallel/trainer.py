@@ -136,6 +136,11 @@ class Trainer:
         return self._step
 
     def train_step(self, state, batch):
+        # device_put is a no-op for arrays already resident with an
+        # equivalent sharding; host (numpy) batches are uploaded each
+        # call — place a fixed batch on the mesh once yourself when
+        # benchmarking (see examples/transformer_long_context.py: on
+        # remote-attached TPUs the per-step upload dwarfs the step).
         batch = jax.tree_util.tree_map(
             lambda a: jax.device_put(a, self.batch_sharding), batch)
         return self.step_fn()(state, batch)

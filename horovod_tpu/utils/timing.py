@@ -1,0 +1,45 @@
+"""Steady-state step timing for benchmarks.
+
+One shared implementation of the discipline bench.py and the examples
+need on TPU platforms:
+
+- warm up past compilation AND the platform's slow first dispatches
+  (remotely-attached chips settle over ~10 calls);
+- time in chunks with a real value fetch per chunk — on some platforms
+  ``block_until_ready`` can return before execution finishes, so a
+  scalar fetch is the only reliable sync point;
+- report the median chunk, robust to bursty host/tunnel interference.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+
+def steady_state_sec_per_step(step: Callable[[], object],
+                              sync: Callable[[object], None],
+                              warmup_steps: int = 10,
+                              chunks: int = 4,
+                              chunk_steps: int = 5) -> float:
+    """Median seconds per ``step()`` call at steady state.
+
+    ``step`` runs one (async-dispatched) training step and returns a
+    handle; ``sync`` forces completion of that handle (e.g.
+    ``lambda r: float(r[-1])`` fetching the loss). Runs
+    ``warmup_steps`` then ``chunks`` timed chunks of ``chunk_steps``.
+    """
+    import numpy as np
+
+    r = None
+    for _ in range(max(1, warmup_steps)):
+        r = step()
+    sync(r)
+    dts = []
+    for _ in range(max(1, chunks)):
+        t0 = time.perf_counter()
+        for _ in range(max(1, chunk_steps)):
+            r = step()
+        sync(r)
+        dts.append((time.perf_counter() - t0) / max(1, chunk_steps))
+    return float(np.median(dts))
