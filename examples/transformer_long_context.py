@@ -88,10 +88,11 @@ def main():
             last.get("state", state), batch)
         return last["loss"]
 
+    chunks = 4
     sec = steady_state_sec_per_step(
         one_step, lambda l: float(l),
-        warmup_steps=10, chunks=4,
-        chunk_steps=-(-args.steps // 4))  # ceil: at least --steps timed
+        warmup_steps=10, chunks=chunks,
+        chunk_steps=-(-args.steps // chunks))  # ceil: >= --steps timed
     loss = float(last["loss"])
     tok_s = args.batch_size * args.seq_len / sec
     print(f"loss {loss:.4f}; {tok_s:,.0f} tokens/sec "

@@ -27,14 +27,16 @@ def steady_state_sec_per_step(step: Callable[[], object],
     ``step`` runs one (async-dispatched) training step and returns a
     handle; ``sync`` forces completion of that handle (e.g.
     ``lambda r: float(r[-1])`` fetching the loss). Runs
-    ``warmup_steps`` then ``chunks`` timed chunks of ``chunk_steps``.
+    ``warmup_steps`` (0 allowed, for cold-start measurements) then
+    ``chunks`` timed chunks of ``chunk_steps`` (each clamped to >= 1).
     """
     import numpy as np
 
     r = None
-    for _ in range(max(1, warmup_steps)):
+    for _ in range(max(0, warmup_steps)):
         r = step()
-    sync(r)
+    if r is not None:
+        sync(r)
     dts = []
     for _ in range(max(1, chunks)):
         t0 = time.perf_counter()

@@ -435,6 +435,29 @@ def scenario_rank_death(hvd, rank, size):
     hvd.shutdown()
 
 
+def scenario_coordinator_death(hvd, rank, size):
+    """The COORDINATOR (rank 0, which also hosts the controller socket)
+    dying abruptly is the worst failure: every worker's control channel
+    drops at once. Workers must fail loudly on their next collective and
+    shut down cleanly — never hang (complements scenario_rank_death,
+    which kills a non-coordinator)."""
+    import time
+    from horovod_tpu.common.status import HorovodInternalError
+    x = np.full(16, float(rank + 1), np.float32)
+    out = hvd.allreduce(x, average=False, name="cd.ok")
+    np.testing.assert_allclose(out, sum(range(1, size + 1)))
+    if rank == 0:
+        os._exit(0)  # coordinator vanishes, controller socket with it
+    time.sleep(0.5)
+    try:
+        hvd.allreduce(x, average=False, name="cd.after")
+        raise AssertionError(
+            "collective after coordinator death must fail")
+    except HorovodInternalError:
+        pass
+    hvd.shutdown()
+
+
 def scenario_subset_world(hvd, rank, size):
     """hvd.init(comm=[1, 2]) on a 3-process launch: ranks 1 and 2 form
     a 2-rank sub-world (renumbered 0 and 1, rank 1 hosting the

@@ -82,6 +82,11 @@ def test_moe_pipeline_parallel():
     assert "loss" in out.lower() or "moe" in out.lower()
 
 
+def test_zero_fsdp():
+    out = _run("zero_fsdp.py", n_devices=8)
+    assert "ZeRO-1" in out and "FSDP" in out
+
+
 @pytest.mark.parametrize("script", sorted(
     f for f in os.listdir(EX) if f.endswith(".py")))
 def test_every_example_is_covered(script):
@@ -90,5 +95,6 @@ def test_every_example_is_covered(script):
         "jax_mnist.py", "torch_mnist.py", "tensorflow_mnist.py",
         "keras_mnist.py", "jax_synthetic_benchmark.py",
         "transformer_long_context.py", "moe_pipeline_parallel.py",
+        "zero_fsdp.py",
     }
     assert script in covered, f"add a smoke test for examples/{script}"

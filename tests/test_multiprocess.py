@@ -343,6 +343,12 @@ def test_rank_death_fails_survivors_cleanly():
     run_scenario("rank_death", 3, timeout=60.0)
 
 
+def test_coordinator_death_fails_workers_cleanly():
+    """Kill rank 0 (coordinator + controller host): both workers must
+    error out on their next collective and shut down, not hang."""
+    run_scenario("coordinator_death", 3, timeout=60.0)
+
+
 def test_rank_subset_init():
     """init(comm=[1, 2]) on 3 processes: the 2-rank subset allreduces
     while the third abstains in a size-1 world."""

@@ -175,3 +175,39 @@ def test_create_hybrid_mesh_axis_order(monkeypatch):
     assert captured == {"ici": (2, 2), "dcn": (2,)}
     assert mesh.axis_names == ("data", "seq", "model")
     assert dict(mesh.shape) == {"data": 2, "seq": 2, "model": 2}
+
+
+def test_distributed_optimizer_predivide_and_compression(mesh):
+    """hvd.jax.DistributedOptimizer: the default pmean path, the
+    prescale/postscale pre-divide path, and the bf16-compressed path
+    must all produce the mean-gradient SGD update (prescale by 1/f,
+    postscale by f/n — net mean, smaller intermediates; reference:
+    allreduce prescale/postscale contract)."""
+    import optax
+    import horovod_tpu.jax as hj
+
+    rng = np.random.RandomState(3)
+    params = {"w": rng.randn(4, 6).astype(np.float32)}
+    g_stacked = rng.randn(8, 4, 6).astype(np.float32)
+    want_g = g_stacked.mean(0)
+
+    def run(tx):
+        def step(p, g8):
+            g = {"w": g8[0]}
+            state = tx.init(p)
+            updates, _ = tx.update(g, state, p)
+            return optax.apply_updates(p, updates)
+        f = _shard_map(mesh, step, (P(), P("data")), P())
+        return np.asarray(f(params, g_stacked)["w"])
+
+    want = params["w"] - 0.1 * want_g
+    base = run(hj.DistributedOptimizer(optax.sgd(0.1)))
+    np.testing.assert_allclose(base, want, rtol=1e-5)
+
+    pre = run(hj.DistributedOptimizer(optax.sgd(0.1),
+                                      gradient_predivide_factor=8.0))
+    np.testing.assert_allclose(pre, want, rtol=1e-5)
+
+    comp = run(hj.DistributedOptimizer(
+        optax.sgd(0.1), compression=hj.Compression.bf16))
+    np.testing.assert_allclose(comp, want, rtol=2e-2, atol=1e-2)

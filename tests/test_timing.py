@@ -1,0 +1,43 @@
+"""The shared steady-state timing helper used by bench.py and the
+examples: warmup runs first and is excluded; chunks are timed with a
+sync per chunk; the median chunk is reported."""
+
+import time
+
+from horovod_tpu.utils.timing import steady_state_sec_per_step
+
+
+def test_warmup_excluded_and_median_reported():
+    calls = []
+
+    def step():
+        calls.append(time.perf_counter())
+        # first 3 calls (warmup) artificially slow
+        if len(calls) <= 3:
+            time.sleep(0.05)
+        else:
+            time.sleep(0.002)
+        return len(calls)
+
+    synced = []
+    sec = steady_state_sec_per_step(
+        step, synced.append, warmup_steps=3, chunks=3, chunk_steps=4)
+    assert len(calls) == 3 + 3 * 4
+    # one sync per chunk plus the warmup sync
+    assert len(synced) == 1 + 3
+    # the slow warmup never pollutes the measurement
+    assert 0.0015 < sec < 0.02, sec
+
+
+def test_degenerate_counts_clamped():
+    n = {"v": 0}
+
+    def step():
+        n["v"] += 1
+        return n["v"]
+
+    sec = steady_state_sec_per_step(step, lambda r: None,
+                                    warmup_steps=0, chunks=0,
+                                    chunk_steps=0)
+    assert n["v"] == 1  # warmup 0 honored (cold start); 1 chunk of 1
+    assert sec >= 0.0
