@@ -247,8 +247,15 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None):
-        """tokens: [B, S] int32 → logits [B, S, vocab] fp32."""
+    def __call__(self, tokens, positions=None, return_hidden=False):
+        """tokens: [B, S] int32 → logits [B, S, vocab] fp32.
+
+        ``return_hidden=True`` returns the pre-head hidden states
+        [B, S, D] (after ln_f, cfg.dtype) instead — the input to
+        :func:`lm_loss_from_hidden`'s chunked cross-entropy, which
+        avoids ever materializing the full [B, S, vocab] fp32 logits
+        (multi-GB at vocab 32k and long context). XLA dead-code
+        eliminates the unbuilt head."""
         cfg = self.cfg
         if positions is None:
             positions = jnp.broadcast_to(
@@ -263,6 +270,8 @@ class TransformerLM(nn.Module):
                 x, positions)
         x = nn.LayerNorm(use_bias=False, dtype=cfg.dtype,
                          param_dtype=jnp.float32, name="ln_f")(x)
+        if return_hidden:
+            return x
         logits = nn.Dense(cfg.vocab_size, use_bias=False,
                           dtype=jnp.float32, name="lm_head")(
                               x.astype(jnp.float32))
@@ -276,3 +285,49 @@ def lm_loss(logits, tokens):
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
+
+
+def lm_loss_from_hidden(hidden, head_kernel, tokens, chunk: int = 1024):
+    """Chunked next-token cross-entropy from pre-head hidden states.
+
+    Identical math to ``lm_loss(model(tokens), tokens)`` but the
+    [B, S, vocab] fp32 logits are never materialized: the head matmul
+    + log-softmax run per sequence chunk inside a rematerialized scan,
+    so peak logits memory is B × chunk × vocab in both forward and
+    backward (the backward recomputes each chunk's logits). At vocab
+    32k, seq 4096, batch 8 this turns 2 × 3.9 GB of fp32 logits
+    buffers into 2 × ~1 GB at chunk=1024 (scaling linearly in chunk).
+
+    hidden: [B, S, D] as returned by ``model(tokens,
+    return_hidden=True)``; head_kernel: the lm_head kernel
+    ``params["lm_head"]["kernel"]`` [D, vocab] fp32.
+    """
+    targets = tokens[:, 1:]
+    hid = hidden[:, :-1]
+    b, s, d = hid.shape
+    chunk = min(chunk, s)
+    pad = (-s) % chunk
+    mask = jnp.ones((b, s), jnp.float32)
+    if pad:
+        hid = jnp.pad(hid, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    n = (s + pad) // chunk
+    hid = hid.reshape(b, n, chunk, d).transpose(1, 0, 2, 3)
+    targets = targets.reshape(b, n, chunk).transpose(1, 0, 2)
+    mask = mask.reshape(b, n, chunk).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def chunk_ll(h, t, m):
+        logits = h.astype(jnp.float32) @ head_kernel
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, t[..., None], axis=-1)[..., 0]
+        return jnp.sum(ll * m)
+
+    def body(carry, xs):
+        h, t, m = xs
+        return carry + chunk_ll(h, t, m), None
+
+    total, _ = jax.lax.scan(body, jnp.float32(0.0),
+                            (hid, targets, mask))
+    return -total / (b * s)

@@ -24,7 +24,9 @@ from horovod_tpu.parallel.ulysses import (
 from horovod_tpu.parallel.pipeline import (
     make_pipeline_apply, pipeline_stages,
 )
-from horovod_tpu.parallel.trainer import Trainer, TrainerConfig
+from horovod_tpu.parallel.trainer import (
+    Trainer, TrainerConfig, make_chunked_lm_loss,
+)
 
 
 def __getattr__(name):
@@ -41,5 +43,5 @@ __all__ = [
     "ring_attention", "make_ring_attention",
     "ulysses_attention", "make_ulysses_attention",
     "pipeline_stages", "make_pipeline_apply", "PipelinedLM",
-    "Trainer", "TrainerConfig",
+    "Trainer", "TrainerConfig", "make_chunked_lm_loss",
 ]

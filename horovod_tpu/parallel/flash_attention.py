@@ -110,15 +110,18 @@ def _kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
         l = l_scr[:]
         denom = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
-        m_ref[0] = m_scr[:]
-        l_ref[0] = l
+        # Stats leave as [1, BQ] rows: the HBM stats tensors are
+        # [BH, 1, S] so the TPU (8,128) tiling pads the size-1 dim
+        # 8x instead of padding a trailing size-1 lane dim 128x.
+        m_ref[0] = jnp.transpose(m_scr[:])
+        l_ref[0] = jnp.transpose(l)
 
 @functools.partial(
     jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
 def _flash_bhsd(q, k, v, offsets, causal: bool, block_q: int,
                 block_k: int, interpret: bool):
     """q: [BH, Sq, D]; k, v: [BH, Sk, D]; offsets: int32[2].
-    Returns (o [BH,Sq,D], m [BH,Sq,1], l [BH,Sq,1])."""
+    Returns (o [BH,Sq,D], m [BH,1,Sq], l [BH,1,Sq])."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -141,8 +144,8 @@ def _flash_bhsd(q, k, v, offsets, causal: bool, block_q: int,
         ],
         out_specs=(
             pl.BlockSpec((1, block_q, d), lambda b, i, j, offs: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j, offs: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j, offs: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j, offs: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j, offs: (b, 0, i)),
         ),
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -155,8 +158,8 @@ def _flash_bhsd(q, k, v, offsets, causal: bool, block_q: int,
         grid_spec=grid_spec,
         out_shape=(
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
-            jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, seq_q), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, seq_q), jnp.float32),
         ),
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
@@ -178,8 +181,8 @@ def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]                                  # [BQ, 1]
-    delta = delta_ref[0]                              # [BQ, 1]
+    lse = jnp.transpose(lse_ref[0])                   # [1,BQ] -> [BQ,1]
+    delta = jnp.transpose(delta_ref[0])               # [1,BQ] -> [BQ,1]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale   # [BQ, BK]
@@ -273,7 +276,7 @@ def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 def _flash_bwd_bhsd(q, k, v, do, lse, delta, offsets, causal: bool,
                     block_q: int, block_k: int, interpret: bool):
     """Backward kernels. q, do: [BH,Sq,D]; k, v: [BH,Sk,D];
-    lse, delta: [BH,Sq,1] fp32. Returns (dq, dk, dv) in input dtypes."""
+    lse, delta: [BH,1,Sq] fp32. Returns (dq, dk, dv) in input dtypes."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -285,8 +288,8 @@ def _flash_bwd_bhsd(q, k, v, do, lse, delta, offsets, causal: bool,
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j, offs: (b, i, 0))
     k_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j, offs: (b, j, 0))
-    stat_spec = pl.BlockSpec((1, block_q, 1),
-                             lambda b, i, j, offs: (b, i, 0))
+    stat_spec = pl.BlockSpec((1, 1, block_q),
+                             lambda b, i, j, offs: (b, 0, i))
 
     dq = pl.pallas_call(
         functools.partial(
@@ -313,8 +316,8 @@ def _flash_bwd_bhsd(q, k, v, do, lse, delta, offsets, causal: bool,
     # dk/dv: swap grid so the kv block is outer and q streams.
     q_spec2 = pl.BlockSpec((1, block_q, d), lambda b, j, i, offs: (b, i, 0))
     k_spec2 = pl.BlockSpec((1, block_k, d), lambda b, j, i, offs: (b, j, 0))
-    stat_spec2 = pl.BlockSpec((1, block_q, 1),
-                              lambda b, j, i, offs: (b, i, 0))
+    stat_spec2 = pl.BlockSpec((1, 1, block_q),
+                              lambda b, j, i, offs: (b, 0, i))
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, block_q=block_q, block_k=block_k,
@@ -378,8 +381,8 @@ def _run(q, k, v, offsets, causal, block_q, block_k, interpret):
     o, m, l = _flash_bhsd(_to_bhsd(q), _to_bhsd(k), _to_bhsd(v), offsets,
                           causal, block_q, block_k, bool(interpret))
     o = _from_bhsd(o, b, h)
-    m = m[..., 0].reshape(b, h, seq_q)
-    l = l[..., 0].reshape(b, h, seq_q)
+    m = m[:, 0].reshape(b, h, seq_q)
+    l = l[:, 0].reshape(b, h, seq_q)
     return o, m, l
 
 
@@ -408,12 +411,14 @@ def flash_attention_stats(q, k, v, causal: bool = True,
 
 
 def _lse_from_stats(m, l):
-    """[B,H,S] stats -> [BH,S,1] fp32 lse; +inf marks dead rows so the
-    backward's exp(s - lse) underflows to exactly 0 for them."""
+    """[B,H,S] stats -> [BH,1,S] fp32 lse; +inf marks dead rows so the
+    backward's exp(s - lse) underflows to exactly 0 for them. The
+    size-1 middle dim keeps S on the 128-lane axis — a trailing size-1
+    dim would tile-pad the tensor 128x in HBM."""
     b, h, s = m.shape
     lse = jnp.where(l > 0.0, m + jnp.log(jnp.where(l > 0.0, l, 1.0)),
                     jnp.inf)
-    return lse.reshape(b * h, s, 1)
+    return lse.reshape(b * h, 1, s)
 
 
 def flash_attention_bwd(q, k, v, o, m, l, do, causal: bool = True,
@@ -442,7 +447,7 @@ def flash_attention_bwd(q, k, v, o, m, l, do, causal: bool = True,
     qb, kb, vb, dob, ob = (_to_bhsd(x) for x in (q, k, v, do, o))
     lse = _lse_from_stats(m, l)
     delta = jnp.sum(dob.astype(jnp.float32) * ob.astype(jnp.float32),
-                    axis=-1, keepdims=True)
+                    axis=-1)[:, None, :]   # [BH,1,S], see _lse_from_stats
     dq, dk, dv = _flash_bwd_bhsd(qb, kb, vb, dob, lse, delta, offsets,
                                  bool(causal), block_q, block_k,
                                  bool(interpret))

@@ -171,7 +171,7 @@ def _ring_flash_bwd(causal, axis, block, residuals, g):
     kb, vb = _to_bhsd(k), _to_bhsd(v)
     lse = _lse_from_stats(m, l)
     delta = jnp.sum(gb.astype(jnp.float32) * ob.astype(jnp.float32),
-                    axis=-1, keepdims=True)
+                    axis=-1)[:, None, :]   # [BH,1,S], see _lse_from_stats
 
     dq0 = jnp.zeros(qb.shape, jnp.float32)
     dk0 = jnp.zeros(kb.shape, jnp.float32)

@@ -179,15 +179,48 @@ def _opt_state_shardings(tx, params, param_shardings, mesh):
 _MOE_AUX_WEIGHT = 0.01  # Switch Transformer's alpha
 
 
-def _default_lm_loss(apply_fn, params, batch):
-    """Next-token LM loss + the Switch load-balancing auxiliary for MoE
-    configs (sowed by MoEMLP; zero for dense models). Without the aux
-    term a top-1 router collapses onto one expert and the fixed
+def _lm_loss_with_moe_aux(apply_fn, params, batch, task_loss,
+                          **apply_kwargs):
+    """Shared LM-loss scaffolding: extract tokens, apply with sowed
+    intermediates, add the Switch load-balancing auxiliary (zero for
+    dense models). ``task_loss(output, tokens)`` computes the
+    next-token loss from whatever ``apply_fn`` returned. Without the
+    aux term a top-1 router collapses onto one expert and the fixed
     capacity silently drops the overflow tokens."""
-    from horovod_tpu.models.transformer import lm_loss, moe_aux_loss
+    from horovod_tpu.models.transformer import moe_aux_loss
     tokens = batch["tokens"] if isinstance(batch, dict) else batch
-    logits, mutated = apply_fn(params, tokens,
-                               mutable=["intermediates"])
-    loss = lm_loss(logits, tokens)
+    output, mutated = apply_fn(params, tokens,
+                               mutable=["intermediates"],
+                               **apply_kwargs)
+    loss = task_loss(output, tokens)
     aux = moe_aux_loss(mutated.get("intermediates", {}))
     return loss + _MOE_AUX_WEIGHT * aux
+
+
+def make_chunked_lm_loss(chunk: int = 1024):
+    """Trainer ``loss_fn`` for big-vocab / long-context TransformerLM:
+    next-token loss via :func:`models.transformer.lm_loss_from_hidden`,
+    so the full [B, S, vocab] fp32 logits never exist in HBM. Same
+    MoE-aux handling as the default loss.
+
+    ``Trainer(model, mesh, tx, loss_fn=make_chunked_lm_loss(1024))``.
+    """
+    from horovod_tpu.models.transformer import lm_loss_from_hidden
+
+    def loss_fn(apply_fn, params, batch):
+        def task_loss(hidden, tokens):
+            head_kernel = params["params"]["lm_head"]["kernel"]
+            return lm_loss_from_hidden(hidden, head_kernel, tokens,
+                                       chunk=chunk)
+        return _lm_loss_with_moe_aux(apply_fn, params, batch,
+                                     task_loss, return_hidden=True)
+
+    return loss_fn
+
+
+def _default_lm_loss(apply_fn, params, batch):
+    """Next-token LM loss from full logits (see _lm_loss_with_moe_aux
+    for the shared MoE-aux scaffolding)."""
+    from horovod_tpu.models.transformer import lm_loss
+    return _lm_loss_with_moe_aux(apply_fn, params, batch, lm_loss)
+
