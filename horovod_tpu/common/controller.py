@@ -20,7 +20,6 @@ from __future__ import annotations
 import json
 import os
 import socket
-import threading
 import time
 from typing import Dict, List, Optional
 

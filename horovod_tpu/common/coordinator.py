@@ -17,7 +17,7 @@ provides by construction.
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 from horovod_tpu.common import logging as hlog
 from horovod_tpu.common.message import (

@@ -16,7 +16,6 @@ Enabled with ``HOROVOD_AUTOTUNE=1``; progress optionally logged to
 from __future__ import annotations
 
 import time
-from typing import Optional
 
 import numpy as np
 

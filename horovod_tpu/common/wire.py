@@ -33,7 +33,6 @@ Layout (all little-endian):
 from __future__ import annotations
 
 import struct
-from typing import Tuple
 
 from horovod_tpu.common.message import (
     DataType, Request, RequestList, RequestType, Response, ResponseList,

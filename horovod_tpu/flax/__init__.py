@@ -18,7 +18,6 @@ from horovod_tpu.common.basics import (  # noqa: F401
 )
 from horovod_tpu.common.compression import Compression  # noqa: F401
 from horovod_tpu import ops as _ops
-from horovod_tpu import spmd as _spmd
 from horovod_tpu.ops import Average, Sum  # noqa: F401
 
 

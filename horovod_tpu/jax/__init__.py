@@ -23,7 +23,7 @@ Two gradient-sync paths, chosen by where your step runs:
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any
 
 import numpy as np
 

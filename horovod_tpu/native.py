@@ -137,7 +137,6 @@ def sum_into(acc, src) -> bool:
     lib = get()
     if lib is None:
         return False
-    import numpy as np
     code = _DTYPE_CODES.get(str(acc.dtype))
     if code is None or not acc.flags["C_CONTIGUOUS"] \
             or not src.flags["C_CONTIGUOUS"]:

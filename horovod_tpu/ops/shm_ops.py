@@ -44,7 +44,7 @@ from __future__ import annotations
 import json
 import mmap
 import os
-from typing import List, Optional, Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 

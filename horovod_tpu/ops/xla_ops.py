@@ -27,7 +27,7 @@ SPMD path (horovod_tpu/spmd) or the local backend instead.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Tuple
+from typing import Dict, Tuple
 
 import numpy as np
 

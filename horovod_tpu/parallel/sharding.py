@@ -12,7 +12,7 @@ partitioning rules — the public-domain idiom for this.
 from __future__ import annotations
 
 import re
-from typing import List, Sequence, Tuple
+from typing import Sequence, Tuple
 
 import jax
 import numpy as np

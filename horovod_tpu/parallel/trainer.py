@@ -16,7 +16,7 @@ Horovod's whole background runtime exists to perform.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp

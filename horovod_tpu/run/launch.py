@@ -13,7 +13,6 @@ import json
 import os
 import secrets as _secrets
 import shlex
-import signal
 import socket
 import subprocess
 import sys
@@ -21,7 +20,6 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from horovod_tpu.common import logging as hlog
 from horovod_tpu.run.services import DriverService, local_addresses
 
 

@@ -13,7 +13,7 @@ keep the reference's exact contract.
 
 from __future__ import annotations
 
-from typing import Iterable, Optional, Tuple
+from typing import Optional
 
 import numpy as np
 
