@@ -64,10 +64,7 @@ def allreduce(tensor, op: int = Average, name: Optional[str] = None,
             np.asarray(compression.decompress(np.asarray(out), ctx),
                        dtype=host.dtype), host)
 
-    if (tf.executing_eagerly()
-            and (tf.is_tensor(tensor)
-                 or isinstance(tensor, tf.Variable))
-            and tensor.dtype.is_floating):
+    if _differentiable(tensor):
         # Variables differentiate like tensors; convert so the
         # custom_gradient sees one input kind.
         tensor = tf.convert_to_tensor(tensor)
@@ -91,15 +88,82 @@ def allreduce(tensor, op: int = Average, name: Optional[str] = None,
     return _host_allreduce(tensor, resolved)
 
 
-def allgather(tensor, name: Optional[str] = None):
-    out = _ops.allgather(_to_numpy(tensor), name=name)
+def _differentiable(tensor):
     import tensorflow as tf
-    return tf.constant(np.ascontiguousarray(out))
+    return (tf.executing_eagerly()
+            and (tf.is_tensor(tensor) or isinstance(tensor, tf.Variable))
+            and tensor.dtype.is_floating)
+
+
+def allgather(tensor, name: Optional[str] = None):
+    import tensorflow as tf
+    resolved = name if name is not None else _ops._auto_name("allgather")
+
+    def _host(t):
+        return tf.constant(np.ascontiguousarray(
+            _ops.allgather(_to_numpy(t), name=resolved)))
+
+    if _differentiable(tensor):
+        tensor = tf.convert_to_tensor(tensor)
+
+        # Reference gradient of HorovodAllgather
+        # (tensorflow/mpi_ops.py:127-148): allreduce-SUM the upstream
+        # gradient, then keep this rank's dim-0 slice — located via an
+        # allgather of the per-rank dim-0 sizes (variable allgather).
+        @tf.custom_gradient
+        def _op(x):
+            y = _host(x)
+            d0 = int(x.shape[0]) if x.shape.rank else 1
+
+            def grad(dy):
+                sizes = np.asarray(_ops.allgather(
+                    np.asarray([d0], np.int64),
+                    name=f"{resolved}.grad.sizes"))
+                summed = np.asarray(_ops.allreduce(
+                    _to_numpy(dy), op=Sum, name=f"{resolved}.grad"))
+                off = int(sizes[:rank()].sum())
+                piece = summed[off:off + d0]
+                if not x.shape.rank:
+                    piece = piece.reshape(())
+                return _to_tf(piece.astype(x.dtype.as_numpy_dtype), x)
+
+            return y, grad
+
+        return _op(tensor)
+    return _host(tensor)
 
 
 def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
-    out = _ops.broadcast(_to_numpy(tensor), root_rank=root_rank, name=name)
-    return _to_tf(np.asarray(out), tensor)
+    import tensorflow as tf
+    resolved = name if name is not None else _ops._auto_name("broadcast")
+
+    def _host(t):
+        return _to_tf(np.asarray(_ops.broadcast(
+            _to_numpy(t), root_rank=root_rank, name=resolved)), _to_numpy(t))
+
+    if _differentiable(tensor):
+        tensor = tf.convert_to_tensor(tensor)
+
+        # Reference gradient of HorovodBroadcast
+        # (tensorflow/mpi_ops.py:168-181): allreduce-SUM of the
+        # upstream gradient on the root; zeros elsewhere (non-root
+        # inputs do not influence the output).
+        @tf.custom_gradient
+        def _op(x):
+            y = _host(x)
+
+            def grad(dy):
+                summed = allreduce(dy, op=Sum, name=f"{resolved}.grad")
+                if rank() != root_rank:
+                    # zeros_like, not summed*0: a non-finite upstream
+                    # (loss-scaling inf) would otherwise become NaN here
+                    return tf.zeros_like(summed)
+                return summed
+
+            return y, grad
+
+        return _op(tensor)
+    return _host(tensor)
 
 
 def alltoall(tensor, name: Optional[str] = None):

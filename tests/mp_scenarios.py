@@ -1091,6 +1091,51 @@ def scenario_tf_allreduce_grad(hvd_mod, rank, size):
     assert np.allclose(np.asarray(s), 3.0 * sum(range(1, size + 1)))
 
 
+def scenario_tf_gather_bcast_grad(hvd_mod, rank, size):
+    """Gradients flow through TF allgather (variable dim-0!) and
+    broadcast (reference: the registered HorovodAllgather /
+    HorovodBroadcast gradients, tensorflow/mpi_ops.py:127-181):
+    allgather's grad is this rank's slice of the sum-allreduced
+    upstream; broadcast's grad is the summed upstream on the root and
+    zeros elsewhere."""
+    import tensorflow as tf
+    import horovod_tpu.tensorflow as hvd
+
+    # -- allgather: rank r contributes r+1 rows of 2 ---------------------
+    d0 = rank + 1
+    x = tf.constant(np.full((d0, 2), float(rank + 1), np.float32))
+    # per-GLOBAL-row weights, identical on every rank
+    total_rows = sum(r + 1 for r in range(size))
+    w = tf.constant(np.arange(total_rows,
+                              dtype=np.float32)[:, None] + 1.0)
+    with tf.GradientTape() as tape:
+        tape.watch(x)
+        y = hvd.allgather(x, name="tg.ag")
+        loss = tf.reduce_sum(y * w)
+    assert y.shape == (total_rows, 2)
+    g = tape.gradient(loss, x)
+    # upstream dL/dy = w on every rank; sum over ranks = size * w;
+    # our slice starts at sum of earlier ranks' sizes
+    off = sum(r + 1 for r in range(rank))
+    want = size * (np.arange(total_rows, dtype=np.float32)[:, None]
+                   + 1.0)[off:off + d0]
+    assert np.allclose(g.numpy(), want), (g.numpy(), want)
+
+    # -- broadcast: non-root inputs get zero gradient --------------------
+    root = size - 1
+    v = tf.Variable(np.full(3, float(rank + 10), np.float32))
+    with tf.GradientTape() as tape:
+        y = hvd.broadcast(v, root_rank=root, name="tg.bc")
+        loss = tf.reduce_sum(y * float(rank + 1))
+    assert np.allclose(y.numpy(), float(root + 10))
+    g = tape.gradient(loss, v)
+    ssum = sum(range(1, size + 1))
+    if rank == root:
+        assert np.allclose(g.numpy(), float(ssum)), g.numpy()
+    else:
+        assert np.allclose(g.numpy(), 0.0), g.numpy()
+
+
 def scenario_scalar_broadcast(hvd_mod, rank, size):
     """0-d tensors must round-trip broadcast with shape intact
     (regression: ascontiguousarray promotes 0-d to (1,))."""

@@ -233,6 +233,12 @@ def test_tf_allreduce_grad():
     run_scenario("tf_allreduce_grad", 2, timeout=180.0)
 
 
+def test_tf_gather_bcast_grad():
+    """Differentiable allgather (variable dim-0) and broadcast
+    (root-only gradient), 3 ranks."""
+    run_scenario("tf_gather_bcast_grad", 3, timeout=180.0)
+
+
 def test_tfkeras_facade():
     run_scenario("tfkeras_facade", 2, timeout=240.0)
 
