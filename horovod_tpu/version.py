@@ -1,3 +1,3 @@
 """Version of horovod_tpu (reference: horovod/__init__.py:1)."""
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
