@@ -384,9 +384,10 @@ def main() -> None:
             "published scaling efficiencies (one GPU per rank). The "
             "host additionally burst-throttles sustained CPU/memory "
             "load after ~1-2 s, which hits the 16 MiB shm/star legs "
-            "specifically (isolated shm 16 MiB medians are ~130 ms vs "
-            "the in-sweep ~650 ms; the ring's lower CPU intensity "
-            "keeps its 16 MiB row stable at ~230 ms across runs)."),
+            "specifically, so those rows vary several-fold between runs "
+            "(e.g. shm 16 MiB medians of ~160-650 ms across "
+            "sweeps); the ring's lower CPU intensity makes its "
+            "16 MiB row the most stable, ~230-290 ms across runs."),
     }
     path = os.path.join(REPO, "benchmarks", "RESULTS_cpu.json")
     with open(path, "w") as fh:

@@ -313,6 +313,100 @@ def scenario_mixed_op_storm(hvd, rank, size):
             offset += r + 1
 
 
+def scenario_coordinator_fuzz(hvd, rank, size):
+    """Randomized negotiation fuzz — the framework's race-detection
+    analog (SURVEY §5: the coordinator protocol is what turns racy
+    per-rank op ordering into a total order). A seeded job list of a
+    few hundred mixed collectives (all 5 data ops × 4 dtypes × varied
+    shapes, interleaved barriers) is submitted asynchronously in a
+    DIFFERENT random order on every rank, in waves with partial drains
+    so negotiation, fusion, and execution overlap; every handle's value
+    is checked exactly."""
+    jobs_rng = np.random.RandomState(4242)        # SAME on all ranks
+    order_rng = np.random.RandomState(977 + rank)  # per-rank order
+    ssum = sum(range(1, size + 1))
+    dtypes = [np.float32, np.float64, np.int32, np.int64]
+
+    jobs = []
+    for i in range(240):
+        kind = ["ar", "bc", "ag", "rs", "a2a"][jobs_rng.randint(5)]
+        dt = dtypes[jobs_rng.randint(len(dtypes))]
+        n = int(jobs_rng.randint(1, 90))
+        root = int(jobs_rng.randint(size))
+        jobs.append((i, kind, dt, n, root))
+
+    def submit(job):
+        i, kind, dt, n, root = job
+        tag = f"fz.{i}"
+        if kind == "ar":
+            return hvd.allreduce_async(
+                np.full(n, dt(rank + 1) * (i % 7 + 1), dt),
+                average=False, name=tag)
+        if kind == "bc":
+            return hvd.broadcast_async(
+                np.full(n, dt(rank * 100 + i), dt), root_rank=root,
+                name=tag)
+        if kind == "ag":
+            return hvd.allgather_async(
+                np.full((rank + 1, n), dt(rank * 10 + i), dt), name=tag)
+        if kind == "rs":
+            return hvd.reducescatter_async(
+                (np.arange(size * n) + rank).astype(dt), name=tag)
+        return hvd.alltoall_async(
+            np.full((size * 2, n), dt(rank + i), dt), name=tag)
+
+    def check(job, out):
+        i, kind, dt, n, root = job
+        out = np.asarray(out)
+        if kind == "ar":
+            np.testing.assert_allclose(
+                out.astype(np.float64),
+                np.full(n, float(ssum * (i % 7 + 1))))
+        elif kind == "bc":
+            np.testing.assert_allclose(
+                out.astype(np.float64), float(root * 100 + i))
+        elif kind == "ag":
+            assert out.shape == (sum(r + 1 for r in range(size)), n)
+            off = 0
+            for r in range(size):
+                np.testing.assert_allclose(
+                    out[off:off + r + 1].astype(np.float64),
+                    float(r * 10 + i))
+                off += r + 1
+        elif kind == "rs":
+            base = size * np.arange(size * n) + sum(range(size))
+            np.testing.assert_allclose(
+                out.astype(np.float64),
+                base[rank * n:(rank + 1) * n].astype(np.float64))
+        else:
+            assert out.shape == (size * 2, n)
+            for r in range(size):
+                np.testing.assert_allclose(
+                    out[r * 2:(r + 1) * 2].astype(np.float64),
+                    float(r + i))
+
+    # waves with partial drains: in-flight ops from wave k overlap
+    # wave k+1's negotiation
+    pending = []
+    for start in range(0, len(jobs), 60):
+        wave = [jobs[j] for j in
+                start + order_rng.permutation(
+                    min(60, len(jobs) - start))]
+        pending.extend((job, submit(job)) for job in wave)
+        # Barrier decisions come from the SHARED rng: a collective only
+        # some ranks submit would deadlock the world (which is exactly
+        # what the stall inspector exists to report, but not what this
+        # scenario tests).
+        if jobs_rng.rand() < 0.5:
+            hvd.barrier(name=f"fz.bar.{start}")
+        drain, pending = pending[:len(pending) // 2], \
+            pending[len(pending) // 2:]
+        for job, h in drain:
+            check(job, hvd.synchronize(h))
+    for job, h in pending:
+        check(job, hvd.synchronize(h))
+
+
 def scenario_kitchen_sink(hvd, rank, size):
     """Every auxiliary subsystem enabled at once — autotune (+log),
     timeline (+cycle marks), hierarchical shm over a fake 2-host

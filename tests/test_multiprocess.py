@@ -255,6 +255,14 @@ def test_mixed_op_storm(plane):
     run_scenario("mixed_op_storm", 3, timeout=120.0, extra_env=extra)
 
 
+@pytest.mark.parametrize("plane", ["shm", "socket"])
+def test_coordinator_fuzz(plane):
+    """240 seeded mixed collectives, per-rank-random submission order,
+    overlapping waves, on both host planes — every value exact."""
+    extra = {} if plane == "shm" else {"HOROVOD_TPU_SHM": "0"}
+    run_scenario("coordinator_fuzz", 3, timeout=300.0, extra_env=extra)
+
+
 def test_kitchen_sink_all_subsystems(tmp_path):
     """Cross-subsystem integration: autotune (+log), timeline (+cycle
     marks), hierarchical shm over a fake 2-host topology, and the stall
