@@ -2,6 +2,8 @@
 
 from horovod_tpu.utils.checkpoint import (
     save_checkpoint, restore_checkpoint, latest_checkpoint,
+    wait_pending_saves,
 )
 
-__all__ = ["save_checkpoint", "restore_checkpoint", "latest_checkpoint"]
+__all__ = ["save_checkpoint", "restore_checkpoint",
+           "latest_checkpoint", "wait_pending_saves"]

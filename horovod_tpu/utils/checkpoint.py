@@ -53,15 +53,55 @@ def _load_tree(path: str, target: Optional[Any]) -> Any:
     return serialization.from_bytes(target, data)
 
 
-def save_checkpoint(directory: str, state: Any, step: int,
-                    keep: int = 3) -> Optional[str]:
-    """Rank 0 writes ``state`` under ``directory/step_<step>``; other
-    ranks no-op (reference pattern: checkpoint only on rank 0 —
-    examples/keras_imagenet_resnet50.py callbacks gating). Returns the
-    checkpoint path on rank 0, None elsewhere. Prunes to the newest
-    ``keep`` checkpoints."""
-    if basics.rank() != 0:
-        return None
+# One background writer so async saves stay ordered (a newer save can
+# never be overtaken by an older one still in flight).
+_writer = None
+_pending = []
+
+
+def _writer_pool():
+    global _writer
+    if _writer is None:
+        import atexit
+        from concurrent.futures import ThreadPoolExecutor
+        _writer = ThreadPoolExecutor(max_workers=1,
+                                     thread_name_prefix="hvd-ckpt")
+        # Fire-and-forget saves must not fail silently: surface any
+        # write error at interpreter exit even if the caller never
+        # drained explicitly.
+        atexit.register(_drain_at_exit)
+    return _writer
+
+
+def _drain_at_exit() -> None:
+    try:
+        wait_pending_saves()
+    except Exception as e:
+        hlog.error(f"async checkpoint save failed: {e!r}")
+
+
+def wait_pending_saves() -> None:
+    """Block until every async save issued by this process has hit
+    storage. Called automatically by restore_checkpoint and at a
+    blocking save; call explicitly before exiting rank 0. Every
+    pending save is awaited even when an earlier one failed (nothing
+    is left racing in the background); the first error re-raises
+    after the drain."""
+    global _pending
+    pending, _pending = _pending, []
+    first_error = None
+    for f in pending:
+        try:
+            f.result()
+        except Exception as e:
+            if first_error is None:
+                first_error = e
+    if first_error is not None:
+        raise first_error
+
+
+def _save_impl(directory: str, state: Any, step: int,
+               keep: int) -> str:
     path = os.path.join(directory, f"step_{step}")
     _save_tree(path, state)
     steps = sorted(
@@ -79,6 +119,68 @@ def save_checkpoint(directory: str, state: Any, step: int,
         except OSError as e:
             hlog.warning(f"could not prune checkpoint {old_path}: {e}")
     return path
+
+
+def save_checkpoint(directory: str, state: Any, step: int,
+                    keep: int = 3, block: bool = True):
+    """Rank 0 writes ``state`` under ``directory/step_<step>``; other
+    ranks no-op (reference pattern: checkpoint only on rank 0 —
+    examples/keras_imagenet_resnet50.py callbacks gating). Prunes to
+    the newest ``keep`` checkpoints.
+
+    ``block=True`` (default) returns the checkpoint path on rank 0.
+    ``block=False`` snapshots the tree to host memory immediately —
+    so donated/updated device buffers can't corrupt the save — and
+    writes on a background thread, returning a
+    ``concurrent.futures.Future`` resolving to the path; training
+    continues while storage I/O happens (no reference analog — the
+    reference blocks on framework savers). Saves are ordered;
+    :func:`wait_pending_saves` or the next blocking call drains them.
+    """
+    if basics.rank() != 0:
+        return None
+    if not block:
+        fut = _writer_pool().submit(_save_impl, directory,
+                                    _snapshot(state), step, keep)
+        _pending.append(fut)
+        return fut
+    wait_pending_saves()
+    return _save_impl(directory, state, step, keep)
+
+
+def _snapshot(tree):
+    """Deep host-numpy copy of the ARRAY leaves of a pytree (jax when
+    available, plain container recursion otherwise): the caller may
+    mutate or donate the originals the moment
+    save_checkpoint(block=False) returns. Non-array leaves (python
+    ints, strings, None) pass through untouched so async checkpoints
+    serialize with the same leaf types as blocking ones."""
+    import numpy as np
+
+    def leaf(a):
+        # ndarray / jax array / np scalar expose __array__; python
+        # scalars, str, None do not and must keep their type.
+        if hasattr(a, "__array__"):
+            return np.array(a, copy=True)
+        return a
+
+    try:
+        import jax
+        return jax.tree_util.tree_map(leaf, tree)
+    except ImportError:
+        pass
+
+    def rec(t):
+        if isinstance(t, dict):
+            return {k: rec(v) for k, v in t.items()}
+        if isinstance(t, (list, tuple)):
+            vals = [rec(v) for v in t]
+            if hasattr(t, "_fields"):  # namedtuple
+                return type(t)(*vals)
+            return type(t)(vals)
+        return leaf(t)
+
+    return rec(tree)
 
 
 def latest_checkpoint(directory: str) -> Optional[str]:
@@ -101,6 +203,12 @@ def restore_checkpoint(directory_or_path: str,
     (reference: BroadcastGlobalVariablesHook,
     horovod/tensorflow/__init__.py:117-148) — so shared filesystems
     aren't required on workers."""
+    # Never read around an in-flight save. If a drained save FAILED,
+    # this raises on rank 0 before the broadcast; under the launcher
+    # the nonzero exit tears down the waiting workers (run/launch.py
+    # first-failure teardown) rather than leaving them blocked.
+    if basics.rank() == 0:
+        wait_pending_saves()
     path = directory_or_path
     if os.path.isdir(path) and latest_checkpoint(path) and \
             not _STEP_RE.match(os.path.basename(path)):
