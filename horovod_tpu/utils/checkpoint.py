@@ -74,30 +74,26 @@ def _writer_pool():
 
 
 def _drain_at_exit() -> None:
-    try:
-        wait_pending_saves()
-    except Exception as e:
-        hlog.error(f"async checkpoint save failed: {e!r}")
+    wait_pending_saves()
 
 
 def wait_pending_saves() -> None:
-    """Block until every async save issued by this process has hit
-    storage. Called automatically by restore_checkpoint and at a
-    blocking save; call explicitly before exiting rank 0. Every
-    pending save is awaited even when an earlier one failed (nothing
-    is left racing in the background); the first error re-raises
-    after the drain."""
+    """Block until every async save issued by this process has
+    finished (successfully or not), so nothing races a subsequent
+    read, prune, or write. Failures are LOGGED here, not raised: the
+    Future returned by ``save_checkpoint(block=False)`` is the error
+    channel (``fut.result()`` re-raises), and raising a stale,
+    possibly already-handled error from an unrelated later save or
+    restore would block THAT operation for no reason. Called
+    automatically by restore_checkpoint, blocking saves, and at
+    interpreter exit."""
     global _pending
     pending, _pending = _pending, []
-    first_error = None
     for f in pending:
         try:
             f.result()
         except Exception as e:
-            if first_error is None:
-                first_error = e
-    if first_error is not None:
-        raise first_error
+            hlog.error(f"async checkpoint save failed: {e!r}")
 
 
 def _save_impl(directory: str, state: Any, step: int,
@@ -149,12 +145,14 @@ def save_checkpoint(directory: str, state: Any, step: int,
 
 
 def _snapshot(tree):
-    """Deep host-numpy copy of the ARRAY leaves of a pytree (jax when
-    available, plain container recursion otherwise): the caller may
-    mutate or donate the originals the moment
+    """Deep host-numpy copy of the ARRAY leaves of a pytree: the
+    caller may mutate or donate the originals the moment
     save_checkpoint(block=False) returns. Non-array leaves (python
     ints, strings, None) pass through untouched so async checkpoints
-    serialize with the same leaf types as blocking ones."""
+    serialize with the same leaf types as blocking ones. (jax is a
+    hard dependency of both storage backends, so no jax-less fallback
+    is needed here.)"""
+    import jax
     import numpy as np
 
     def leaf(a):
@@ -164,23 +162,7 @@ def _snapshot(tree):
             return np.array(a, copy=True)
         return a
 
-    try:
-        import jax
-        return jax.tree_util.tree_map(leaf, tree)
-    except ImportError:
-        pass
-
-    def rec(t):
-        if isinstance(t, dict):
-            return {k: rec(v) for k, v in t.items()}
-        if isinstance(t, (list, tuple)):
-            vals = [rec(v) for v in t]
-            if hasattr(t, "_fields"):  # namedtuple
-                return type(t)(*vals)
-            return type(t)(vals)
-        return leaf(t)
-
-    return rec(tree)
+    return jax.tree_util.tree_map(leaf, tree)
 
 
 def latest_checkpoint(directory: str) -> Optional[str]:
@@ -203,10 +185,9 @@ def restore_checkpoint(directory_or_path: str,
     (reference: BroadcastGlobalVariablesHook,
     horovod/tensorflow/__init__.py:117-148) — so shared filesystems
     aren't required on workers."""
-    # Never read around an in-flight save. If a drained save FAILED,
-    # this raises on rank 0 before the broadcast; under the launcher
-    # the nonzero exit tears down the waiting workers (run/launch.py
-    # first-failure teardown) rather than leaving them blocked.
+    # Never read around an in-flight save (failed drained saves are
+    # logged; their step file is simply absent, so the newest COMPLETE
+    # checkpoint is what restores).
     if basics.rank() == 0:
         wait_pending_saves()
     path = directory_or_path

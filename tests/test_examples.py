@@ -87,6 +87,13 @@ def test_zero_fsdp():
     assert "ZeRO-1" in out and "FSDP" in out
 
 
+def test_tensorflow_word2vec():
+    out = _run("tensorflow_word2vec.py", "--steps", "60")
+    assert "IndexedSlices" in out
+    first, last = out.split("loss ")[1].split(" over ")[0].split(" -> ")
+    assert float(last) < float(first)  # it actually learns
+
+
 @pytest.mark.parametrize("script", sorted(
     f for f in os.listdir(EX) if f.endswith(".py")))
 def test_every_example_is_covered(script):
@@ -95,6 +102,6 @@ def test_every_example_is_covered(script):
         "jax_mnist.py", "torch_mnist.py", "tensorflow_mnist.py",
         "keras_mnist.py", "jax_synthetic_benchmark.py",
         "transformer_long_context.py", "moe_pipeline_parallel.py",
-        "zero_fsdp.py",
+        "zero_fsdp.py", "tensorflow_word2vec.py",
     }
     assert script in covered, f"add a smoke test for examples/{script}"
