@@ -12,8 +12,10 @@ MXU-shaped.
 from horovod_tpu.models.resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101
 from horovod_tpu.models.transformer import TransformerConfig, TransformerLM
 from horovod_tpu.models.mnist import MnistConvNet
+from horovod_tpu.models.vit import ViT, ViTConfig, ViT_S16, ViT_B16
 
 __all__ = [
     "ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101",
     "TransformerConfig", "TransformerLM", "MnistConvNet",
+    "ViT", "ViTConfig", "ViT_S16", "ViT_B16",
 ]

@@ -1,0 +1,89 @@
+"""Vision Transformer — second vision family beside ResNet.
+
+No reference analog (the reference ships no models; its vision story is
+the ResNet/Inception benchmarks, docs/benchmarks.md). Included because
+a TPU-native framework's model zoo should cover the two standard
+vision shapes: convolutional (models/resnet.py) and patch-transformer.
+
+TPU notes: bf16 compute with fp32 LayerNorm/softmax-sensitive parts,
+patchify as a single strided conv (one big MXU matmul), learned
+positional embeddings, mean-pool head (no CLS token — simpler and
+equally standard). Works with data parallelism, `fsdp_sharding` (its
+generic largest-free-dim rule needs no ViT-specific rules), and
+`spmd.zero_optimizer` out of the box.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    embed_dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+
+
+class _EncoderBlock(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        ln = lambda name: nn.LayerNorm(dtype=cfg.dtype, name=name,
+                                       param_dtype=jnp.float32)
+        y = ln("ln1")(x)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=cfg.num_heads, dtype=cfg.dtype,
+            name="attn")(y, y)
+        x = x + y
+        y = ln("ln2")(x)
+        y = nn.Dense(cfg.mlp_ratio * cfg.embed_dim, dtype=cfg.dtype,
+                     name="up")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(cfg.embed_dim, dtype=cfg.dtype, name="down")(y)
+        return x + y
+
+
+class ViT(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images, train: bool = True):
+        """images: [B, H, W, 3] → logits [B, num_classes] fp32."""
+        cfg = self.cfg
+        p = cfg.patch_size
+        x = nn.Conv(cfg.embed_dim, (p, p), strides=(p, p),
+                    padding="VALID", dtype=cfg.dtype,
+                    name="patch_embed")(images.astype(cfg.dtype))
+        b, h, w, d = x.shape
+        x = x.reshape(b, h * w, d)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, h * w, d), jnp.float32)
+        x = x + pos.astype(cfg.dtype)
+        for i in range(cfg.num_layers):
+            x = _EncoderBlock(cfg, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
+                         name="ln_f")(x)
+        x = jnp.mean(x, axis=1)
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                        name="head")(x.astype(jnp.float32))
+
+
+def ViT_S16(**kw):
+    return ViT(ViTConfig(embed_dim=384, num_layers=12, num_heads=6,
+                         **kw))
+
+
+def ViT_B16(**kw):
+    return ViT(ViTConfig(**kw))

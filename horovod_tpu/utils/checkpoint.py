@@ -25,18 +25,37 @@ _STEP_RE = re.compile(r"^step_(\d+)$")
 def _save_tree(path: str, tree: Any) -> None:
     # orbax rejects relative paths; the flax fallback doesn't care —
     # normalize so behavior doesn't depend on which backend is present.
+    # Writes are ATOMIC (tmp + rename): a failed or interrupted save
+    # must never leave a truncated step_<n> that latest_checkpoint
+    # would select over the last complete checkpoint. (_STEP_RE is
+    # anchored, so in-progress ``step_<n>.tmp*`` names are invisible
+    # to latest/prune.)
     path = os.path.abspath(path)
+    tmp = f"{path}.tmp{os.getpid()}"
+    import shutil
     try:
-        import orbax.checkpoint as ocp
-        ckptr = ocp.PyTreeCheckpointer()
-        ckptr.save(path, tree, force=True)
-        return
-    except ImportError:
-        pass
-    from flax import serialization
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    with open(path, "wb") as f:
-        f.write(serialization.to_bytes(tree))
+        try:
+            import orbax.checkpoint as ocp
+            ckptr = ocp.PyTreeCheckpointer()
+            ckptr.save(tmp, tree, force=True)
+        except ImportError:
+            from flax import serialization
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(tmp, "wb") as f:
+                f.write(serialization.to_bytes(tree))
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        os.replace(tmp, path) if os.path.isfile(tmp) \
+            else os.rename(tmp, path)
+    except BaseException:
+        try:
+            if os.path.isdir(tmp):
+                shutil.rmtree(tmp)
+            elif os.path.exists(tmp):
+                os.remove(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def _load_tree(path: str, target: Optional[Any]) -> Any:

@@ -97,3 +97,31 @@ def test_failed_async_save_drains_all_without_poisoning(tmp_path,
     p = save_checkpoint(d, {"w": np.full(1, 9.0, np.float32)}, step=3)
     assert p.endswith("step_3")
     assert latest_checkpoint(d).endswith("step_3")
+
+
+def test_failed_save_leaves_no_partial_step(tmp_path, hvd_world,
+                                            monkeypatch):
+    """Atomic writes: a save that dies mid-serialization must leave no
+    step_<n> entry, so restore falls back to the last COMPLETE one."""
+    from horovod_tpu.utils import checkpoint as ck
+
+    d = str(tmp_path / "cka")
+    save_checkpoint(d, {"w": np.full(2, 1.0, np.float32)}, step=1)
+
+    def boom(path, tree):
+        tmp = path + ".tmpX"
+        with open(tmp, "wb") as f:
+            f.write(b"partial")       # bytes hit disk...
+        raise OSError("disk full")    # ...then the save dies
+
+    monkeypatch.setattr(ck, "_save_tree", boom)
+    fut = save_checkpoint(d, {"w": np.full(2, 2.0, np.float32)},
+                          step=2, block=False)
+    wait_pending_saves()              # logged, not raised
+    assert fut.done()
+    monkeypatch.undo()
+
+    assert latest_checkpoint(d).endswith("step_1")  # no phantom step_2
+    r = restore_checkpoint(d, target={"w": np.zeros(2, np.float32)},
+                           broadcast=False)
+    np.testing.assert_allclose(np.asarray(r["w"]), 1.0)
