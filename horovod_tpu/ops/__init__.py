@@ -163,6 +163,23 @@ def allgather(tensor, name: Optional[str] = None) -> Any:
     return synchronize(allgather_async(tensor, name))
 
 
+def allgather_grad(grad, local_d0: int, name: str) -> np.ndarray:
+    """Backward of a named allgather, shared by the framework adapters
+    (reference gradient: HorovodAllgather, horovod/torch/mpi_ops.py:
+    236-254 and tensorflow/mpi_ops.py:127-148): sum-allreduce the
+    upstream gradient of the CONCATENATED output, then keep this
+    rank's dim-0 slice, located via an allgather of the per-rank
+    sizes (variable dim-0 supported). ``name`` must be the forward's
+    resolved op name — the derived grad-op names stay deterministic
+    across ranks regardless of backward execution order."""
+    sizes = np.asarray(allgather(np.asarray([local_d0], np.int64),
+                                 name=f"{name}.grad.sizes"))
+    summed = np.asarray(allreduce(np.asarray(grad), op=Sum,
+                                  name=f"{name}.grad"))
+    off = int(sizes[:basics.rank()].sum())
+    return summed[off:off + local_d0]
+
+
 # -- broadcast -----------------------------------------------------------
 def broadcast_async(tensor, root_rank: int,
                     name: Optional[str] = None) -> int:

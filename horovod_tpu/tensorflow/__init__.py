@@ -107,22 +107,16 @@ def allgather(tensor, name: Optional[str] = None):
         tensor = tf.convert_to_tensor(tensor)
 
         # Reference gradient of HorovodAllgather
-        # (tensorflow/mpi_ops.py:127-148): allreduce-SUM the upstream
-        # gradient, then keep this rank's dim-0 slice — located via an
-        # allgather of the per-rank dim-0 sizes (variable allgather).
+        # (tensorflow/mpi_ops.py:127-148), via the shared
+        # ops.allgather_grad: allreduce-SUM the upstream gradient,
+        # then keep this rank's dim-0 slice (variable allgather).
         @tf.custom_gradient
         def _op(x):
             y = _host(x)
             d0 = int(x.shape[0]) if x.shape.rank else 1
 
             def grad(dy):
-                sizes = np.asarray(_ops.allgather(
-                    np.asarray([d0], np.int64),
-                    name=f"{resolved}.grad.sizes"))
-                summed = np.asarray(_ops.allreduce(
-                    _to_numpy(dy), op=Sum, name=f"{resolved}.grad"))
-                off = int(sizes[:rank()].sum())
-                piece = summed[off:off + d0]
+                piece = _ops.allgather_grad(_to_numpy(dy), d0, resolved)
                 if not x.shape.rank:
                     piece = piece.reshape(())
                 return _to_tf(piece.astype(x.dtype.as_numpy_dtype), x)

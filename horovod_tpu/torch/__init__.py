@@ -13,6 +13,7 @@ keep the reference's exact contract.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import numpy as np
@@ -53,46 +54,42 @@ def _allreduce_impl(tensor, op, name, compression, prescale_factor,
     return _from_numpy(np.asarray(compression.decompress(out, ctx)), tensor)
 
 
-_GRAD_FN = []
-
-
+@functools.lru_cache(maxsize=None)
 def _allreduce_grad_fn():
-    """Lazily-built autograd Function (torch import stays optional):
-    the gradient of an allreduce is the allreduce of the gradient with
-    the same op semantics (reference: HorovodAllreduce,
+    """Lazily-built, memoized autograd Function (torch import stays
+    optional): the gradient of an allreduce is the allreduce of the
+    gradient with the same op semantics (reference: HorovodAllreduce,
     horovod/torch/mpi_ops.py:110-121)."""
-    if not _GRAD_FN:
-        import torch
+    import torch
 
-        class _AllreduceGrad(torch.autograd.Function):
-            @staticmethod
-            def forward(ctx, tensor, op, name, compression, pre, post):
-                # Resolve the auto-name HERE so backward can derive a
-                # deterministic grad-op name: backward-node execution
-                # order may differ across ranks, so the global noname
-                # counter must not be what pairs the gradient
-                # collectives.
-                if name is None:
-                    name = _ops._auto_name("allreduce")
-                ctx.op, ctx.pre, ctx.post = op, pre, post
-                ctx.compression = compression
-                ctx.name = name
-                return _allreduce_impl(tensor, op, name, compression,
-                                       pre, post)
+    class _AllreduceGrad(torch.autograd.Function):
+        @staticmethod
+        def forward(ctx, tensor, op, name, compression, pre, post):
+            # Resolve the auto-name HERE so backward can derive a
+            # deterministic grad-op name: backward-node execution
+            # order may differ across ranks, so the global noname
+            # counter must not be what pairs the gradient
+            # collectives.
+            if name is None:
+                name = _ops._auto_name("allreduce")
+            ctx.op, ctx.pre, ctx.post = op, pre, post
+            ctx.compression = compression
+            ctx.name = name
+            return _allreduce_impl(tensor, op, name, compression,
+                                   pre, post)
 
-            @staticmethod
-            def backward(ctx, grad):
-                # Recurse through the PUBLIC allreduce so double
-                # backward (create_graph=True) stays differentiable,
-                # like the reference's HorovodAllreduce recursion.
-                g = allreduce(grad, op=ctx.op, name=f"{ctx.name}.grad",
-                              compression=ctx.compression,
-                              prescale_factor=ctx.pre,
-                              postscale_factor=ctx.post)
-                return g, None, None, None, None, None
+        @staticmethod
+        def backward(ctx, grad):
+            # Recurse through the PUBLIC allreduce so double
+            # backward (create_graph=True) stays differentiable,
+            # like the reference's HorovodAllreduce recursion.
+            g = allreduce(grad, op=ctx.op, name=f"{ctx.name}.grad",
+                          compression=ctx.compression,
+                          prescale_factor=ctx.pre,
+                          postscale_factor=ctx.post)
+            return g, None, None, None, None, None
 
-        _GRAD_FN.append(_AllreduceGrad)
-    return _GRAD_FN[0]
+    return _AllreduceGrad
 
 
 def allreduce(tensor, op: int = Average, name: Optional[str] = None,
@@ -102,9 +99,7 @@ def allreduce(tensor, op: int = Average, name: Optional[str] = None,
     backward pass allreduces the upstream gradient with identical op
     semantics (reference: test_horovod_allreduce_grad,
     test_torch.py:377)."""
-    import torch
-    if torch.is_grad_enabled() and getattr(tensor, "requires_grad",
-                                           False):
+    if _wants_grad(tensor):
         return _allreduce_grad_fn().apply(
             tensor, op, name, compression, prescale_factor,
             postscale_factor)
@@ -113,10 +108,12 @@ def allreduce(tensor, op: int = Average, name: Optional[str] = None,
 
 
 def allreduce_(tensor, op: int = Average, name: Optional[str] = None):
-    """In-place variant (reference: horovod/torch/mpi_ops.py
-    allreduce_)."""
-    result = allreduce(tensor, op=op, name=name)
-    tensor.copy_(result)
+    """In-place, non-differentiable variant (reference:
+    horovod/torch/mpi_ops.py allreduce_). copy_ into a requires_grad
+    leaf must run outside autograd."""
+    import torch
+    with torch.no_grad():
+        tensor.copy_(allreduce(tensor, op=op, name=name))
     return tensor
 
 
@@ -125,7 +122,73 @@ def allreduce_async(tensor, op: int = Average,
     return _ops.allreduce_async(_to_numpy(tensor), op=op, name=name)
 
 
+@functools.lru_cache(maxsize=None)
+def _allgather_grad_fn():
+    """Autograd through allgather (reference: HorovodAllgather,
+    horovod/torch/mpi_ops.py:236-254): backward is the shared
+    ops.allgather_grad — sum-allreduce the upstream gradient, keep
+    this rank's dim-0 slice (variable dim-0 supported)."""
+    import torch
+
+    class _AllgatherGrad(torch.autograd.Function):
+        @staticmethod
+        def forward(ctx, tensor, name):
+            if name is None:
+                name = _ops._auto_name("allgather")
+            ctx.name = name
+            ctx.d0 = tensor.shape[0] if tensor.dim() else 1
+            ctx.in_dtype = tensor.dtype
+            out = _ops.allgather(_to_numpy(tensor), name=name)
+            return torch.from_numpy(
+                np.ascontiguousarray(out)).to(tensor.dtype)
+
+        @staticmethod
+        def backward(ctx, grad):
+            piece = _ops.allgather_grad(_to_numpy(grad), ctx.d0,
+                                        ctx.name)
+            return torch.from_numpy(np.ascontiguousarray(piece)).to(
+                ctx.in_dtype), None
+
+    return _AllgatherGrad
+
+
+@functools.lru_cache(maxsize=None)
+def _broadcast_grad_fn():
+    """Autograd through broadcast (reference: HorovodBroadcast,
+    horovod/torch/mpi_ops.py:318-334): backward sum-allreduces the
+    upstream gradient on the root, exact zeros elsewhere."""
+    import torch
+
+    class _BroadcastGrad(torch.autograd.Function):
+        @staticmethod
+        def forward(ctx, tensor, root_rank, name):
+            if name is None:
+                name = _ops._auto_name("broadcast")
+            ctx.name = name
+            ctx.root_rank = root_rank
+            out = _ops.broadcast(_to_numpy(tensor),
+                                 root_rank=root_rank, name=name)
+            return _from_numpy(np.asarray(out), tensor)
+
+        @staticmethod
+        def backward(ctx, grad):
+            g = allreduce(grad, op=Sum, name=f"{ctx.name}.grad")
+            if rank() != ctx.root_rank:
+                g = torch.zeros_like(g)
+            return g, None, None
+
+    return _BroadcastGrad
+
+
+def _wants_grad(tensor):
+    import torch
+    return torch.is_grad_enabled() and getattr(tensor, "requires_grad",
+                                               False)
+
+
 def allgather(tensor, name: Optional[str] = None):
+    if _wants_grad(tensor):
+        return _allgather_grad_fn().apply(tensor, name)
     out = _ops.allgather(_to_numpy(tensor), name=name)
     import torch
     return torch.from_numpy(np.ascontiguousarray(out)).to(tensor.dtype)
@@ -136,12 +199,20 @@ def allgather_async(tensor, name: Optional[str] = None) -> int:
 
 
 def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
+    if _wants_grad(tensor):
+        return _broadcast_grad_fn().apply(tensor, root_rank, name)
     out = _ops.broadcast(_to_numpy(tensor), root_rank=root_rank, name=name)
     return _from_numpy(np.asarray(out), tensor)
 
 
 def broadcast_(tensor, root_rank: int = 0, name: Optional[str] = None):
-    tensor.copy_(broadcast(tensor, root_rank=root_rank, name=name))
+    """In-place, non-differentiable (reference: broadcast_,
+    horovod/torch/mpi_ops.py:383 — the grad-tracked form is
+    ``broadcast``). Under no_grad, broadcast takes its plain path and
+    copy_ into a requires_grad leaf is legal."""
+    import torch
+    with torch.no_grad():
+        tensor.copy_(broadcast(tensor, root_rank=root_rank, name=name))
     return tensor
 
 

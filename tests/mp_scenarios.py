@@ -1185,6 +1185,47 @@ def scenario_tf_allreduce_grad(hvd_mod, rank, size):
     assert np.allclose(np.asarray(s), 3.0 * sum(range(1, size + 1)))
 
 
+def scenario_torch_gather_bcast_grad(hvd_mod, rank, size):
+    """Gradients flow through torch allgather (variable dim-0) and
+    broadcast (reference: HorovodAllgather / HorovodBroadcast autograd
+    Functions, horovod/torch/mpi_ops.py:236-334)."""
+    import torch
+    import horovod_tpu.torch as hvd
+
+    # -- allgather: rank r contributes r+1 rows of 2 ---------------------
+    d0 = rank + 1
+    x = torch.full((d0, 2), float(rank + 1), requires_grad=True)
+    total_rows = sum(r + 1 for r in range(size))
+    w = torch.arange(total_rows, dtype=torch.float32)[:, None] + 1.0
+    y = hvd.allgather(x, name="tg.ag")
+    assert y.shape == (total_rows, 2)
+    (y * w).sum().backward()
+    off = sum(r + 1 for r in range(rank))
+    want = size * (np.arange(total_rows, dtype=np.float32)[:, None]
+                   + 1.0)[off:off + d0]
+    np.testing.assert_allclose(x.grad.numpy(),
+                               np.broadcast_to(want, (d0, 2)))
+
+    # -- broadcast: non-root inputs get exact zero gradient --------------
+    root = size - 1
+    v = torch.full((3,), float(rank + 10), requires_grad=True)
+    yb = hvd.broadcast(v, root_rank=root, name="tg.bc")
+    np.testing.assert_allclose(yb.detach().numpy(), float(root + 10))
+    (yb * float(rank + 1)).sum().backward()
+    ssum = sum(range(1, size + 1))
+    if rank == root:
+        np.testing.assert_allclose(v.grad.numpy(), float(ssum))
+    else:
+        np.testing.assert_allclose(v.grad.numpy(), 0.0)
+
+    # broadcast_ stays in-place and non-differentiable, even on a
+    # requires_grad leaf (the reference contract)
+    p = torch.full((2,), float(rank), requires_grad=True)
+    out = hvd.broadcast_(p, root_rank=0, name="tg.bc_")
+    assert out is p and p.grad_fn is None
+    np.testing.assert_allclose(p.detach().numpy(), 0.0)
+
+
 def scenario_tf_gather_bcast_grad(hvd_mod, rank, size):
     """Gradients flow through TF allgather (variable dim-0!) and
     broadcast (reference: the registered HorovodAllgather /

@@ -239,6 +239,12 @@ def test_tf_gather_bcast_grad():
     run_scenario("tf_gather_bcast_grad", 3, timeout=180.0)
 
 
+def test_torch_gather_bcast_grad():
+    """Same contract through the torch autograd Functions, plus the
+    non-differentiable in-place broadcast_."""
+    run_scenario("torch_gather_bcast_grad", 3, timeout=180.0)
+
+
 def test_tfkeras_facade():
     run_scenario("tfkeras_facade", 2, timeout=240.0)
 
