@@ -261,12 +261,15 @@ def test_mixed_op_storm(plane):
     run_scenario("mixed_op_storm", 3, timeout=120.0, extra_env=extra)
 
 
-@pytest.mark.parametrize("plane", ["shm", "socket"])
-def test_coordinator_fuzz(plane):
+@pytest.mark.parametrize("plane,ranks", [
+    ("shm", 3), ("socket", 3), ("shm", 6)])
+def test_coordinator_fuzz(plane, ranks):
     """240 seeded mixed collectives, per-rank-random submission order,
-    overlapping waves, on both host planes — every value exact."""
+    overlapping waves, on both host planes (and a wider 6-rank world)
+    — every value exact."""
     extra = {} if plane == "shm" else {"HOROVOD_TPU_SHM": "0"}
-    run_scenario("coordinator_fuzz", 3, timeout=300.0, extra_env=extra)
+    run_scenario("coordinator_fuzz", ranks, timeout=300.0,
+                 extra_env=extra)
 
 
 def test_kitchen_sink_all_subsystems(tmp_path):
