@@ -125,3 +125,24 @@ def test_failed_save_leaves_no_partial_step(tmp_path, hvd_world,
     r = restore_checkpoint(d, target={"w": np.zeros(2, np.float32)},
                            broadcast=False)
     np.testing.assert_allclose(np.asarray(r["w"]), 1.0)
+
+
+def test_flax_fallback_backend_roundtrip(tmp_path, hvd_world,
+                                         monkeypatch):
+    """The msgpack (flax) storage fallback must round-trip when orbax
+    is unavailable — otherwise that branch never executes in CI."""
+    import sys
+    monkeypatch.setitem(sys.modules, "orbax.checkpoint", None)
+    monkeypatch.setitem(sys.modules, "orbax", None)
+
+    d = str(tmp_path / "ckflax")
+    state = {"w": np.arange(5, dtype=np.float32), "step": 11}
+    p = save_checkpoint(d, state, step=11)
+    assert os.path.isfile(p)  # flax writes a FILE (orbax writes a dir)
+    fut = save_checkpoint(d, state, step=12, block=False)
+    assert os.path.isfile(fut.result())
+
+    r = restore_checkpoint(d, target={"w": np.zeros(5, np.float32),
+                                      "step": 0}, broadcast=False)
+    np.testing.assert_allclose(np.asarray(r["w"]), np.arange(5.0))
+    assert int(r["step"]) == 11  # both saves stored the same state
