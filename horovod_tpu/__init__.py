@@ -44,6 +44,8 @@ from horovod_tpu.common.basics import (
 from horovod_tpu.ops import (
     allreduce,
     allreduce_async,
+    grouped_allreduce,
+    grouped_allreduce_async,
     allgather,
     allgather_async,
     broadcast,
@@ -67,6 +69,7 @@ __all__ = [
     "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
     "is_homogeneous", "coordinator_threads_supported", "mpi_threads_supported",
     "allreduce", "allreduce_async",
+    "grouped_allreduce", "grouped_allreduce_async",
     "allgather", "allgather_async",
     "broadcast", "broadcast_async",
     "alltoall", "alltoall_async",

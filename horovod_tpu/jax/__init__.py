@@ -34,7 +34,8 @@ from horovod_tpu.common.basics import (  # noqa: F401
     cross_rank, cross_size, is_homogeneous,
 )
 from horovod_tpu.ops import (  # noqa: F401
-    allreduce, allreduce_async, allgather, allgather_async,
+    allreduce, allreduce_async, grouped_allreduce,
+    grouped_allreduce_async, allgather, allgather_async,
     broadcast, broadcast_async, alltoall, alltoall_async,
     reducescatter, reducescatter_async, barrier, poll, synchronize,
     Average, Sum,
@@ -164,7 +165,8 @@ def broadcast_train_state(state: Any, root_rank: int = 0):
 __all__ = [
     "init", "shutdown", "initialized", "rank", "size", "local_rank",
     "local_size", "cross_rank", "cross_size", "is_homogeneous",
-    "allreduce", "allreduce_async", "allgather", "allgather_async",
+    "allreduce", "allreduce_async", "grouped_allreduce",
+    "grouped_allreduce_async", "allgather", "allgather_async",
     "broadcast", "broadcast_async", "alltoall", "alltoall_async",
     "reducescatter", "reducescatter_async", "barrier", "poll",
     "synchronize", "Average", "Sum", "Compression",

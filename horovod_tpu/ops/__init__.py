@@ -151,6 +151,60 @@ def allreduce(tensor, average: Optional[bool] = None,
                                        prescale_factor, postscale_factor))
 
 
+def grouped_allreduce_async(tensors, average: Optional[bool] = None,
+                            name: Optional[str] = None,
+                            op: Optional[int] = None,
+                            prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0) -> list:
+    """Submit a list of tensors as one logical allreduce group under
+    derived names ``{name}.<i>``; the coordinator's fusion batches
+    compatible members — typically within one cycle, though atomicity
+    across a concurrent cycle tick or other submitting threads is
+    best-effort (later-Horovod ``grouped_allreduce`` surface; the
+    reference's coordinator batches implicitly via fusion —
+    horovod/common/operations.cc:1118-1234). Returns one handle per
+    tensor.
+
+    Every member is VALIDATED before any member is enqueued, so a bad
+    tensor (unsupported dtype, unscalable integer average) fails the
+    whole call without leaking half a group in flight — peers never
+    block on members this rank never submitted."""
+    if name is None:
+        name = _auto_name("grouped_allreduce")
+    resolved_op = op if op is not None else (
+        Average if (average is None or average) else Sum)
+    for t in tensors:
+        _inspect(t)  # unsupported dtype raises before any enqueue
+        _check_scalable_dtype(t, resolved_op, prescale_factor,
+                              postscale_factor, "grouped_allreduce")
+    return [allreduce_async(t, average, f"{name}.{i}", op,
+                            prescale_factor, postscale_factor)
+            for i, t in enumerate(tensors)]
+
+
+def grouped_allreduce(tensors, average: Optional[bool] = None,
+                      name: Optional[str] = None,
+                      op: Optional[int] = None,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0) -> list:
+    """Blocking grouped allreduce with all-or-nothing error semantics:
+    every member handle is drained even when one fails, then the first
+    error raises — no member is left silently in flight."""
+    handles = grouped_allreduce_async(tensors, average, name, op,
+                                      prescale_factor, postscale_factor)
+    outs, first_error = [], None
+    for h in handles:
+        try:
+            outs.append(synchronize(h))
+        except HorovodInternalError as e:
+            outs.append(None)
+            if first_error is None:
+                first_error = e
+    if first_error is not None:
+        raise first_error
+    return outs
+
+
 # -- allgather -----------------------------------------------------------
 def allgather_async(tensor, name: Optional[str] = None) -> int:
     """Concatenate each rank's tensor along dim 0; dim 0 may differ per

@@ -313,6 +313,60 @@ def scenario_mixed_op_storm(hvd, rank, size):
             offset += r + 1
 
 
+def scenario_grouped_allreduce(hvd, rank, size):
+    """grouped_allreduce: one call, many tensors, derived names agreed
+    across ranks; mixed dtypes split into separate fusion batches but
+    every member completes with exact values. The blocking form drains
+    every member even when one errors (all-or-nothing surfacing)."""
+    from horovod_tpu.common.status import HorovodInternalError
+
+    ssum = sum(range(1, size + 1))
+    tensors = [np.full(16 + i, float(rank + 1) * (i + 1), np.float64)
+               for i in range(6)]
+    tensors.append(np.full(4, rank + 1, np.int64))  # dtype break
+    outs = hvd.grouped_allreduce(tensors, average=False, name="grp")
+    for i in range(6):
+        np.testing.assert_allclose(outs[i],
+                                   np.full(16 + i, ssum * (i + 1.0)))
+    np.testing.assert_allclose(np.asarray(outs[6], np.float64),
+                               float(ssum))
+
+    # average semantics apply per member
+    avg = hvd.grouped_allreduce(
+        [np.full(3, float(rank + 1) * 2, np.float32)], name="grp.avg")
+    np.testing.assert_allclose(avg[0], 2.0 * ssum / size)
+
+    # all-or-nothing: one member mismatched in shape across ranks ->
+    # the group call raises, the good members still completed
+    bad = [np.ones(5, np.float32),
+           np.ones(4 + rank % 2, np.float32)]  # member 1 mismatches
+    try:
+        hvd.grouped_allreduce(bad, average=False, name="grp.bad")
+    except HorovodInternalError as e:
+        assert "shape" in str(e).lower()
+    else:
+        if size > 1:
+            raise AssertionError("expected group member error")
+    # the world remains usable
+    ok = hvd.grouped_allreduce([np.ones(2, np.float32)],
+                               average=False, name="grp.after")
+    np.testing.assert_allclose(ok[0], float(size))
+
+    # pre-validation: an unscalable member (int under Average) fails
+    # the WHOLE call before anything is enqueued — no half-submitted
+    # group for peers to block on
+    try:
+        hvd.grouped_allreduce([np.ones(2, np.float32),
+                               np.ones(2, np.int32)], name="grp.val")
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("expected ValueError for int average")
+    ok = hvd.grouped_allreduce([np.ones(2, np.float32)],
+                               average=False, name="grp.after2")
+    np.testing.assert_allclose(ok[0], float(size))
+
+
 def scenario_coordinator_fuzz(hvd, rank, size):
     """Randomized negotiation fuzz — the framework's race-detection
     analog (SURVEY §5: the coordinator protocol is what turns racy

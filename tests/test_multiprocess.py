@@ -261,6 +261,15 @@ def test_mixed_op_storm(plane):
     run_scenario("mixed_op_storm", 3, timeout=120.0, extra_env=extra)
 
 
+@pytest.mark.parametrize("plane", ["shm", "socket"])
+def test_grouped_allreduce(plane):
+    """Grouped submission: exact values per member, per-member average
+    semantics, and all-or-nothing error surfacing with a usable world
+    afterwards."""
+    extra = {} if plane == "shm" else {"HOROVOD_TPU_SHM": "0"}
+    run_scenario("grouped_allreduce", 3, timeout=120.0, extra_env=extra)
+
+
 @pytest.mark.parametrize("plane,ranks", [
     ("shm", 3), ("socket", 3), ("shm", 6)])
 def test_coordinator_fuzz(plane, ranks):
