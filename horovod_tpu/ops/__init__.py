@@ -174,7 +174,11 @@ def grouped_allreduce_async(tensors, average: Optional[bool] = None,
     resolved_op = op if op is not None else (
         Average if (average is None or average) else Sum)
     for t in tensors:
-        _inspect(t)  # unsupported dtype raises before any enqueue
+        # Unsupported payloads AND unsupported dtypes must raise before
+        # any enqueue — numpy_dtype_to_datatype is what _enqueue would
+        # reject later, so run it here too (e.g. complex64).
+        _, _, _, np_dtype, _, _ = _inspect(t)
+        numpy_dtype_to_datatype(np_dtype)
         _check_scalable_dtype(t, resolved_op, prescale_factor,
                               postscale_factor, "grouped_allreduce")
     return [allreduce_async(t, average, f"{name}.{i}", op,

@@ -362,13 +362,55 @@ def broadcast_parameters(params, root_rank: int = 0):
 def broadcast_optimizer_state(optimizer, root_rank: int = 0):
     """Broadcast optimizer.state_dict() tensors and scalars from root
     (reference: horovod/torch/__init__.py:232-348 incl. the
-    scalar-wrapping + recursive type restoration)."""
+    scalar-wrapping + recursive type restoration).
+
+    In the canonical restore flow only rank ``root_rank`` has state (it
+    loaded a checkpoint; workers hold fresh optimizers). Broadcasting
+    "whatever exists" would have root submit broadcasts the workers
+    never submit and hang the world, so — like the reference
+    (horovod/torch/__init__.py:249-271) — ranks with empty state first
+    materialize it with a zero-gradient step, and a stateless optimizer
+    returns without touching the wire.
+    """
     import torch
+    # Must not route through DistributedOptimizer.step below (that
+    # synchronizes allreduces only this rank submitted), so unwrap to
+    # the inner torch optimizer; the LBFGS guard must also see through
+    # the wrapper.
+    inner = optimizer._opt if isinstance(
+        optimizer, _DistributedOptimizer) else optimizer
+    if isinstance(inner, torch.optim.LBFGS):
+        # Reference parity (horovod/torch/__init__.py:241-245): LBFGS
+        # state is deeply nested with None-valued entries; its shape
+        # cannot be agreed across ranks by this path-keyed protocol.
+        raise ValueError("cannot broadcast torch.optim.LBFGS state")
+
     state_dict = optimizer.state_dict()
 
-    # Newly constructed optimizers have empty state on all ranks; the
-    # reference forces a zero-grad step to materialize it. We broadcast
-    # whatever exists, keyed deterministically.
+    if not state_dict["state"]:
+        # Materialize with zero gradients so every rank ends up with
+        # the same state *structure* as a rank that restored from a
+        # checkpoint. Frozen params never receive gradients in real
+        # training, so the root's restored state has no entries for
+        # them — giving them a grad here would make step() create
+        # entries only on this rank and desynchronize the broadcast.
+        for group in inner.param_groups:
+            for p in group["params"]:
+                if not p.requires_grad:
+                    continue
+                if p.grad is None:
+                    p.grad = torch.zeros_like(p.data)
+                else:
+                    with torch.no_grad():
+                        p.grad.zero_()
+        inner.step()
+        state_dict = optimizer.state_dict()
+
+    if not state_dict["state"]:
+        # Stateless optimizer (e.g. plain SGD without momentum):
+        # nothing to agree on, and every rank takes this exit.
+        return
+
     scalars = {}
     handles = []
 

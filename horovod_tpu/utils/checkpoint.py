@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import os
 import re
+import threading
 from typing import Any, Optional
 
 from horovod_tpu.common import basics
@@ -73,23 +74,32 @@ def _load_tree(path: str, target: Optional[Any]) -> Any:
 
 
 # One background writer so async saves stay ordered (a newer save can
-# never be overtaken by an older one still in flight).
+# never be overtaken by an older one still in flight). _pending is
+# appended on caller threads and swapped out by the drain; the lock
+# keeps an append from racing the swap when saves are issued from more
+# than one thread (a future escaping the drain would surface its
+# failure only at atexit, after a restore already read around it).
 _writer = None
 _pending = []
+_pending_lock = threading.Lock()
 
 
 def _writer_pool():
     global _writer
-    if _writer is None:
-        import atexit
-        from concurrent.futures import ThreadPoolExecutor
-        _writer = ThreadPoolExecutor(max_workers=1,
-                                     thread_name_prefix="hvd-ckpt")
-        # Fire-and-forget saves must not fail silently: surface any
-        # write error at interpreter exit even if the caller never
-        # drained explicitly.
-        atexit.register(_drain_at_exit)
-    return _writer
+    with _pending_lock:
+        if _writer is None:
+            import atexit
+            from concurrent.futures import ThreadPoolExecutor
+            # Init under the lock: two first-savers racing here would
+            # otherwise each build a pool, and two writer threads break
+            # the save-ordering guarantee documented above.
+            _writer = ThreadPoolExecutor(max_workers=1,
+                                         thread_name_prefix="hvd-ckpt")
+            # Fire-and-forget saves must not fail silently: surface any
+            # write error at interpreter exit even if the caller never
+            # drained explicitly.
+            atexit.register(_drain_at_exit)
+        return _writer
 
 
 def _drain_at_exit() -> None:
@@ -107,7 +117,8 @@ def wait_pending_saves() -> None:
     automatically by restore_checkpoint, blocking saves, and at
     interpreter exit."""
     global _pending
-    pending, _pending = _pending, []
+    with _pending_lock:
+        pending, _pending = _pending, []
     for f in pending:
         try:
             f.result()
@@ -155,9 +166,13 @@ def save_checkpoint(directory: str, state: Any, step: int,
     if basics.rank() != 0:
         return None
     if not block:
-        fut = _writer_pool().submit(_save_impl, directory,
-                                    _snapshot(state), step, keep)
-        _pending.append(fut)
+        pool = _writer_pool()  # before the lock: it takes the same one
+        snap = _snapshot(state)
+        with _pending_lock:
+            # submit+append atomically, so a concurrent drain can never
+            # observe the future in flight but absent from _pending.
+            fut = pool.submit(_save_impl, directory, snap, step, keep)
+            _pending.append(fut)
         return fut
     wait_pending_saves()
     return _save_impl(directory, state, step, keep)

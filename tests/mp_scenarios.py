@@ -366,6 +366,21 @@ def scenario_grouped_allreduce(hvd, rank, size):
                                average=False, name="grp.after2")
     np.testing.assert_allclose(ok[0], float(size))
 
+    # pre-validation also covers unsupported DTYPES: a complex member
+    # must fail the whole call before member 0 is enqueued (otherwise
+    # member 0 would be left in flight and peers would hang on it)
+    try:
+        hvd.grouped_allreduce([np.ones(2, np.float32),
+                               np.ones(2, np.complex64)],
+                              average=False, name="grp.cplx")
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("expected ValueError for complex dtype")
+    ok = hvd.grouped_allreduce([np.ones(2, np.float32)],
+                               average=False, name="grp.after3")
+    np.testing.assert_allclose(ok[0], float(size))
+
 
 def scenario_coordinator_fuzz(hvd, rank, size):
     """Randomized negotiation fuzz — the framework's race-detection
@@ -1076,6 +1091,68 @@ def scenario_torch_adam_state(hvd_mod, rank, size):
                 for r in range(size):
                     assert torch.allclose(gathered[r], gathered[0]), \
                         f"state {pid}/{key} diverged"
+
+
+def scenario_torch_opt_state_asymmetric(hvd_mod, rank, size):
+    """The checkpoint-restore shape broadcast_optimizer_state exists
+    for: ONLY rank 0 has materialized state (it "loaded a checkpoint");
+    workers hold fresh optimizers. Without empty-state materialization
+    (reference: horovod/torch/__init__.py:249-271) rank 0 submits
+    broadcasts the workers never submit and the world hangs."""
+    import torch
+    import horovod_tpu.torch as hvd
+
+    torch.manual_seed(300 + rank)
+    model = torch.nn.Linear(4, 2)
+    # A frozen parameter: real training on rank 0 never gives it a
+    # gradient, so rank 0's state has NO entry for it. Worker-side
+    # materialization must also skip it or the broadcast structures
+    # disagree and the world hangs.
+    model.bias.requires_grad_(False)
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    if rank == 0:
+        # rank 0 materializes real (non-zero) state
+        loss = model(torch.randn(3, 4)).sum()
+        loss.backward()
+        opt.step()
+        opt.zero_grad()
+    assert bool(opt.state_dict()["state"]) == (rank == 0)
+
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+
+    st = opt.state_dict()["state"]
+    assert st, "workers must have materialized state after broadcast"
+    for pid, entry in st.items():
+        for key, val in entry.items():
+            if isinstance(val, torch.Tensor) and val.numel():
+                gathered = hvd.allgather(
+                    val.detach().reshape(1, -1).to(torch.float32),
+                    name=f"check.asym.{pid}.{key}")
+                for r in range(size):
+                    assert torch.allclose(gathered[r], gathered[0]), \
+                        f"state {pid}/{key} diverged after restore bcast"
+
+    # Stateless optimizer: every rank takes the early return, no wire
+    # traffic, no hang (reference :266-271).
+    sgd = torch.optim.SGD(model.parameters(), lr=0.1)
+    hvd.broadcast_optimizer_state(sgd, root_rank=0)
+    assert not sgd.state_dict()["state"]
+
+    # LBFGS is rejected up front on every rank (reference :241-245),
+    # including when hidden behind the DistributedOptimizer wrapper.
+    lbfgs = torch.optim.LBFGS([p for p in model.parameters()
+                               if p.requires_grad])
+    for candidate in (lbfgs, hvd.DistributedOptimizer(lbfgs)):
+        try:
+            hvd.broadcast_optimizer_state(candidate, root_rank=0)
+        except ValueError:
+            pass
+        else:
+            raise AssertionError("LBFGS broadcast must raise ValueError")
+
+    # world still healthy after the error path
+    one = hvd.allreduce(torch.ones(2), name="asym.final", op=hvd.Sum)
+    assert torch.allclose(one, torch.full((2,), float(size)))
 
 
 def scenario_jax_adapter(hvd_mod, rank, size):

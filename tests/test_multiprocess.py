@@ -221,6 +221,12 @@ def test_torch_adam_state_broadcast():
     run_scenario("torch_adam_state", 2, timeout=120.0)
 
 
+def test_torch_opt_state_asymmetric_broadcast():
+    """Checkpoint-restore shape: only rank 0 has optimizer state; the
+    broadcast must materialize worker state instead of hanging."""
+    run_scenario("torch_opt_state_asymmetric", 2, timeout=120.0)
+
+
 def test_keras_distributed_optimizer():
     run_scenario("keras_optimizer", 2, timeout=180.0)
 
