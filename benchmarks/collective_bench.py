@@ -248,6 +248,183 @@ def worker_fixed_compute(rank: int, size: int) -> None:
 # orchestrator
 # ---------------------------------------------------------------------------
 
+def worker_overhead(rank: int, size: int) -> None:
+    """Isolate the per-step control-plane cost: a BARRIER is a pure
+    negotiate+dispatch round (no payload), and a 4 KiB allreduce adds
+    only a trivial payload — their medians are the framework overhead a
+    training step pays on top of compute, the quantity that bounds
+    pod-scale efficiency (the data-plane bytes ride ICI on real
+    hardware and overlap with backward)."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init()
+    for i in range(5):
+        hvd.barrier(name=f"warm.{i}")
+    ts_bar = []
+    for i in range(ALLREDUCE_ITERS * 2):
+        t0 = time.perf_counter()
+        hvd.barrier(name=f"ov.bar.{i}")
+        ts_bar.append(time.perf_counter() - t0)
+    x = np.full((1024,), float(rank + 1), np.float32)
+    ts_small = []
+    for i in range(ALLREDUCE_ITERS * 2):
+        t0 = time.perf_counter()
+        out = hvd.allreduce(x, average=False, name=f"ov.ar.{i}")
+        ts_small.append(time.perf_counter() - t0)
+    assert abs(float(out[0]) - sum(range(1, size + 1))) < 1e-4
+    _, bar_med, _ = _quantiles(ts_bar)
+    _, small_med, _ = _quantiles(ts_small)
+    if rank == 0:
+        print("RESULT " + json.dumps({
+            "barrier_us": round(bar_med * 1e6, 1),
+            "small_allreduce_us": round(small_med * 1e6, 1),
+        }), flush=True)
+    hvd.shutdown()
+
+
+def _project_scaling(overheads: dict, step_budget_ms: float) -> dict:
+    """Fit the measured control-plane overhead vs world size and
+    project data-parallel scaling efficiency at pod scale.
+
+    Model: the data plane rides ICI and overlaps with backward (as the
+    reference's NCCL allreduce overlaps), so the per-step cost that
+    does NOT parallelize is the negotiation round. The coordinator
+    gathers one RequestList per rank each cycle — linear in N on the
+    star control plane — so fit overhead(N) = a + b*N (conservative;
+    a tree/hierarchical control plane would be b*log N) on the
+    measured np in {2,4,8} and evaluate at 64:
+
+        efficiency(N) ~= step_budget / (step_budget + overhead(N))
+
+    with step_budget the measured single-chip step time from bench.py.
+    """
+    ns = sorted(int(k) for k in overheads)
+    ys = [overheads[str(n)]["barrier_us"] for n in ns]
+    # least-squares fit y = a + b*n
+    n_arr = [float(n) for n in ns]
+    mean_n = sum(n_arr) / len(n_arr)
+    mean_y = sum(ys) / len(ys)
+    b = (sum((n - mean_n) * (y - mean_y)
+             for n, y in zip(n_arr, ys))
+         / sum((n - mean_n) ** 2 for n in n_arr))
+    a = mean_y - b * mean_n
+    budget_us = step_budget_ms * 1e3
+    proj = {}
+    for n in (8, 16, 64):
+        ov = a + b * n
+        proj[str(n)] = {
+            "overhead_us": round(ov, 1),
+            "efficiency": round(budget_us / (budget_us + ov), 4),
+        }
+    return {
+        "measured_overhead_us": {str(n): overheads[str(n)]
+                                 for n in ns},
+        "fit_us": {"a": round(a, 2), "b_per_rank": round(b, 2),
+                   "model": "a + b*N (star control plane)"},
+        "step_budget_ms": step_budget_ms,
+        "projected": proj,
+        "note": (
+            "overhead measured as a pure negotiation round (barrier) "
+            "over the TCP control plane on loopback at np=2/4/8; the "
+            "projection assumes the data plane (XLA collectives on "
+            "ICI) overlaps with backward as in bench.py's measured "
+            "step, so control-plane latency is the non-parallelizing "
+            "term. step_budget_ms is bench.py's measured single-chip "
+            "ResNet-50 step. Loopback TCP on a 1-vCPU host "
+            "overstates per-rank cost vs a real pod's NIC-to-NIC "
+            "fabric, making the 64-chip number conservative."),
+    }
+
+
+def worker_bcast_render(rank: int, size: int) -> None:
+    """Microbench the two XLA broadcast renderings on one process with
+    8 virtual devices: masked psum (pre-r4: full allreduce bandwidth)
+    vs the binary-tree collective-permute chain (the ncclBcast role,
+    reference: nccl_operations.cc:334-351). Reports execution medians
+    AND the compiled HLO's bytes-accessed estimate, which is
+    machine-independent evidence that the permute rendering moves less
+    data."""
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    ndev = 8
+    devs = jax.devices()[:ndev]
+    mesh = Mesh(np.array(devs), ("p",))
+    n = 1 << 20  # 4 MiB fp32 payload per device
+    root = 0
+
+    def masked(t):
+        idx = jax.lax.axis_index("p")
+        return jax.lax.psum(jnp.where(idx == root, t,
+                                      jnp.zeros_like(t)), "p")
+
+    def permute(t):
+        # binary-tree chain, same shape as ops/xla_ops.py broadcast
+        idx = jax.lax.axis_index("p")
+        v = (idx - root) % ndev
+        cur = t
+        k = 1
+        while k < ndev:
+            perm = [((u + root) % ndev, (u + k + root) % ndev)
+                    for u in range(k) if u + k < ndev]
+            received = jax.lax.ppermute(cur, "p", perm=perm)
+            cur = jnp.where((v >= k) & (v < 2 * k), received, cur)
+            k *= 2
+        return cur
+
+    x = jax.device_put(
+        np.ones((ndev * n,), np.float32),
+        NamedSharding(mesh, P("p")))
+    report = {"bytes": n * 4, "n_devices": ndev}
+    for name, body in (("masked_psum", masked), ("ppermute", permute)):
+        fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("p"),
+                                   out_specs=P("p"), check_vma=False))
+        compiled = fn.lower(x).compile()
+        try:
+            ca = compiled.cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            report[f"{name}_bytes_accessed"] = ca.get("bytes accessed")
+        except Exception:
+            pass
+        jax.block_until_ready(compiled(x))  # warmup
+        ts = []
+        for _ in range(ALLREDUCE_ITERS):
+            t0 = time.perf_counter()
+            jax.block_until_ready(compiled(x))
+            ts.append((time.perf_counter() - t0) * 1e6)
+        _, med, _ = _quantiles(ts)
+        report[f"{name}_us"] = round(med, 1)
+    if report.get("ppermute_us") and report.get("masked_psum_us"):
+        report["speedup"] = round(
+            report["masked_psum_us"] / report["ppermute_us"], 3)
+    print("RESULT " + json.dumps(report), flush=True)
+
+
+def _run_bcast_render(timeout: float = 300.0) -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    p = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         "bcast_render", "--rank", "0", "--size", "1"],
+        cwd=REPO, env=env, capture_output=True, timeout=timeout)
+    out = p.stdout.decode()
+    if p.returncode != 0:
+        raise RuntimeError(f"bcast_render exited {p.returncode}:\n"
+                           f"{out}\n{p.stderr.decode()}")
+    for line in out.splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    raise RuntimeError(f"no RESULT from bcast_render:\n{out}")
+
+
 def _run_world(mode: str, size: int, timeout: float = 600.0,
                extra_env=None) -> dict:
     port = _free_port()
@@ -296,7 +473,8 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--np", type=int, default=8)
     ap.add_argument("--worker",
-                    choices=["allreduce", "train", "fixed_compute"])
+                    choices=["allreduce", "train", "fixed_compute",
+                             "bcast_render", "overhead"])
     ap.add_argument("--rank", type=int)
     ap.add_argument("--size", type=int)
     ap.add_argument("--skip-variants", action="store_true",
@@ -306,7 +484,9 @@ def main() -> None:
     if args.worker:
         {"allreduce": worker_allreduce,
          "train": worker_train,
-         "fixed_compute": worker_fixed_compute}[args.worker](
+         "fixed_compute": worker_fixed_compute,
+         "bcast_render": worker_bcast_render,
+         "overhead": worker_overhead}[args.worker](
              args.rank, args.size)
         return
 
@@ -350,6 +530,23 @@ def main() -> None:
           f"{cores} core(s); vs-achievable {min(eff / ideal, 1.0):.1%})",
           flush=True)
 
+    bc = {}
+    if not args.skip_variants:
+        print("== broadcast rendering (8 virtual devices, 4 MiB) ==",
+              flush=True)
+        try:
+            bc = _run_bcast_render()
+            print(f"  masked psum {bc.get('masked_psum_us')} us   "
+                  f"ppermute {bc.get('ppermute_us')} us   "
+                  f"speedup {bc.get('speedup')}x   bytes accessed "
+                  f"{bc.get('masked_psum_bytes_accessed')} -> "
+                  f"{bc.get('ppermute_bytes_accessed')}", flush=True)
+        except Exception as e:
+            # Record, don't abort: the already-measured sweeps must
+            # still reach RESULTS_cpu.json.
+            bc = {"error": repr(e)}
+            print(f"  bcast_render failed: {e!r}", flush=True)
+
     print(f"== scaling (fixed {FIXED_COMPUTE_S * 1e3:.0f} ms compute — "
           f"parallelizable, isolates comm overhead) ==", flush=True)
     f1 = _median_world("fixed_compute", 1)
@@ -358,6 +555,46 @@ def main() -> None:
     print(f"  np=1: {f1['steps_per_sec']} steps/s   "
           f"np={np_}: {fn['steps_per_sec']} steps/s   "
           f"efficiency {fc_eff:.1%}", flush=True)
+
+    projection = {}
+    if not args.skip_variants:
+        print("== control-plane overhead (negotiation round medians) "
+              "==", flush=True)
+        try:
+            overheads = {}
+            for n in sorted({2, 4, np_}):
+                vals = [_run_world("overhead", n) for _ in range(3)]
+                vals.sort(key=lambda d: d["barrier_us"])
+                overheads[str(n)] = vals[1]  # median of world medians
+                print(f"  np={n}: barrier "
+                      f"{overheads[str(n)]['barrier_us']} us   4KiB "
+                      f"allreduce "
+                      f"{overheads[str(n)]['small_allreduce_us']} us",
+                      flush=True)
+            # step budget = bench.py's most recent single-chip
+            # measurement (batch 256 at the reported img/s/chip)
+            step_budget_ms = 103.6
+            bench_files = sorted(
+                f for f in os.listdir(REPO)
+                if f.startswith("BENCH_r") and f.endswith(".json"))
+            if bench_files:
+                try:
+                    with open(os.path.join(
+                            REPO, bench_files[-1])) as fh:
+                        parsed = json.load(fh).get("parsed") or {}
+                    if parsed.get("value"):
+                        step_budget_ms = round(
+                            256.0 / parsed["value"] * 1e3, 2)
+                except Exception:
+                    pass
+            projection = _project_scaling(overheads, step_budget_ms)
+            print(f"  fit {projection['fit_us']}   projected 64-chip "
+                  f"efficiency "
+                  f"{projection['projected']['64']['efficiency']:.1%}"
+                  f" against a {step_budget_ms} ms step", flush=True)
+        except Exception as e:
+            projection = {"error": repr(e)}
+            print(f"  overhead projection failed: {e!r}", flush=True)
 
     out = {
         "world_size": np_,
@@ -371,6 +608,8 @@ def main() -> None:
         "scaling_efficiency": round(eff, 4),
         "timeshare_ideal": round(ideal, 4),
         "efficiency_vs_achievable": round(min(eff / ideal, 1.0), 4),
+        "broadcast_rendering": bc,
+        "projected_scaling": projection,
         "fixed_compute_ms": FIXED_COMPUTE_S * 1e3,
         "fixed_compute_steps_per_sec": {
             "1": f1["steps_per_sec"], str(np_): fn["steps_per_sec"]},

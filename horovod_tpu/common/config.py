@@ -81,6 +81,16 @@ class Config:
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
 
+    # XLA broadcast rendering: "psum" (masked psum — one fused
+    # allreduce, ~2x payload per link but single-round and pipelined
+    # by XLA; measured fastest at N>=8) or "tree" (binary-tree
+    # ppermute chain — each device receives the payload exactly once,
+    # N-1 payload transfers over the whole fabric vs the psum's ~2N,
+    # at ceil(log2 N) sequential rounds of latency; wins on small or
+    # congested worlds). See benchmarks/collective_bench.py
+    # broadcast_rendering.
+    xla_broadcast: str = "psum"
+
     # Timeline (reference: operations.cc:792-798)
     timeline_path: str = ""
     timeline_mark_cycles: bool = False
@@ -145,6 +155,15 @@ class Config:
             "HOROVOD_HIERARCHICAL_ALLREDUCE", c.hierarchical_allreduce)
         c.hierarchical_allgather = _env_bool(
             "HOROVOD_HIERARCHICAL_ALLGATHER", c.hierarchical_allgather)
+        c.xla_broadcast = os.environ.get("HOROVOD_XLA_BCAST",
+                                         c.xla_broadcast).lower()
+        if c.xla_broadcast not in ("psum", "tree"):
+            # A typo must not silently pick a rendering — and per-rank
+            # divergence would compile different collectives for the
+            # same negotiated broadcast and hang the mesh.
+            raise ValueError(
+                f"HOROVOD_XLA_BCAST={c.xla_broadcast!r}: must be "
+                "'psum' or 'tree'")
         c.timeline_path = os.environ.get("HOROVOD_TIMELINE", "")
         c.timeline_mark_cycles = _env_bool(
             "HOROVOD_TIMELINE_MARK_CYCLES", c.timeline_mark_cycles)

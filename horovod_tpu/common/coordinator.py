@@ -240,32 +240,56 @@ def construct_response(table: MessageTable, name: str,
                     "by this coordinator.")
 
 
-def _response_bytes(resp: Response, dtype: DataType) -> int:
+def _response_bytes(resp: Response, dtype: DataType,
+                    slice_numels: Dict[str, int]) -> int:
+    """Payload bytes a response moves. ALLREDUCE tensor_sizes are
+    per-tensor numels; ALLGATHER tensor_sizes are per-rank dim-0 rows,
+    so the output size is rows × slice-numel (the reference's
+    ``TotalByteSizeOfAllgatherOutput``, operations.cc:1178-1191)."""
+    if resp.response_type == ResponseType.ALLGATHER:
+        return (sum(resp.tensor_sizes)
+                * slice_numels[resp.tensor_names[0]]
+                * datatype_size(dtype))
     return sum(resp.tensor_sizes) * datatype_size(dtype)
 
 
 def fuse_responses(responses: List[Response],
                    dtypes: Dict[str, DataType],
-                   fusion_threshold_bytes: int) -> List[Response]:
-    """Batch compatible consecutive ALLREDUCE responses under the fusion
-    threshold, with the reference's look-ahead-skip behaviour: a tensor
-    that cannot join the current batch does not end it — later compatible
-    tensors may still join, and skipped ones are retried in order
-    (reference: horovod/common/operations.cc:1118-1234).
+                   fusion_threshold_bytes: int,
+                   slice_numels: Dict[str, int] = None) -> List[Response]:
+    """Batch compatible consecutive ALLREDUCE **and ALLGATHER**
+    responses under the fusion threshold, with the reference's
+    look-ahead-skip behaviour: a tensor that cannot join the current
+    batch does not end it — later compatible tensors may still join,
+    and skipped ones are retried in order
+    (reference: horovod/common/operations.cc:1118-1234; the allgather
+    branch 1172-1234 accounts bytes as dim0-sum × slice-size).
 
     ``dtypes`` maps tensor name → dtype (fusion requires same dtype and
     same device placement; we fuse host-side entries and device entries
-    separately via the devices signature).
+    separately via the devices signature). ``slice_numels`` maps
+    name → elements per dim-0 row, needed for allgather byte
+    accounting. A fused ALLGATHER response keeps ``tensor_sizes``
+    entry-major: sizes[ec * world_size + rc] is entry ec's dim-0
+    contribution from rank rc (reference:
+    Response::add_allgather_response, message.cc:306-314).
     """
+    # Without slice numels, allgather byte accounting is impossible —
+    # pass allgathers through unfused (pre-fusion behavior) instead of
+    # guessing sizes or crashing the coordinator loop.
+    fusable = ((ResponseType.ALLREDUCE, ResponseType.ALLGATHER)
+               if slice_numels is not None
+               else (ResponseType.ALLREDUCE,))
+    slice_numels = slice_numels or {}
     queue = list(responses)
     fused: List[Response] = []
     while queue:
         resp = queue.pop(0)
-        if resp.response_type != ResponseType.ALLREDUCE:
+        if resp.response_type not in fusable:
             fused.append(resp)
             continue
         dtype = dtypes[resp.tensor_names[0]]
-        tensor_bytes = _response_bytes(resp, dtype)
+        tensor_bytes = _response_bytes(resp, dtype, slice_numels)
         if tensor_bytes >= fusion_threshold_bytes:
             fused.append(resp)
             continue
@@ -273,19 +297,21 @@ def fuse_responses(responses: List[Response],
         while queue:
             cand = queue.pop(0)
             joinable = (
-                cand.response_type == ResponseType.ALLREDUCE
+                cand.response_type == resp.response_type
                 and dtypes[cand.tensor_names[0]] == dtype
                 and cand.devices == resp.devices
                 and cand.prescale_factor == resp.prescale_factor
                 and cand.postscale_factor == resp.postscale_factor
-                and tensor_bytes + _response_bytes(cand, dtype)
+                and tensor_bytes + _response_bytes(cand, dtype,
+                                                   slice_numels)
                     <= fusion_threshold_bytes)
             if joinable:
                 for n in cand.tensor_names:
                     resp.add_tensor_name(n)
                 for s in cand.tensor_sizes:
                     resp.add_tensor_size(s)
-                tensor_bytes += _response_bytes(cand, dtype)
+                tensor_bytes += _response_bytes(cand, dtype,
+                                                slice_numels)
             else:
                 skipped.append(cand)
         queue = skipped

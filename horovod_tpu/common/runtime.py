@@ -64,8 +64,12 @@ class Runtime:
         if controller.rank == 0 and config.timeline_path:
             self.timeline = create_timeline(config.timeline_path,
                                             config.timeline_mark_cycles)
+        op_manager.attach_timeline(self.timeline)
         self._message_table = MessageTable() if controller.rank == 0 else None
         self._dtypes: Dict[str, DataType] = {}
+        # name -> elements per dim-0 row, for allgather fusion byte
+        # accounting (reference: TotalByteSizeOfAllgatherOutput).
+        self._slice_numels: Dict[str, int] = {}
         self._stall = StallInspector(
             controller.size,
             warning_time=config.stall_check_time_seconds,
@@ -145,6 +149,49 @@ class Runtime:
             if self.tensor_table.pop_entry_if_present(entry.tensor_name):
                 return Status.Aborted(SHUT_DOWN_ERROR)
         self._wake.set()  # snap an idle-backed-off loop awake
+        return Status.OK()
+
+    def enqueue_group(self, request_type: RequestType, items,
+                      prescale: float = 1.0,
+                      postscale: float = 1.0) -> Status:
+        """Atomically enqueue several entries as one negotiation batch
+        (the grouped-collective contract, later-Horovod
+        ``grouped_allreduce``): every request enters the same
+        RequestList on this rank, so a concurrent cycle tick cannot
+        split the group, all members become ready in the same
+        coordinator cycle, and compatible members fuse into ONE
+        Response under the threshold. ``items`` is a list of
+        (entry, dtype, shape)."""
+        if self._done.is_set() or self._shutdown_requested.is_set():
+            return Status.Aborted(SHUT_DOWN_ERROR)
+        pairs = []
+        for entry, dtype, shape in items:
+            req = Request(request_rank=self.controller.rank,
+                          request_type=request_type,
+                          tensor_type=dtype,
+                          tensor_name=entry.tensor_name,
+                          root_rank=entry.root_rank,
+                          device=entry.device,
+                          tensor_shape=shape,
+                          prescale_factor=prescale,
+                          postscale_factor=postscale)
+            entry.request_type = request_type
+            pairs.append((entry, req))
+        dup = self.tensor_table.add_all(pairs)
+        if dup is not None:
+            return Status.InvalidArgument(
+                DUPLICATE_NAME_ERROR_FMT
+                % (request_type.name.lower(), dup))
+        if self._done.is_set():
+            # Same liveness race as enqueue(): reclaim anything the
+            # shutdown fan-out may have missed. Per-entry, because the
+            # fan-out may already have completed some members — their
+            # callbacks must not fire twice.
+            for entry, _ in pairs:
+                if self.tensor_table.pop_entry_if_present(
+                        entry.tensor_name) and entry.callback:
+                    entry.callback(Status.Aborted(SHUT_DOWN_ERROR))
+        self._wake.set()
         return Status.OK()
 
     # -- the loop --------------------------------------------------------
@@ -244,6 +291,10 @@ class Runtime:
             shutdown = shutdown or rl.shutdown
             for req in rl.requests:
                 self._dtypes[req.tensor_name] = req.tensor_type
+                numel = 1
+                for d in req.tensor_shape[1:]:
+                    numel *= d
+                self._slice_numels[req.tensor_name] = numel
                 table.increment_tensor_count(req, size, self.timeline)
         ready = table.pop_ready()
         responses = []
@@ -253,10 +304,12 @@ class Runtime:
         threshold = self.config.fusion_threshold_bytes
         if self.parameter_manager is not None:
             threshold = self.parameter_manager.fusion_threshold_bytes()
-        fused = fuse_responses(responses, self._dtypes, threshold)
+        fused = fuse_responses(responses, self._dtypes, threshold,
+                               self._slice_numels)
         for resp in fused:
             for n in resp.tensor_names:
                 self._dtypes.pop(n, None)
+                self._slice_numels.pop(n, None)
 
         if self._stall.should_check():
             if self._stall.check(table):

@@ -60,6 +60,21 @@ class TensorTable:
             self._message_queue.append(request)
             return True
 
+    def add_all(self, pairs) -> Optional[str]:
+        """Insert several (entry, request) pairs under ONE lock hold —
+        all-or-nothing, and atomic w.r.t. pop_messages, so a concurrent
+        cycle tick can never split the batch across two RequestLists
+        (the grouped-allreduce atomicity contract). Returns the first
+        duplicate name, or None on success."""
+        with self._lock:
+            for entry, _ in pairs:
+                if entry.tensor_name in self._table:
+                    return entry.tensor_name
+            for entry, request in pairs:
+                self._table[entry.tensor_name] = entry
+                self._message_queue.append(request)
+            return None
+
     def pop_messages(self) -> List[Request]:
         """Drain the message queue for this cycle
         (reference: operations.cc:1000-1012)."""

@@ -157,13 +157,16 @@ def grouped_allreduce_async(tensors, average: Optional[bool] = None,
                             prescale_factor: float = 1.0,
                             postscale_factor: float = 1.0) -> list:
     """Submit a list of tensors as one logical allreduce group under
-    derived names ``{name}.<i>``; the coordinator's fusion batches
-    compatible members — typically within one cycle, though atomicity
-    across a concurrent cycle tick or other submitting threads is
-    best-effort (later-Horovod ``grouped_allreduce`` surface; the
-    reference's coordinator batches implicitly via fusion —
-    horovod/common/operations.cc:1118-1234). Returns one handle per
-    tensor.
+    derived names ``{name}.<i>`` (later-Horovod ``grouped_allreduce``
+    surface; the reference's coordinator batches implicitly via
+    fusion — horovod/common/operations.cc:1118-1234). Returns one
+    handle per tensor.
+
+    Atomicity is guaranteed, not best-effort: all members enter the
+    negotiation in ONE RequestList (Runtime.enqueue_group holds the
+    table lock across the whole insert), so a concurrent cycle tick or
+    another submitting thread can never split the group — compatible
+    members under the fusion threshold land in one fused Response.
 
     Every member is VALIDATED before any member is enqueued, so a bad
     tensor (unsupported dtype, unscalable integer average) fails the
@@ -173,17 +176,45 @@ def grouped_allreduce_async(tensors, average: Optional[bool] = None,
         name = _auto_name("grouped_allreduce")
     resolved_op = op if op is not None else (
         Average if (average is None or average) else Sum)
+    post = postscale_factor
+    if resolved_op == Average:
+        post = post / basics.size()
+
+    inspected = []
     for t in tensors:
         # Unsupported payloads AND unsupported dtypes must raise before
-        # any enqueue — numpy_dtype_to_datatype is what _enqueue would
-        # reject later, so run it here too (e.g. complex64).
-        _, _, _, np_dtype, _, _ = _inspect(t)
-        numpy_dtype_to_datatype(np_dtype)
+        # any enqueue — numpy_dtype_to_datatype is what the enqueue
+        # would reject later, so run it here too (e.g. complex64).
+        payload, ctx, device, np_dtype, shape, ready_fn = _inspect(t)
+        dtype = numpy_dtype_to_datatype(np_dtype)
         _check_scalable_dtype(t, resolved_op, prescale_factor,
                               postscale_factor, "grouped_allreduce")
-    return [allreduce_async(t, average, f"{name}.{i}", op,
-                            prescale_factor, postscale_factor)
-            for i, t in enumerate(tensors)]
+        inspected.append((payload, ctx, device, dtype, shape, ready_fn))
+
+    rt = basics.runtime()
+    handles, items = [], []
+    for i, (payload, ctx, device, dtype, shape,
+            ready_fn) in enumerate(inspected):
+        handle = rt.handle_manager.allocate()
+        entry = TensorTableEntry(tensor_name=f"{name}.{i}",
+                                 tensor=payload, root_rank=-1,
+                                 device=device, ready_fn=ready_fn,
+                                 context=ctx)
+
+        def callback(status, entry=entry, handle=handle):
+            rt.handle_manager.mark_done(handle, status, entry.output)
+
+        entry.callback = callback
+        handles.append(handle)
+        items.append((entry, dtype, shape))
+
+    status = rt.enqueue_group(RequestType.ALLREDUCE, items,
+                              prescale_factor, post)
+    if not status.ok():
+        # Nothing was inserted (all-or-nothing): fail every handle.
+        for h in handles:
+            rt.handle_manager.mark_done(h, status, None)
+    return handles
 
 
 def grouped_allreduce(tensors, average: Optional[bool] = None,

@@ -17,11 +17,13 @@ fused into the collective by the compiler — for the mesh path).
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from typing import List
 
 from horovod_tpu.common.message import Response
 from horovod_tpu.common.status import Status
 from horovod_tpu.common.tensor_table import TensorTableEntry
+from horovod_tpu.common.timeline import NOOP_TIMELINE
 
 
 class CollectiveBackend:
@@ -31,6 +33,26 @@ class CollectiveBackend:
     # enabled; backends that issue asynchronously submit a completion
     # closure and return Status.InProgress.
     finalizer = None
+
+    # Set by OperationManager.attach_timeline (rank 0 with
+    # HOROVOD_TIMELINE only); host planes wrap their fusion pack/unpack
+    # in MEMCPY_IN/OUT_FUSION_BUFFER activities so timelines show where
+    # fusion time goes (reference: mpi_operations.cc:35-62).
+    timeline = NOOP_TIMELINE
+
+    @contextmanager
+    def activity(self, names, act, enabled: bool = True):
+        """Timeline sub-activity span; the finally guarantees the span
+        closes even when the wrapped transport/pack raises, so an error
+        mid-batch cannot misnest every later event in the trace."""
+        if not enabled:
+            yield
+            return
+        self.timeline.activity_start_all(names, act)
+        try:
+            yield
+        finally:
+            self.timeline.activity_end_all(names)
 
     def enabled(self, entries: List[TensorTableEntry],
                 response: Response) -> bool:

@@ -29,6 +29,12 @@ class OperationManager:
         for b in self._backends:
             b.finalizer = finalizer
 
+    def attach_timeline(self, timeline) -> None:
+        """Give every backend the rank-0 timeline so fusion memcpys show
+        up as sub-activities (reference: mpi_operations.cc:35-62)."""
+        for b in self._backends:
+            b.timeline = timeline
+
     def close(self) -> None:
         """Release backend resources (ring channels, shm mappings) at
         shutdown."""

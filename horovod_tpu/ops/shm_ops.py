@@ -53,9 +53,13 @@ from horovod_tpu.common import logging as hlog
 from horovod_tpu.common.controller import _my_hostname
 from horovod_tpu.common.message import Response, ResponseType
 from horovod_tpu.common.status import Status
+from horovod_tpu.common.timeline import (
+    ACT_MEMCPY_IN_FUSION_BUFFER, ACT_MEMCPY_OUT_FUSION_BUFFER,
+)
 from horovod_tpu.ops.backend import CollectiveBackend
 from horovod_tpu.ops.socket_ops import (
-    _pack_fused, _restore, _to_numpy, _unpack_fused,
+    _allgather_layout, _pack_allgather, _pack_fused, _restore,
+    _to_numpy, _unpack_allgather, _unpack_fused,
 )
 
 _PAGE = 4096
@@ -243,7 +247,10 @@ class ShmBackend(CollectiveBackend):
         ctl = self._ctl
         arrays = [_to_numpy(e.tensor) for e in entries]
         dtype = arrays[0].dtype
-        fused, _ = _pack_fused(arrays, response)
+        names = [e.tensor_name for e in entries]
+        multi = len(entries) > 1  # single-tensor pack is a view
+        with self.activity(names, ACT_MEMCPY_IN_FUSION_BUFFER, multi):
+            fused, _ = _pack_fused(arrays, response)
         if fused.size == 0:
             # Nothing to move; every rank short-circuits identically
             # (sizes are negotiated), so no control rounds are owed.
@@ -274,7 +281,8 @@ class ShmBackend(CollectiveBackend):
                 ctl.gather_data(b"")
                 ctl.broadcast_data(None)
                 result = self._view(out_off, dtype, fused.size).copy()
-        _unpack_fused(entries, arrays, result, response)
+        with self.activity(names, ACT_MEMCPY_OUT_FUSION_BUFFER, multi):
+            _unpack_fused(entries, arrays, result, response)
         return Status.OK()
 
     def _parallel_sum_allreduce(self, fused: np.ndarray, dtype,
@@ -370,40 +378,44 @@ class ShmBackend(CollectiveBackend):
 
     def execute_allgather(self, entries, response: Response) -> Status:
         ctl = self._ctl
-        (entry,) = entries
-        arr = np.ascontiguousarray(_to_numpy(entry.tensor))
-        rows = list(response.tensor_sizes)
-        row_elems = int(np.prod(arr.shape[1:], dtype=np.int64)) \
-            if arr.ndim > 1 else 1
-        itemsize = arr.dtype.itemsize
-        seg = self._segment_for(max(rows) * row_elems * itemsize)
+        arrays = [np.ascontiguousarray(_to_numpy(e.tensor))
+                  for e in entries]
+        names = [e.tensor_name for e in entries]
+        comp, rank_counts = _allgather_layout(entries, arrays, response,
+                                              ctl.size)
+        itemsize = arrays[0].dtype.itemsize
+        seg = self._segment_for(max(rank_counts) * itemsize)
         if seg is None:
             return self._fallback.execute_allgather(entries, response)
         _, stride = seg
         out_off = ctl.size * stride
-        total_elems = sum(rows) * row_elems
+        total_elems = sum(rank_counts)
+        multi = len(entries) > 1
+        with self.activity(names, ACT_MEMCPY_IN_FUSION_BUFFER, multi):
+            packed = _pack_allgather(arrays)
+        dtype = packed.dtype
         if ctl.is_coordinator:
             ctl.gather_data(b"")
-            out = self._view(out_off, arr.dtype, total_elems)
+            out = self._view(out_off, dtype, total_elems)
             pos = 0
             for r in range(ctl.size):
-                n = rows[r] * row_elems
+                n = rank_counts[r]
                 if r == 0:
-                    out[pos:pos + n] = arr.reshape(-1)
+                    out[pos:pos + n] = packed
                 else:
-                    out[pos:pos + n] = self._view(r * stride, arr.dtype, n)
+                    out[pos:pos + n] = self._view(r * stride, dtype, n)
                 pos += n
             ctl.broadcast_data(b"")
             result = out.copy()
         else:
-            slot = self._view(ctl.rank * stride, arr.dtype,
-                              arr.size)
-            slot[:] = arr.reshape(-1)
+            slot = self._view(ctl.rank * stride, dtype, packed.size)
+            slot[:] = packed
             ctl.gather_data(b"")
             ctl.broadcast_data(None)
-            result = self._view(out_off, arr.dtype, total_elems).copy()
-        out_shape = (sum(rows),) + arr.shape[1:]
-        entry.output = _restore(entry, result.reshape(out_shape))
+            result = self._view(out_off, dtype, total_elems).copy()
+        with self.activity(names, ACT_MEMCPY_OUT_FUSION_BUFFER, multi):
+            _unpack_allgather(entries, arrays, result, comp,
+                              rank_counts)
         return Status.OK()
 
     def execute_broadcast(self, entries, response: Response) -> Status:

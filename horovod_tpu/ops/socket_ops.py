@@ -32,6 +32,9 @@ from horovod_tpu.common.message import (
     Response, datatype_to_numpy_dtype, numpy_dtype_to_datatype,
 )
 from horovod_tpu.common.status import Status
+from horovod_tpu.common.timeline import (
+    ACT_MEMCPY_IN_FUSION_BUFFER, ACT_MEMCPY_OUT_FUSION_BUFFER,
+)
 from horovod_tpu.ops.backend import CollectiveBackend
 
 
@@ -72,6 +75,60 @@ def _pack_fused(arrays: List[np.ndarray], response: Response):
         flat = flat * np.asarray(response.prescale_factor, dtype)
         fresh = True
     return flat, fresh
+
+
+def _allgather_layout(entries, arrays, response: Response, size: int):
+    """Displacement math for a (possibly fused) allgather response
+    (reference: AllgatherOp::AllocateOutput / SetEntryComponentOffsets,
+    ops/collective_operations.cc:68-134). ``response.tensor_sizes`` is
+    entry-major: sizes[ec * size + rc] = entry ec's dim-0 rows from
+    rank rc. Returns (comp, rank_counts):
+    comp[ec][rc] = elements entry ec contributes from rank rc;
+    rank_counts[rc] = total elements in rank rc's packed block."""
+    sizes = response.tensor_sizes
+    comp = []
+    for ec, a in enumerate(arrays):
+        row = int(np.prod(a.shape[1:], dtype=np.int64)) \
+            if a.ndim > 1 else 1
+        comp.append([sizes[ec * size + rc] * row for rc in range(size)])
+    rank_counts = [sum(comp[ec][rc] for ec in range(len(arrays)))
+                   for rc in range(size)]
+    return comp, rank_counts
+
+
+def _pack_allgather(arrays: List[np.ndarray]) -> np.ndarray:
+    """This rank's packed contribution: each entry's rows flattened,
+    concatenated in entry order (the reference's allgather
+    MemcpyInFusionBuffer, collective_operations.cc:136-150)."""
+    if len(arrays) == 1:
+        return np.ascontiguousarray(arrays[0]).reshape(-1)
+    return np.concatenate([a.reshape(-1) for a in arrays])
+
+
+def _unpack_allgather(entries, arrays, result: np.ndarray, comp,
+                      rank_counts) -> None:
+    """Per-entry unpack of the rank-major gathered buffer: entry ec's
+    output is the concatenation over ranks of its component inside each
+    rank's block (the reference's allgather MemcpyOutFusionBuffer,
+    collective_operations.cc:152-168)."""
+    size = len(rank_counts)
+    rank_off = [0] * size
+    for rc in range(1, size):
+        rank_off[rc] = rank_off[rc - 1] + rank_counts[rc - 1]
+    # entry_off[rc]: running offset of the NEXT entry's component
+    # inside rank rc's block — O(entries x ranks) total, not O(E^2 N).
+    entry_off = list(rank_off)
+    for ec, (e, a) in enumerate(zip(entries, arrays)):
+        parts = []
+        for rc in range(size):
+            off = entry_off[rc]
+            parts.append(result[off:off + comp[ec][rc]])
+            entry_off[rc] = off + comp[ec][rc]
+        flat = parts[0] if size == 1 else np.concatenate(parts)
+        total_rows = sum(comp[ec]) // (
+            int(np.prod(a.shape[1:], dtype=np.int64)) if a.ndim > 1
+            else 1)
+        e.output = _restore(e, flat.reshape((total_rows,) + a.shape[1:]))
 
 
 def _unpack_fused(entries, arrays, result: np.ndarray, response: Response):
@@ -131,7 +188,10 @@ class SocketBackend(CollectiveBackend):
         ctl = self._ctl
         arrays = [_to_numpy(e.tensor) for e in entries]
         dtype = arrays[0].dtype
-        fused, fresh = _pack_fused(arrays, response)
+        names = [e.tensor_name for e in entries]
+        multi = len(entries) > 1  # single-tensor pack is a view
+        with self.activity(names, ACT_MEMCPY_IN_FUSION_BUFFER, multi):
+            fused, fresh = _pack_fused(arrays, response)
 
         # Large payloads ride the ring (every rank computes the same
         # negotiated size, so the path choice is world-consistent).
@@ -157,22 +217,32 @@ class SocketBackend(CollectiveBackend):
             else:
                 result = _np_from_bytes(ctl.broadcast_data(None), dtype)
 
-        _unpack_fused(entries, arrays, result, response)
+        with self.activity(names, ACT_MEMCPY_OUT_FUSION_BUFFER, multi):
+            _unpack_fused(entries, arrays, result, response)
         return Status.OK()
 
-    # -- allgather -------------------------------------------------------
+    # -- allgather (multi-entry: fused responses) ------------------------
     def execute_allgather(self, entries, response: Response) -> Status:
         ctl = self._ctl
-        (entry,) = entries  # allgather responses are not fused (parity)
-        arr = np.ascontiguousarray(_to_numpy(entry.tensor))
-        gathered = ctl.gather_data(arr)
+        arrays = [np.ascontiguousarray(_to_numpy(e.tensor))
+                  for e in entries]
+        names = [e.tensor_name for e in entries]
+        multi = len(entries) > 1  # single-tensor pack is a view
+        with self.activity(names, ACT_MEMCPY_IN_FUSION_BUFFER, multi):
+            packed = _pack_allgather(arrays)
+        gathered = ctl.gather_data(packed)
         if gathered is not None:
             blob = b"".join(gathered)
-            result = _np_from_bytes(ctl.broadcast_data(blob), arr.dtype)
+            result = _np_from_bytes(ctl.broadcast_data(blob),
+                                    packed.dtype)
         else:
-            result = _np_from_bytes(ctl.broadcast_data(None), arr.dtype)
-        out_shape = (sum(response.tensor_sizes),) + arr.shape[1:]
-        entry.output = _restore(entry, result.reshape(out_shape))
+            result = _np_from_bytes(ctl.broadcast_data(None),
+                                    packed.dtype)
+        comp, rank_counts = _allgather_layout(entries, arrays, response,
+                                              ctl.size)
+        with self.activity(names, ACT_MEMCPY_OUT_FUSION_BUFFER, multi):
+            _unpack_allgather(entries, arrays, result, comp,
+                              rank_counts)
         return Status.OK()
 
     # -- broadcast -------------------------------------------------------

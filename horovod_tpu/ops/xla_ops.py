@@ -287,19 +287,30 @@ class XlaMeshBackend(CollectiveBackend):
             offset += n
         return self._complete(entries)
 
-    # -- allgather (variable dim0 via pad + slice) -----------------------
+    # -- allgather (variable dim0 via pad + slice; fused multi-entry) ----
     def execute_allgather(self, entries, response: Response) -> Status:
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
 
-        (entry,) = entries
-        x = entry.tensor
-        dim0_sizes = response.tensor_sizes
-        max_dim0 = max(dim0_sizes)
-        pad = max_dim0 - x.shape[0]
-        if pad:
-            x = jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
+        size = self._size_fn()
+        sizes = response.tensor_sizes  # entry-major: [ec*size + rc]
+        # Pad every entry to its own max dim-0, flatten, concatenate:
+        # one all_gather moves the whole fused batch — the TPU
+        # rendering of the reference's fused MPI_Allgatherv
+        # (reference: mpi_operations.cc:95-173).
+        max_dim0s, slices, flats = [], [], []
+        for ec, e in enumerate(entries):
+            x = e.tensor
+            rows = sizes[ec * size:(ec + 1) * size]
+            m = max(rows)
+            pad = m - x.shape[0]
+            if pad:
+                x = jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
+            max_dim0s.append(m)
+            slices.append(tuple(x.shape[1:]))
+            flats.append(jnp.ravel(x))
+        flat = (jnp.concatenate(flats) if len(flats) > 1 else flats[0])
 
         hier = (self._mesh2d is not None and getattr(
             self._config, "hierarchical_allgather", False))
@@ -318,23 +329,53 @@ class XlaMeshBackend(CollectiveBackend):
                 return g.reshape((cross_size * local_size,) + t.shape)
 
             out = self._run_shard_op(
-                "allgather_hier", x, P(), body,
-                extra=(tuple(dim0_sizes),), mesh=self._mesh2d,
+                "allgather_hier", flat, P(), body,
+                extra=(tuple(sizes),), mesh=self._mesh2d,
                 axes=("cross", "local"))
         else:
             def body(t):
                 return jax.lax.all_gather(t, _AXIS)
 
-            out = self._run_shard_op("allgather", x, P(), body,
-                                     extra=(tuple(dim0_sizes),))
-        # out: [size, max_dim0, ...] replicated; slice each rank's real rows
+            out = self._run_shard_op("allgather", flat, P(), body,
+                                     extra=(tuple(sizes),))
+        # out: [size, sum(max_dim0_e*slice_e)] replicated; for each
+        # entry slice each rank's real rows out of its padded block.
         g = out.addressable_data(0)
-        parts = [g[r][:dim0_sizes[r]] for r in range(len(dim0_sizes))]
-        entry.output = jax.device_put(jnp.concatenate(parts, axis=0))
+        ent_off = 0
+        for ec, e in enumerate(entries):
+            rows = sizes[ec * size:(ec + 1) * size]
+            slice_shape = slices[ec]
+            slice_numel = 1
+            for d in slice_shape:
+                slice_numel *= d
+            block = max_dim0s[ec] * slice_numel
+            parts = [
+                g[r][ent_off:ent_off + rows[r] * slice_numel].reshape(
+                    (rows[r],) + slice_shape)
+                for r in range(size)]
+            e.output = jax.device_put(
+                jnp.concatenate(parts, axis=0) if size > 1
+                else parts[0])
+            ent_off += block
         return self._complete(entries)
 
-    # -- broadcast (masked psum) ----------------------------------------
+    # -- broadcast (ncclBcast role, two renderings) ----------------------
     def execute_broadcast(self, entries, response: Response) -> Status:
+        """Fills the ncclBcast role (reference:
+        nccl_operations.cc:334-351). Two renderings, selected by
+        HOROVOD_XLA_BCAST (no native one-to-all collective exists at
+        the jax level — ppermute forbids multicast sources):
+
+        * ``psum`` (default): mask to the root's contribution and
+          psum. One fused, pipelined collective; ~2x payload per link
+          (allreduce bandwidth) but single-round. Measured fastest on
+          8-way worlds (benchmarks/collective_bench.py
+          broadcast_rendering).
+        * ``tree``: binary-tree ppermute chain; every device receives
+          the payload exactly once (N-1 transfers over the fabric vs
+          the psum's ~2N) at ceil(log2 N) sequential rounds of
+          latency. Wins on small worlds / congested fabrics.
+        """
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
@@ -342,15 +383,36 @@ class XlaMeshBackend(CollectiveBackend):
         (entry,) = entries
         x = entry.tensor
         root = entry.root_rank
+        size = self._size_fn()
         flat = jnp.ravel(x)  # 0-d scalars are legal for broadcast
+        rendering = getattr(self._config, "xla_broadcast", "psum") \
+            if self._config is not None else "psum"
 
-        def body(t):
-            idx = jax.lax.axis_index(_AXIS)
-            contrib = jnp.where(idx == root, t, jnp.zeros_like(t))
-            return jax.lax.psum(contrib, _AXIS)
+        if rendering == "tree":
+            def body(t):
+                idx = jax.lax.axis_index(_AXIS)
+                v = (idx - root) % size  # virtual index: root is 0
+                cur = t
+                k = 1
+                while k < size:
+                    perm = [((u + root) % size, (u + k + root) % size)
+                            for u in range(k) if u + k < size]
+                    received = jax.lax.ppermute(cur, _AXIS, perm=perm)
+                    cur = jnp.where((v >= k) & (v < 2 * k), received,
+                                    cur)
+                    k *= 2
+                return cur
 
-        out = self._run_shard_op("broadcast", flat, P(), body,
-                                 extra=(root,))
+            out = self._run_shard_op("broadcast", flat, P(_AXIS), body,
+                                     extra=(root, "tree"))
+        else:
+            def body(t):
+                idx = jax.lax.axis_index(_AXIS)
+                contrib = jnp.where(idx == root, t, jnp.zeros_like(t))
+                return jax.lax.psum(contrib, _AXIS)
+
+            out = self._run_shard_op("broadcast", flat, P(), body,
+                                     extra=(root, "psum"))
         entry.output = jax.device_put(
             out.addressable_data(0).reshape(x.shape))
         return self._complete(entries)

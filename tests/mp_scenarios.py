@@ -382,6 +382,109 @@ def scenario_grouped_allreduce(hvd, rank, size):
     np.testing.assert_allclose(ok[0], float(size))
 
 
+def _record_batches(hvd):
+    """Wrap the runtime's op dispatch to record every executed batch as
+    (response_type_name, [tensor_names]) — lets scenarios assert HOW
+    work was batched, not just that values are right."""
+    from horovod_tpu.common import basics as _b
+    rt = _b.runtime()
+    seen = []
+    orig = rt.op_manager.execute
+
+    def wrapped(entries, response):
+        seen.append((response.response_type.name,
+                     list(response.tensor_names)))
+        return orig(entries, response)
+
+    rt.op_manager.execute = wrapped
+    return seen
+
+
+def scenario_fused_allgather(hvd, rank, size):
+    """ALLGATHER responses fuse under the threshold like allreduce
+    (reference: operations.cc:1172-1234): several small allgathers
+    submitted together execute as multi-entry batches on every
+    backend, with entry-major displacement unpack and variable dim-0
+    per rank preserved per entry."""
+    seen = _record_batches(hvd)
+
+    handles, specs = [], []
+    for i in range(6):
+        # distinct slice shapes AND variable dim-0 per rank
+        rows = rank + 1 + (i % 2)
+        x = np.full((rows, i + 1), float(rank * 10 + i), np.float32)
+        specs.append((rows, i + 1))
+        handles.append(hvd.allgather_async(x, name=f"fag.{i}"))
+    for i, h in enumerate(handles):
+        out = hvd.synchronize(h)
+        total_rows = sum(r + 1 + (i % 2) for r in range(size))
+        assert out.shape == (total_rows, i + 1), (i, out.shape)
+        off = 0
+        for r in range(size):
+            rr = r + 1 + (i % 2)
+            np.testing.assert_allclose(
+                out[off:off + rr], np.full((rr, i + 1),
+                                           float(r * 10 + i)))
+            off += rr
+
+    ag_batches = [names for kind, names in seen if kind == "ALLGATHER"]
+    assert any(len(b) >= 2 for b in ag_batches), \
+        f"no fused allgather batch executed: {ag_batches}"
+
+    # an int64 allgather must NOT fuse into a float32 batch
+    seen.clear()
+    h1 = hvd.allgather_async(np.full((2, 2), rank, np.float32),
+                             name="fag.f32")
+    h2 = hvd.allgather_async(np.full((2, 2), rank, np.int64),
+                             name="fag.i64")
+    hvd.synchronize(h1), hvd.synchronize(h2)
+    for kind, names in seen:
+        if kind == "ALLGATHER" and len(names) > 1:
+            raise AssertionError(f"mixed-dtype allgather fused: {names}")
+
+
+def scenario_grouped_atomic(hvd, rank, size):
+    """Grouped allreduce atomicity is a guarantee, not best-effort:
+    all members land in ONE fused response even with the default
+    1 ms cycle ticking concurrently and another thread spamming its
+    own singles (Runtime.enqueue_group holds the table lock across
+    the whole insert)."""
+    import threading
+
+    seen = _record_batches(hvd)
+
+    def spam():
+        # Fixed count on every rank: a collective only some ranks
+        # submit would deadlock the world (blocking allreduce paces
+        # all ranks through the same 50 names).
+        for i in range(50):
+            hvd.allreduce(np.full(8, float(rank + 1), np.float32),
+                          average=False, name=f"spam.{i}")
+
+    spammer = threading.Thread(target=spam)
+    spammer.start()
+    try:
+        for round_ in range(5):
+            group = [np.full(16, float(rank + 1) * (i + 1), np.float32)
+                     for i in range(8)]
+            outs = hvd.grouped_allreduce(group, average=False,
+                                         name=f"atom.{round_}")
+            ssum = sum(range(1, size + 1))
+            for i, o in enumerate(outs):
+                np.testing.assert_allclose(o, ssum * (i + 1.0))
+            want = {f"atom.{round_}.{i}" for i in range(8)}
+            batches = [set(names) for kind, names in seen
+                       if kind == "ALLREDUCE"]
+            containing = [b for b in batches if b & want]
+            assert len(containing) == 1 and want <= containing[0], \
+                f"group {round_} split across batches: " \
+                f"{[sorted(b & want) for b in containing]}"
+    finally:
+        spammer.join()
+    # spam thread's own collectives must drain before shutdown
+    hvd.barrier(name="atom.done")
+
+
 def scenario_coordinator_fuzz(hvd, rank, size):
     """Randomized negotiation fuzz — the framework's race-detection
     analog (SURVEY §5: the coordinator protocol is what turns racy
@@ -830,7 +933,9 @@ def scenario_shm_hier_allreduce(hvd, rank, size):
 def scenario_timeline(hvd, rank, size):
     """Drive one of each collective so rank 0's timeline (enabled via
     HOROVOD_TIMELINE in the harness env) records the full vocabulary
-    (reference: test/test_timeline.py:42-58)."""
+    (reference: test/test_timeline.py:42-58), including the fusion
+    memcpy sub-activities a fused batch emits on the host planes
+    (reference: mpi_operations.cc:35-62)."""
     x = np.full(64, float(rank + 1), np.float32)
     out = hvd.allreduce(x, average=False, name="tl.ar")
     np.testing.assert_allclose(out, sum(range(1, size + 1)))
@@ -838,6 +943,14 @@ def scenario_timeline(hvd, rank, size):
                       name="tl.ag")
     assert g.shape[0] == sum(r + 1 for r in range(size))
     hvd.broadcast(x, root_rank=0, name="tl.bc")
+    # grouped members are guaranteed one fused batch -> the pack/unpack
+    # memcpy spans are emitted deterministically
+    outs = hvd.grouped_allreduce(
+        [np.full(16, float(rank + 1) * (i + 1), np.float32)
+         for i in range(3)], average=False, name="tl.grp")
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(
+            o, sum(range(1, size + 1)) * (i + 1.0))
 
 
 def scenario_shm_fallback(hvd, rank, size):
@@ -1464,13 +1577,43 @@ def scenario_xla_backend(hvd_mod, rank, size):
             np.asarray(hvd_mod.synchronize(h)), ssum * (i + 1),
             rtol=1e-6)
 
-    # broadcast with non-zero root + allgather
-    b = jnp.full((3,), float(rank), jnp.float32)
-    out = hvd_mod.broadcast(b, root_rank=1, name="xla.bc")
-    np.testing.assert_allclose(np.asarray(out), 1.0)
+    # broadcast with non-zero root (one-to-all collective-permute
+    # path) — every root must deliver its own values
+    for root in range(size):
+        b = jnp.full((3,), float(rank * 10), jnp.float32)
+        out = hvd_mod.broadcast(b, root_rank=root,
+                                name=f"xla.bc/{root}")
+        np.testing.assert_allclose(np.asarray(out), float(root * 10))
+    # 0-d scalar broadcast rides the same path
+    s = hvd_mod.broadcast(jnp.asarray(float(rank + 7)), root_rank=1,
+                          name="xla.bc0d")
+    np.testing.assert_allclose(np.asarray(s), 8.0)
+
     g = hvd_mod.allgather(
         jnp.full((rank + 1, 2), float(rank), jnp.float32), name="xla.ag")
     assert np.asarray(g).shape == (sum(range(1, size + 1)) + 0, 2) or         np.asarray(g).shape[0] == sum(r + 1 for r in range(size))
+
+    # fused multi-entry allgather on the mesh: several variable-dim0
+    # gathers submitted together execute as one padded all_gather +
+    # per-entry slice (multi-entry execute_allgather)
+    seen = _record_batches(hvd_mod)
+    hs = [hvd_mod.allgather_async(
+        jnp.full((rank + 1 + (i % 2), i + 1), float(rank * 10 + i),
+                 jnp.float32), name=f"xla.fag.{i}") for i in range(6)]
+    for i, h in enumerate(hs):
+        out = np.asarray(hvd_mod.synchronize(h))
+        total_rows = sum(r + 1 + (i % 2) for r in range(size))
+        assert out.shape == (total_rows, i + 1), (i, out.shape)
+        off = 0
+        for r in range(size):
+            rr = r + 1 + (i % 2)
+            np.testing.assert_allclose(
+                out[off:off + rr],
+                np.full((rr, i + 1), float(r * 10 + i)))
+            off += rr
+    ag_batches = [names for kind, names in seen if kind == "ALLGATHER"]
+    assert any(len(b) >= 2 for b in ag_batches), \
+        f"no fused xla allgather batch: {ag_batches}"
 
 
 def scenario_xla_hierarchical(hvd_mod, rank, size):

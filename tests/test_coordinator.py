@@ -152,15 +152,51 @@ class TestFusion:
             dtypes, fusion_threshold_bytes=100)
         assert [f.tensor_names for f in fused] == [["a", "c"], ["b"]]
 
-    def test_non_allreduce_not_fused(self):
+    def _ag(self, name, rows):
+        return Response(response_type=ResponseType.ALLGATHER,
+                        tensor_names=[name], devices=[-1, -1],
+                        tensor_sizes=list(rows))
+
+    def test_allgather_does_not_fuse_into_allreduce(self):
         dtypes = {"a": DataType.FLOAT32, "g": DataType.FLOAT32,
                   "b": DataType.FLOAT32}
-        ag = Response(response_type=ResponseType.ALLGATHER,
-                      tensor_names=["g"], devices=[-1, -1],
-                      tensor_sizes=[3, 4])
-        fused = fuse_responses([self._ar("a", 10), ag, self._ar("b", 10)],
-                               dtypes, fusion_threshold_bytes=1 << 20)
+        fused = fuse_responses(
+            [self._ar("a", 10), self._ag("g", [3, 4]), self._ar("b", 10)],
+            dtypes, fusion_threshold_bytes=1 << 20,
+            slice_numels={"g": 1})
         assert [f.tensor_names for f in fused] == [["a", "b"], ["g"]]
+
+    def test_allgather_fusion(self):
+        """ALLGATHER responses fuse like allreduce, with entry-major
+        tensor_sizes and dim0-sum × slice-numel byte accounting
+        (reference: operations.cc:1172-1234)."""
+        dtypes = {"g1": DataType.FLOAT32, "g2": DataType.FLOAT32}
+        fused = fuse_responses(
+            [self._ag("g1", [3, 4]), self._ag("g2", [2, 5])],
+            dtypes, fusion_threshold_bytes=1 << 20,
+            slice_numels={"g1": 8, "g2": 8})
+        assert len(fused) == 1
+        assert fused[0].tensor_names == ["g1", "g2"]
+        # entry-major: g1's per-rank rows then g2's
+        assert fused[0].tensor_sizes == [3, 4, 2, 5]
+
+    def test_allgather_fusion_respects_output_bytes(self):
+        # g1 output: (3+4) rows × 8 el × 4 B = 224 B; g2: 224 B.
+        # Threshold 300 B admits one but not both.
+        dtypes = {"g1": DataType.FLOAT32, "g2": DataType.FLOAT32}
+        fused = fuse_responses(
+            [self._ag("g1", [3, 4]), self._ag("g2", [3, 4])],
+            dtypes, fusion_threshold_bytes=300,
+            slice_numels={"g1": 8, "g2": 8})
+        assert len(fused) == 2
+
+    def test_allgather_fusion_mixed_dtype_splits(self):
+        dtypes = {"g1": DataType.FLOAT32, "g2": DataType.FLOAT64}
+        fused = fuse_responses(
+            [self._ag("g1", [1, 1]), self._ag("g2", [1, 1])],
+            dtypes, fusion_threshold_bytes=1 << 20,
+            slice_numels={"g1": 4, "g2": 4})
+        assert len(fused) == 2
 
     def test_error_responses_pass_through(self):
         err = Response(response_type=ResponseType.ERROR,

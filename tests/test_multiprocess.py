@@ -276,6 +276,23 @@ def test_grouped_allreduce(plane):
     run_scenario("grouped_allreduce", 3, timeout=120.0, extra_env=extra)
 
 
+@pytest.mark.parametrize("plane", ["shm", "socket"])
+def test_fused_allgather(plane):
+    """ALLGATHER response fusion: multi-entry batches execute with
+    entry-major displacement unpack on both host planes; mixed dtypes
+    never share a batch."""
+    extra = {"HOROVOD_CYCLE_TIME": "25"}
+    if plane == "socket":
+        extra["HOROVOD_TPU_SHM"] = "0"
+    run_scenario("fused_allgather", 3, timeout=120.0, extra_env=extra)
+
+
+def test_grouped_allreduce_atomic():
+    """All group members land in ONE fused response even with the
+    1 ms cycle ticking and a concurrent thread submitting singles."""
+    run_scenario("grouped_atomic", 2, timeout=180.0)
+
+
 @pytest.mark.parametrize("plane,ranks", [
     ("shm", 3), ("socket", 3), ("shm", 6)])
 def test_coordinator_fuzz(plane, ranks):
@@ -409,6 +426,14 @@ def test_checkpoint_resume(tmp_path_factory):
 def test_xla_mesh_backend():
     """Real multi-process JAX CPU world -> XlaMeshBackend data plane."""
     run_scenario("xla_backend", 2, timeout=180.0)
+
+
+def test_xla_mesh_backend_tree_broadcast():
+    """HOROVOD_XLA_BCAST=tree: the binary-tree ppermute broadcast
+    rendering delivers every root's values (3 ranks exercises the
+    non-power-of-two round structure)."""
+    run_scenario("xla_backend", 3, timeout=240.0,
+                 extra_env={"HOROVOD_XLA_BCAST": "tree"})
 
 
 def test_xla_hierarchical_allreduce():

@@ -41,6 +41,13 @@ def _assert_vocabulary(events, expect_ranks):
     # cycle markers (HOROVOD_TIMELINE_MARK_CYCLES)
     cycle = [e for e in events if e.get("name") == "CYCLE_START"]
     assert cycle and all(e["ph"] == "i" for e in cycle)
+    if expect_ranks > 1:
+        # fused batches wrap their pack/unpack in memcpy activities
+        # (reference: mpi_operations.cc:35-62); the scenario's grouped
+        # allreduce guarantees one fused multi-entry batch
+        assert "MEMCPY_IN_FUSION_BUFFER" in names, \
+            sorted(set(n for n in names if n and "MEMCPY" in n))
+        assert "MEMCPY_OUT_FUSION_BUFFER" in names
     # per-tensor trace processes carry the tensor names
     proc_names = {e["args"]["name"] for e in events
                   if e.get("name") == "process_name"}
