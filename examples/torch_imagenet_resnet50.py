@@ -1,0 +1,217 @@
+"""ImageNet-scale ResNet-50 training with the torch adapter
+(reference: examples/pytorch_imagenet_resnet50.py — fp16 allreduce
+compression, batches-per-allreduce gradient accumulation, linear LR
+warmup per arXiv:1706.02677, rank-0 checkpointing with broadcast
+resume).
+
+Data is synthetic ImageNet-shaped by default (this benchmark harness
+is what BASELINE.json's configs sweep); point --train-dir at an
+ImageFolder-style tree to train on real data if torchvision is
+available.
+
+Run:  python -m horovod_tpu.run -np 8 python \
+          examples/torch_imagenet_resnet50.py --fp16-allreduce
+"""
+
+import argparse
+import math
+import os
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Bottleneck(nn.Module):
+    """Standard ResNet v1.5 bottleneck (1x1 -> 3x3(stride) -> 1x1)."""
+
+    expansion = 4
+
+    def __init__(self, cin, planes, stride=1):
+        super().__init__()
+        cout = planes * self.expansion
+        self.conv1 = nn.Conv2d(cin, planes, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(planes)
+        self.conv2 = nn.Conv2d(planes, planes, 3, stride=stride,
+                               padding=1, bias=False)
+        self.bn2 = nn.BatchNorm2d(planes)
+        self.conv3 = nn.Conv2d(planes, cout, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(cout)
+        self.down = None
+        if stride != 1 or cin != cout:
+            self.down = nn.Sequential(
+                nn.Conv2d(cin, cout, 1, stride=stride, bias=False),
+                nn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        y = F.relu(self.bn1(self.conv1(x)))
+        y = F.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
+        s = x if self.down is None else self.down(x)
+        return F.relu(y + s)
+
+
+class ResNet50(nn.Module):
+    """ResNet-50: [3, 4, 6, 3] bottleneck stages (the reference uses
+    torchvision.models.resnet50; this is the same architecture,
+    self-contained)."""
+
+    def __init__(self, num_classes=1000, width=64):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2d(3, width, 7, stride=2, padding=3, bias=False),
+            nn.BatchNorm2d(width), nn.ReLU(inplace=True),
+            nn.MaxPool2d(3, stride=2, padding=1))
+        layers = []
+        cin = width
+        for planes, blocks, stride in ((width, 3, 1), (width * 2, 4, 2),
+                                       (width * 4, 6, 2),
+                                       (width * 8, 3, 2)):
+            for b in range(blocks):
+                layers.append(Bottleneck(cin, planes,
+                                         stride if b == 0 else 1))
+                cin = planes * Bottleneck.expansion
+        self.body = nn.Sequential(*layers)
+        self.head = nn.Linear(cin, num_classes)
+
+    def forward(self, x):
+        x = self.body(self.stem(x))
+        x = F.adaptive_avg_pool2d(x, 1).flatten(1)
+        return self.head(x)
+
+
+def synthetic_batches(rank, n, batch, image_size, num_classes):
+    rng = np.random.RandomState(1000 + rank)
+    for _ in range(n):
+        x = torch.from_numpy(
+            rng.rand(batch, 3, image_size, image_size).astype(np.float32))
+        y = torch.from_numpy(rng.randint(0, num_classes, batch))
+        yield x, y
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description="ResNet-50 ImageNet training (horovod_tpu torch)")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--batches-per-allreduce", type=int, default=1,
+                   help="local gradient-accumulation sub-batches per "
+                        "allreduce; multiplies the effective batch")
+    p.add_argument("--epochs", type=int, default=90)
+    p.add_argument("--steps-per-epoch", type=int, default=16,
+                   help="synthetic batches per epoch")
+    p.add_argument("--base-lr", type=float, default=0.0125)
+    p.add_argument("--warmup-epochs", type=float, default=5)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--wd", type=float, default=5e-5)
+    p.add_argument("--fp16-allreduce", action="store_true",
+                   help="fp16 compression on the gradient wire")
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--width", type=int, default=64,
+                   help="stem width (64 = real ResNet-50; smaller for "
+                        "smoke tests)")
+    p.add_argument("--checkpoint-format",
+                   default="./checkpoint-{epoch}.pth.tar")
+    p.add_argument("--seed", type=int, default=42)
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(args.seed)
+    verbose = hvd.rank() == 0
+
+    # Resume from the newest checkpoint rank 0 can see; the epoch is
+    # broadcast so every rank agrees (reference behavior).
+    resume_from_epoch = 0
+    for try_epoch in range(args.epochs, 0, -1):
+        if os.path.exists(args.checkpoint_format.format(epoch=try_epoch)):
+            resume_from_epoch = try_epoch
+            break
+    resume_from_epoch = int(hvd.broadcast(
+        torch.tensor(resume_from_epoch), root_rank=0,
+        name="resume_from_epoch").item())
+
+    model = ResNet50(args.num_classes, width=args.width)
+    # LR scaled by world size AND accumulation factor
+    # (arXiv:1706.02677 linear scaling rule).
+    optimizer = torch.optim.SGD(
+        model.parameters(),
+        lr=args.base_lr * args.batches_per_allreduce * hvd.size(),
+        momentum=args.momentum, weight_decay=args.wd)
+
+    if resume_from_epoch > 0 and hvd.rank() == 0:
+        ckpt = torch.load(
+            args.checkpoint_format.format(epoch=resume_from_epoch),
+            weights_only=True)
+        model.load_state_dict(ckpt["model"])
+        optimizer.load_state_dict(ckpt["optimizer"])
+
+    # Rank 0's (possibly restored) weights and optimizer state become
+    # everyone's; broadcast_optimizer_state materializes worker state
+    # when only rank 0 restored (the asymmetric shape).
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    compression = (hvd.Compression.fp16 if args.fp16_allreduce
+                   else hvd.Compression.none)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        compression=compression,
+        backward_passes_per_step=args.batches_per_allreduce)
+
+    def adjust_lr(epoch, batch_idx):
+        """Warmup 1/N -> 1 over warmup_epochs, then /10 at 30/60/80."""
+        if epoch < args.warmup_epochs:
+            ep = epoch + float(batch_idx + 1) / args.steps_per_epoch
+            adj = (1.0 / hvd.size()
+                   * (ep * (hvd.size() - 1) / args.warmup_epochs + 1))
+        elif epoch < 30:
+            adj = 1.0
+        elif epoch < 60:
+            adj = 1e-1
+        elif epoch < 80:
+            adj = 1e-2
+        else:
+            adj = 1e-3
+        lr = (args.base_lr * hvd.size() * args.batches_per_allreduce
+              * adj)
+        for group in optimizer.param_groups:
+            group["lr"] = lr
+
+    model.train()
+    sub = args.batch_size
+    for epoch in range(resume_from_epoch, args.epochs):
+        batches = synthetic_batches(
+            hvd.rank(), args.steps_per_epoch,
+            sub * args.batches_per_allreduce, args.image_size,
+            args.num_classes)
+        for batch_idx, (data, target) in enumerate(batches):
+            adjust_lr(epoch, batch_idx)
+            optimizer.zero_grad()
+            n_sub = math.ceil(len(data) / sub)
+            for i in range(0, len(data), sub):
+                loss = F.cross_entropy(model(data[i:i + sub]),
+                                       target[i:i + sub])
+                # average gradients over the local sub-batches
+                (loss / n_sub).backward()
+            optimizer.step()
+        # Epoch metrics averaged over ranks, like the reference's
+        # Metric helper (allreduce of the running average).
+        avg_loss = hvd.allreduce(loss.detach(),
+                                 name="train_loss").item()
+        if verbose:
+            print(f"epoch {epoch + 1}/{args.epochs}: "
+                  f"loss {avg_loss:.4f} "
+                  f"lr {optimizer.param_groups[0]['lr']:.5f}")
+        if hvd.rank() == 0:
+            torch.save(
+                {"model": model.state_dict(),
+                 "optimizer": optimizer.state_dict()},
+                args.checkpoint_format.format(epoch=epoch + 1))
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

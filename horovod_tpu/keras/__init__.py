@@ -39,6 +39,31 @@ def _distributed_class(cls, compression, op: int):
         return np.asarray(compression.decompress(np.asarray(out), ctx),
                           dtype=host.dtype)
 
+    def _reduce_sparse(g, idx: int, tf):
+        """IndexedSlices (embedding gradients) take the allgather path
+        like the reference (reference:
+        horovod/tensorflow/__init__.py:72-83): gather every rank's
+        (values, indices); averaging divides values by size — repeated
+        indices sum on scatter, which IS the correct average of the
+        dense equivalent. Works traced or eager (py_function executes
+        immediately under eager)."""
+        def _host(v, i):
+            vals = np.asarray(_ops.allgather(
+                v.numpy(), name=f"keras.grad.{idx}.values"))
+            inds = np.asarray(_ops.allgather(
+                i.numpy(), name=f"keras.grad.{idx}.indices"))
+            if op == Average:
+                vals = (vals / size()).astype(vals.dtype)
+            return vals, inds
+
+        vals, inds = tf.py_function(
+            _host, [g.values, g.indices],
+            Tout=(g.values.dtype, g.indices.dtype))
+        vals.set_shape([None] + list(g.values.shape[1:]))
+        inds.set_shape([None])
+        return tf.IndexedSlices(vals, inds,
+                                dense_shape=g.dense_shape)
+
     def _reduce_tensor(g, idx: int):
         """Average one gradient. ``model.fit`` traces apply_gradients
         inside the backend's jit (tf.function / jax.jit), so the host
@@ -48,6 +73,8 @@ def _distributed_class(cls, compression, op: int):
         backend = keras.backend.backend()
         if backend == "tensorflow":
             import tensorflow as tf
+            if isinstance(g, tf.IndexedSlices):
+                return _reduce_sparse(g, idx, tf)
             if not tf.executing_eagerly():
                 out = tf.py_function(
                     lambda t: _host_allreduce(t.numpy(), idx), [g],

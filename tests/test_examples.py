@@ -87,6 +87,63 @@ def test_zero_fsdp():
     assert "ZeRO-1" in out and "FSDP" in out
 
 
+def test_torch_imagenet_resnet50(tmp_path):
+    """ImageNet-scale torch example (fp16 allreduce + gradient
+    accumulation + warmup + checkpoint/resume), smoke-sized."""
+    ckpt = str(tmp_path / "checkpoint-{epoch}.pth.tar")
+    out = _run("torch_imagenet_resnet50.py", "--epochs", "1",
+               "--steps-per-epoch", "2", "--batch-size", "2",
+               "--batches-per-allreduce", "2", "--image-size", "32",
+               "--num-classes", "10", "--width", "8",
+               "--fp16-allreduce", "--checkpoint-format", ckpt)
+    assert "loss" in out.lower()
+    assert os.path.exists(ckpt.format(epoch=1))
+    # resume path: epoch 1 checkpoint found -> trains epoch 2 only
+    out = _run("torch_imagenet_resnet50.py", "--epochs", "2",
+               "--steps-per-epoch", "2", "--batch-size", "2",
+               "--image-size", "32", "--num-classes", "10",
+               "--width", "8", "--checkpoint-format", ckpt)
+    assert "epoch 2/2" in out and "epoch 1/2" not in out
+
+
+def test_keras_imagenet_resnet50(tmp_path):
+    """ImageNet-scale keras example: warmup + staged-decay callbacks,
+    metric averaging, fusion-threshold sweep knob."""
+    out = _run("keras_imagenet_resnet50.py", "--epochs", "1",
+               "--steps-per-epoch", "2", "--batch-size", "2",
+               "--image-size", "32", "--num-classes", "10",
+               "--fusion-threshold", str(1 << 20), "--fp16-allreduce",
+               "--checkpoint-dir", str(tmp_path), timeout=600)
+    assert "loss" in out.lower()
+
+
+def test_keras_mnist_advanced():
+    """Warmup + LR schedule + MetricAverage composed in one fit."""
+    out = _run("keras_mnist_advanced.py", "--epochs", "3",
+               "--warmup-epochs", "1", "--batch-size", "128")
+    assert "lr trajectory" in out and "val_loss" in out
+
+
+def test_keras_spark_training():
+    """End-to-end Spark workflow in fake-pyspark demo mode: driver
+    dataset -> spark.run training -> driver-side scoring."""
+    env_extra = {"HVD_FAKE_PYSPARK": "1"}
+    env = dict(os.environ)
+    env.update(env_extra)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HOROVOD_CYCLE_TIME"] = "1"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EX, "keras_spark_training.py"),
+         "--num-proc", "2"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert proc.returncode == 0, (
+        f"keras_spark_training.py failed\n--- stdout ---\n"
+        f"{proc.stdout[-3000:]}\n--- stderr ---\n{proc.stderr[-3000:]}")
+    assert "holdout RMSE" in proc.stdout
+
+
 def test_tensorflow_word2vec():
     out = _run("tensorflow_word2vec.py", "--steps", "60")
     assert "IndexedSlices" in out
@@ -103,5 +160,7 @@ def test_every_example_is_covered(script):
         "keras_mnist.py", "jax_synthetic_benchmark.py",
         "transformer_long_context.py", "moe_pipeline_parallel.py",
         "zero_fsdp.py", "tensorflow_word2vec.py",
+        "torch_imagenet_resnet50.py", "keras_imagenet_resnet50.py",
+        "keras_mnist_advanced.py", "keras_spark_training.py",
     }
     assert script in covered, f"add a smoke test for examples/{script}"
