@@ -76,17 +76,21 @@ def main():
         # 30/60/80 like the reference example
         hvd.callbacks.LearningRateWarmupCallback(
             warmup_epochs=args.warmup_epochs, verbose=verbose),
+        # Explicit initial_lr: without it the callback would autodetect
+        # from the optimizer AFTER warmup already scaled it by size,
+        # double-applying the size factor (base*size^2).
         hvd.callbacks.LearningRateScheduleCallback(
-            multiplier=hvd.size() * 1.0,
+            multiplier=hvd.size() * 1.0, initial_lr=args.base_lr,
             start_epoch=args.warmup_epochs, end_epoch=30),
         hvd.callbacks.LearningRateScheduleCallback(
-            multiplier=hvd.size() * 1e-1, start_epoch=30,
-            end_epoch=60),
+            multiplier=hvd.size() * 1e-1, initial_lr=args.base_lr,
+            start_epoch=30, end_epoch=60),
         hvd.callbacks.LearningRateScheduleCallback(
-            multiplier=hvd.size() * 1e-2, start_epoch=60,
-            end_epoch=80),
+            multiplier=hvd.size() * 1e-2, initial_lr=args.base_lr,
+            start_epoch=60, end_epoch=80),
         hvd.callbacks.LearningRateScheduleCallback(
-            multiplier=hvd.size() * 1e-3, start_epoch=80),
+            multiplier=hvd.size() * 1e-3, initial_lr=args.base_lr,
+            start_epoch=80),
     ]
     if hvd.rank() == 0:
         os.makedirs(args.checkpoint_dir, exist_ok=True)

@@ -67,11 +67,15 @@ def main():
         hvd.callbacks.MetricAverageCallback(),
         hvd.callbacks.LearningRateWarmupCallback(
             warmup_epochs=args.warmup_epochs, verbose=verbose),
+        # Explicit initial_lr: without it the callback would autodetect
+        # from the optimizer AFTER warmup already scaled it by size,
+        # double-applying the size factor (base*size^2).
         hvd.callbacks.LearningRateScheduleCallback(
-            multiplier=hvd.size() * 1.0,
+            multiplier=hvd.size() * 1.0, initial_lr=args.base_lr,
             start_epoch=args.warmup_epochs, end_epoch=half),
         hvd.callbacks.LearningRateScheduleCallback(
-            multiplier=hvd.size() * 1e-1, start_epoch=half),
+            multiplier=hvd.size() * 1e-1, initial_lr=args.base_lr,
+            start_epoch=half),
     ]
     ckpt_dir = args.checkpoint_dir
     if hvd.rank() == 0:

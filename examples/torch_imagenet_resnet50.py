@@ -187,6 +187,7 @@ def main():
             hvd.rank(), args.steps_per_epoch,
             sub * args.batches_per_allreduce, args.image_size,
             args.num_classes)
+        loss_sum, loss_count = 0.0, 0
         for batch_idx, (data, target) in enumerate(batches):
             adjust_lr(epoch, batch_idx)
             optimizer.zero_grad()
@@ -194,13 +195,16 @@ def main():
             for i in range(0, len(data), sub):
                 loss = F.cross_entropy(model(data[i:i + sub]),
                                        target[i:i + sub])
+                loss_sum += loss.item()
+                loss_count += 1
                 # average gradients over the local sub-batches
                 (loss / n_sub).backward()
             optimizer.step()
-        # Epoch metrics averaged over ranks, like the reference's
-        # Metric helper (allreduce of the running average).
-        avg_loss = hvd.allreduce(loss.detach(),
-                                 name="train_loss").item()
+        # Epoch metric averaged over sub-batches AND ranks, like the
+        # reference's Metric helper (allreduce of the running average).
+        avg_loss = hvd.allreduce(
+            torch.tensor(loss_sum / max(loss_count, 1)),
+            name="train_loss").item()
         if verbose:
             print(f"epoch {epoch + 1}/{args.epochs}: "
                   f"loss {avg_loss:.4f} "

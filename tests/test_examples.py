@@ -17,10 +17,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EX = os.path.join(REPO, "examples")
 
 
-def _run(script, *args, n_devices=1, timeout=420):
+def _run(script, *args, n_devices=1, timeout=420, extra_env=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["HOROVOD_CYCLE_TIME"] = "1"
+    if extra_env:
+        env.update(extra_env)
     # Keep the TPU plugin's sitecustomize from overriding jax_platforms
     # back to the tunneled TPU (same hygiene as test_multiprocess).
     env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -127,21 +129,9 @@ def test_keras_mnist_advanced():
 def test_keras_spark_training():
     """End-to-end Spark workflow in fake-pyspark demo mode: driver
     dataset -> spark.run training -> driver-side scoring."""
-    env_extra = {"HVD_FAKE_PYSPARK": "1"}
-    env = dict(os.environ)
-    env.update(env_extra)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["HOROVOD_CYCLE_TIME"] = "1"
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    proc = subprocess.run(
-        [sys.executable, os.path.join(EX, "keras_spark_training.py"),
-         "--num-proc", "2"],
-        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
-    assert proc.returncode == 0, (
-        f"keras_spark_training.py failed\n--- stdout ---\n"
-        f"{proc.stdout[-3000:]}\n--- stderr ---\n{proc.stderr[-3000:]}")
-    assert "holdout RMSE" in proc.stdout
+    out = _run("keras_spark_training.py", "--num-proc", "2",
+               timeout=600, extra_env={"HVD_FAKE_PYSPARK": "1"})
+    assert "holdout RMSE" in out
 
 
 def test_tensorflow_word2vec():
