@@ -1,0 +1,95 @@
+"""Synthetic benchmark through the torch adapter
+(reference: examples/pytorch_synthetic_benchmark.py — img/sec mean
+± 1.96σ per device and total, --fp16-allreduce flag, warmup +
+batches-per-iter × iters timing shape).
+
+Measures the framework's HOST gradient path (torch CPU tensors staged
+through the background runtime's negotiated collectives); the
+TPU-compute benchmark with the same timing discipline is
+examples/jax_synthetic_benchmark.py / bench.py.
+
+Run:  python -m horovod_tpu.run -np 2 python \
+          examples/torch_synthetic_benchmark.py --model resnet50tiny
+"""
+
+import argparse
+import timeit
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+from torch_imagenet_resnet50 import ResNet50
+
+
+def build_model(name: str):
+    if name == "resnet50":
+        return ResNet50(num_classes=1000), 224
+    if name == "resnet50tiny":
+        # smoke-test scale: same topology, 1/8 width, small images
+        return ResNet50(num_classes=10, width=8), 32
+    raise SystemExit(f"unknown --model {name}")
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description="torch synthetic benchmark (horovod_tpu)")
+    p.add_argument("--model", default="resnet50")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--fp16-allreduce", action="store_true")
+    p.add_argument("--num-warmup-batches", type=int, default=10)
+    p.add_argument("--num-batches-per-iter", type=int, default=10)
+    p.add_argument("--num-iters", type=int, default=10)
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42)
+    model, image_size = build_model(args.model)
+
+    optimizer = torch.optim.SGD(model.parameters(), lr=0.01)
+    compression = (hvd.Compression.fp16 if args.fp16_allreduce
+                   else hvd.Compression.none)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        compression=compression)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    data = torch.randn(args.batch_size, 3, image_size, image_size)
+    target = torch.randint(0, 10, (args.batch_size,))
+
+    def benchmark_step():
+        optimizer.zero_grad()
+        loss = F.cross_entropy(model(data), target)
+        loss.backward()
+        optimizer.step()
+
+    if hvd.rank() == 0:
+        print(f"Model: {args.model}, batch size {args.batch_size}, "
+              f"{hvd.size()} process(es)")
+    timeit.timeit(benchmark_step, number=args.num_warmup_batches)
+
+    img_secs = []
+    for i in range(args.num_iters):
+        t = timeit.timeit(benchmark_step,
+                          number=args.num_batches_per_iter)
+        img_sec = args.batch_size * args.num_batches_per_iter / t
+        if hvd.rank() == 0:
+            print(f"Iter #{i}: {img_sec:.1f} img/sec per process")
+        img_secs.append(img_sec)
+
+    # mean ± 1.96 sigma, per process and total, like the reference
+    img_sec_mean = np.mean(img_secs)
+    img_sec_conf = 1.96 * np.std(img_secs)
+    total = hvd.allreduce(torch.tensor(img_sec_mean), op=hvd.Sum,
+                          name="bench.total").item()
+    if hvd.rank() == 0:
+        print(f"Img/sec per process: {img_sec_mean:.1f} "
+              f"+-{img_sec_conf:.1f}")
+        print(f"Total img/sec on {hvd.size()} process(es): "
+              f"{total:.1f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -131,6 +131,29 @@ _DTYPE_CODES = {"float32": 0, "float64": 1, "int32": 2, "int64": 3,
                 "uint8": 4, "float16": 5, "bfloat16": 6}
 
 
+def pack(arrays):
+    """Concatenate same-dtype C-contiguous flat arrays into one fresh
+    buffer with a single native call (the reference's fusion-buffer
+    MemcpyInFusionBuffer, collective_operations.cc:35-63). Returns
+    None when the native path is unavailable (caller falls back to
+    numpy concatenation)."""
+    lib = get()
+    if lib is None or not arrays:
+        return None
+    import numpy as np
+    dtype = arrays[0].dtype
+    for a in arrays:
+        if a.dtype != dtype or not a.flags["C_CONTIGUOUS"]:
+            return None
+    n = len(arrays)
+    srcs = (ctypes.c_void_p * n)(*[a.ctypes.data for a in arrays])
+    sizes = (ctypes.c_int64 * n)(*[a.nbytes for a in arrays])
+    total = sum(a.size for a in arrays)
+    out = np.empty(total, dtype)
+    lib.hvd_pack(srcs, sizes, n, out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
 def sum_into(acc, src) -> bool:
     """acc += src elementwise via the native kernel. Returns False if
     the native path is unavailable for this dtype (caller falls back)."""

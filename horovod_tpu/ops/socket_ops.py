@@ -70,7 +70,10 @@ def _pack_fused(arrays: List[np.ndarray], response: Response):
     if len(arrays) == 1:
         flat = np.ascontiguousarray(arrays[0]).reshape(-1)
     else:
-        flat = np.concatenate([a.reshape(-1) for a in arrays])
+        flats = [np.ascontiguousarray(a).reshape(-1) for a in arrays]
+        flat = _native.pack(flats)
+        if flat is None:
+            flat = np.concatenate(flats)
     if response.prescale_factor != 1.0:
         flat = flat * np.asarray(response.prescale_factor, dtype)
         fresh = True
@@ -99,10 +102,14 @@ def _allgather_layout(entries, arrays, response: Response, size: int):
 def _pack_allgather(arrays: List[np.ndarray]) -> np.ndarray:
     """This rank's packed contribution: each entry's rows flattened,
     concatenated in entry order (the reference's allgather
-    MemcpyInFusionBuffer, collective_operations.cc:136-150)."""
+    MemcpyInFusionBuffer, collective_operations.cc:136-150). The
+    native one-call pack is preferred; numpy concatenation is the
+    fallback."""
     if len(arrays) == 1:
         return np.ascontiguousarray(arrays[0]).reshape(-1)
-    return np.concatenate([a.reshape(-1) for a in arrays])
+    flats = [np.ascontiguousarray(a).reshape(-1) for a in arrays]
+    packed = _native.pack(flats)
+    return packed if packed is not None else np.concatenate(flats)
 
 
 def _unpack_allgather(entries, arrays, result: np.ndarray, comp,

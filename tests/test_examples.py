@@ -134,6 +134,26 @@ def test_keras_spark_training():
     assert "holdout RMSE" in out
 
 
+def test_torch_synthetic_benchmark():
+    out = _run("torch_synthetic_benchmark.py", "--model",
+               "resnet50tiny", "--batch-size", "4",
+               "--num-warmup-batches", "1", "--num-batches-per-iter",
+               "1", "--num-iters", "2")
+    assert "Img/sec per process" in out and "Total img/sec" in out
+
+
+def test_tensorflow_mnist_eager():
+    out = _run("tensorflow_mnist_eager.py", "--steps", "12")
+    first, last = out.split("loss ")[-1].split(" over ")[0].split(" -> ")
+    assert float(last) < float(first)  # it actually learns
+
+
+def test_mxnet_mnist():
+    out = _run("mxnet_mnist.py", "--steps", "40",
+               extra_env={"HVD_FAKE_MXNET": "1"})
+    assert "loss" in out and "->" in out
+
+
 def test_tensorflow_word2vec():
     out = _run("tensorflow_word2vec.py", "--steps", "60")
     assert "IndexedSlices" in out
@@ -152,5 +172,7 @@ def test_every_example_is_covered(script):
         "zero_fsdp.py", "tensorflow_word2vec.py",
         "torch_imagenet_resnet50.py", "keras_imagenet_resnet50.py",
         "keras_mnist_advanced.py", "keras_spark_training.py",
+        "torch_synthetic_benchmark.py", "tensorflow_mnist_eager.py",
+        "mxnet_mnist.py",
     }
     assert script in covered, f"add a smoke test for examples/{script}"

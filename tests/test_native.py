@@ -91,6 +91,22 @@ def test_pack_unpack_roundtrip():
         np.testing.assert_array_equal(a, o)
 
 
+def test_pack_wrapper_matches_concatenate():
+    """native.pack — the fusion-buffer hot path the host planes call —
+    must equal numpy concatenation and refuse mixed dtypes."""
+    rng = np.random.RandomState(3)
+    import ml_dtypes
+    for dtype in (np.float32, np.int64, ml_dtypes.bfloat16):
+        arrays = [rng.randn(n).astype(dtype) for n in (1, 5, 64, 1000)]
+        out = native.pack(arrays)
+        assert out is not None and out.dtype == arrays[0].dtype
+        np.testing.assert_array_equal(
+            out.view(np.uint8), np.concatenate(
+                [a.view(np.uint8).reshape(-1) for a in arrays]))
+    mixed = [np.ones(3, np.float32), np.ones(3, np.float64)]
+    assert native.pack(mixed) is None  # caller falls back
+
+
 @pytest.mark.parametrize("secret", [b"", b"sharedsecret"])
 def test_frame_transport_interop(secret):
     """Native gather/broadcast must interoperate with the Python
