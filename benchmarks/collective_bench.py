@@ -283,6 +283,57 @@ def worker_overhead(rank: int, size: int) -> None:
     hvd.shutdown()
 
 
+def _coordinator_cpu_bench() -> dict:
+    """Pure-Python microbench of the coordinator's per-cycle CPU work —
+    parse N RequestLists, count readiness, construct+fuse responses,
+    serialize the ResponseList — with NO transport or scheduler in the
+    way. This is the per-rank cost that actually grows with world size
+    on the rank-0 host, free of the 1-vCPU time-share distortion that
+    inflates the world-based overhead numbers."""
+    import time as _t
+    sys.path.insert(0, REPO)
+    from horovod_tpu.common import wire
+    from horovod_tpu.common.coordinator import (
+        MessageTable, construct_response, fuse_responses)
+    from horovod_tpu.common.message import (
+        DataType, Request, RequestList, RequestType, ResponseList)
+
+    out = {}
+    for n_ranks in (8, 64, 256):
+        tensors_per_cycle = 8  # a fused step's worth of requests
+        payloads = []
+        for r in range(n_ranks):
+            reqs = [Request(request_rank=r,
+                            request_type=RequestType.ALLREDUCE,
+                            tensor_type=DataType.FLOAT32,
+                            tensor_name=f"grad.{t}", root_rank=-1,
+                            device=-1, tensor_shape=(1024,))
+                    for t in range(tensors_per_cycle)]
+            payloads.append(
+                wire.serialize_request_list(RequestList(reqs)))
+        iters = 50
+        t0 = _t.perf_counter()
+        for _ in range(iters):
+            table = MessageTable()
+            dtypes, slices = {}, {}
+            for data in payloads:
+                rl = wire.parse_request_list(data)
+                for req in rl.requests:
+                    dtypes[req.tensor_name] = req.tensor_type
+                    slices[req.tensor_name] = 1
+                    table.increment_tensor_count(req, n_ranks)
+            responses = [construct_response(table, name, n_ranks)
+                         for name in table.pop_ready()]
+            fused = fuse_responses(responses, dtypes, 64 << 20, slices)
+            wire.serialize_response_list(ResponseList(fused))
+        per_cycle_us = (_t.perf_counter() - t0) / iters * 1e6
+        out[str(n_ranks)] = {
+            "cycle_us": round(per_cycle_us, 1),
+            "us_per_rank": round(per_cycle_us / n_ranks, 2),
+        }
+    return out
+
+
 def _project_scaling(overheads: dict, step_budget_ms: float) -> dict:
     """Fit the measured control-plane overhead vs world size and
     project data-parallel scaling efficiency at pod scale.
@@ -588,10 +639,20 @@ def main() -> None:
                 except Exception:
                     pass
             projection = _project_scaling(overheads, step_budget_ms)
+            try:
+                projection["coordinator_cpu"] = _coordinator_cpu_bench()
+            except Exception as e:
+                # a microbench failure must not discard the projection
+                projection["coordinator_cpu"] = {"error": repr(e)}
             print(f"  fit {projection['fit_us']}   projected 64-chip "
                   f"efficiency "
                   f"{projection['projected']['64']['efficiency']:.1%}"
                   f" against a {step_budget_ms} ms step", flush=True)
+            cc = projection["coordinator_cpu"]
+            if "error" not in cc:
+                print("  coordinator CPU (no transport): "
+                      + "   ".join(f"np={n}: {v['cycle_us']} us/cycle"
+                                   for n, v in cc.items()), flush=True)
         except Exception as e:
             projection = {"error": repr(e)}
             print(f"  overhead projection failed: {e!r}", flush=True)

@@ -73,7 +73,7 @@ def main():
 
     first = last = None
     for step in range(args.steps):
-        lo = (step * args.batch_size) % (n - args.batch_size)
+        lo = (step * args.batch_size) % max(n - args.batch_size, 1)
         xb, yb = x[lo:lo + args.batch_size], y[lo:lo + args.batch_size]
         loss, dw, db = forward_backward(xb, yb)
         opt.update(0, w, mx.nd.array(dw), None)

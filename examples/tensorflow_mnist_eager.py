@@ -44,7 +44,7 @@ def main():
 
     first_loss = last_loss = None
     for step in range(args.steps):
-        lo = (step * args.batch_size) % (1024 - args.batch_size)
+        lo = (step * args.batch_size) % max(1024 - args.batch_size, 1)
         x = tf.constant(x_all[lo:lo + args.batch_size])
         y = tf.constant(y_all[lo:lo + args.batch_size])
         with tf.GradientTape() as tape:

@@ -45,6 +45,16 @@ _I32 = struct.Struct("<i")
 _I64 = struct.Struct("<q")
 _F64 = struct.Struct("<d")
 
+# Combined-field structs for the hot request path: the coordinator
+# parses world_size RequestLists per cycle, and per-field unpacks +
+# enum __call__ dominate that cost (measured 86% of a synthetic
+# 64-rank cycle). Same wire layout, one unpack per segment.
+_REQ_HEAD = struct.Struct("<BiBiiI")  # type|rank|dtype|root|device|namelen
+_REQ_TAIL = struct.Struct("<ddB")     # prescale|postscale|ndim
+_REQ_TYPE_OF = RequestType._value2member_map_
+_DTYPE_OF = DataType._value2member_map_
+_RESP_TYPE_OF = ResponseType._value2member_map_
+
 
 class _Writer:
     def __init__(self):
@@ -103,34 +113,48 @@ class _Reader:
 
 
 def _write_request(w: _Writer, req: Request) -> None:
-    w.u8(int(req.request_type))
-    w.i32(req.request_rank)
-    w.u8(int(req.tensor_type))
-    w.i32(req.root_rank)
-    w.i32(req.device)
-    w.string(req.tensor_name)
-    w.f64(req.prescale_factor)
-    w.f64(req.postscale_factor)
-    w.u8(len(req.tensor_shape))
-    for d in req.tensor_shape:
-        w.i64(d)
+    name = req.tensor_name.encode("utf-8")
+    shape = req.tensor_shape
+    w.parts.append(_REQ_HEAD.pack(
+        int(req.request_type), req.request_rank, int(req.tensor_type),
+        req.root_rank, req.device, len(name)))
+    w.parts.append(name)
+    w.parts.append(_REQ_TAIL.pack(
+        req.prescale_factor, req.postscale_factor, len(shape)))
+    if shape:
+        w.parts.append(struct.pack(f"<{len(shape)}q", *shape))
 
 
 def _read_request(r: _Reader) -> Request:
-    req_type = RequestType(r.u8())
-    request_rank = r.i32()
-    tensor_type = DataType(r.u8())
-    root_rank = r.i32()
-    device = r.i32()
-    name = r.string()
-    prescale = r.f64()
-    postscale = r.f64()
-    ndim = r.u8()
-    shape = tuple(r.i64() for _ in range(ndim))
-    return Request(request_rank=request_rank, request_type=req_type,
-                   tensor_type=tensor_type, tensor_name=name,
-                   root_rank=root_rank, device=device, tensor_shape=shape,
-                   prescale_factor=prescale, postscale_factor=postscale)
+    data, off = r.data, r.off
+    (req_type, request_rank, tensor_type, root_rank, device,
+     namelen) = _REQ_HEAD.unpack_from(data, off)
+    off += _REQ_HEAD.size
+    name = data[off:off + namelen].decode("utf-8")
+    off += namelen
+    prescale, postscale, ndim = _REQ_TAIL.unpack_from(data, off)
+    off += _REQ_TAIL.size
+    if ndim:
+        shape = struct.unpack_from(f"<{ndim}q", data, off)
+        off += 8 * ndim
+    else:
+        shape = ()
+    r.off = off
+    # Direct slot assignment: the wire reader already holds real enum
+    # members and an int tuple, so Request.__init__'s defensive
+    # coercions (enum calls, per-dim int()) are pure overhead on the
+    # coordinator's hottest loop.
+    req = Request.__new__(Request)
+    req.request_rank = request_rank
+    req.request_type = _REQ_TYPE_OF[req_type]
+    req.tensor_type = _DTYPE_OF[tensor_type]
+    req.tensor_name = name
+    req.root_rank = root_rank
+    req.device = device
+    req.tensor_shape = shape
+    req.prescale_factor = prescale
+    req.postscale_factor = postscale
+    return req
 
 
 def serialize_request_list(rl: RequestList) -> bytes:
@@ -166,7 +190,7 @@ def _write_response(w: _Writer, resp: Response) -> None:
 
 
 def _read_response(r: _Reader) -> Response:
-    resp_type = ResponseType(r.u8())
+    resp_type = _RESP_TYPE_OF[r.u8()]
     err = r.string()
     prescale = r.f64()
     postscale = r.f64()

@@ -67,13 +67,7 @@ def _pack_fused(arrays: List[np.ndarray], response: Response):
     reference's MPI_IN_PLACE path (mpi_operations.cc:44-47)."""
     dtype = arrays[0].dtype
     fresh = len(arrays) > 1
-    if len(arrays) == 1:
-        flat = np.ascontiguousarray(arrays[0]).reshape(-1)
-    else:
-        flats = [np.ascontiguousarray(a).reshape(-1) for a in arrays]
-        flat = _native.pack(flats)
-        if flat is None:
-            flat = np.concatenate(flats)
+    flat = _pack_flat(arrays)
     if response.prescale_factor != 1.0:
         flat = flat * np.asarray(response.prescale_factor, dtype)
         fresh = True
@@ -99,17 +93,25 @@ def _allgather_layout(entries, arrays, response: Response, size: int):
     return comp, rank_counts
 
 
-def _pack_allgather(arrays: List[np.ndarray]) -> np.ndarray:
-    """This rank's packed contribution: each entry's rows flattened,
-    concatenated in entry order (the reference's allgather
-    MemcpyInFusionBuffer, collective_operations.cc:136-150). The
-    native one-call pack is preferred; numpy concatenation is the
-    fallback."""
+def _pack_flat(arrays: List[np.ndarray]) -> np.ndarray:
+    """Flatten + concatenate same-dtype tensors into one fused buffer
+    (the reference's MemcpyInFusionBuffer,
+    collective_operations.cc:35-63): the native one-call pack when
+    available, numpy concatenation otherwise. Single-tensor packs stay
+    a view. The one helper both host planes' allreduce AND allgather
+    pack paths share."""
     if len(arrays) == 1:
         return np.ascontiguousarray(arrays[0]).reshape(-1)
     flats = [np.ascontiguousarray(a).reshape(-1) for a in arrays]
     packed = _native.pack(flats)
     return packed if packed is not None else np.concatenate(flats)
+
+
+def _pack_allgather(arrays: List[np.ndarray]) -> np.ndarray:
+    """This rank's packed allgather contribution: each entry's rows
+    flattened, concatenated in entry order (reference:
+    collective_operations.cc:136-150)."""
+    return _pack_flat(arrays)
 
 
 def _unpack_allgather(entries, arrays, result: np.ndarray, comp,

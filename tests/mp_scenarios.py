@@ -1691,6 +1691,25 @@ def scenario_xla_hierarchical_allgather(hvd_mod, rank, size):
         [np.full((r + 1, 3), float(r), np.float32) for r in range(size)])
     np.testing.assert_allclose(np.asarray(out), expected)
 
+    # FUSED multi-entry allgather on the two-level path: several
+    # variable-dim0 gathers submitted together must land in one
+    # (cross, local) gather and unpack per entry in rank order
+    seen = _record_batches(hvd_mod)
+    hs = [hvd_mod.allgather_async(
+        jnp.full((rank + 1 + (i % 2), i + 1), float(rank * 10 + i),
+                 jnp.float32), name=f"hier.fag.{i}") for i in range(4)]
+    for i, h in enumerate(hs):
+        got = np.asarray(hvd_mod.synchronize(h))
+        off = 0
+        for r in range(size):
+            rr = r + 1 + (i % 2)
+            np.testing.assert_allclose(
+                got[off:off + rr],
+                np.full((rr, i + 1), float(r * 10 + i)))
+            off += rr
+    fag_batches = [n for k, n in seen if k == "ALLGATHER"]
+    assert any(len(b) >= 2 for b in fag_batches), fag_batches
+
     rt = _b.runtime()
     xla = [b for b in rt.op_manager._backends if b.name == "xla_mesh"][0]
     assert xla._mesh2d is not None, "hierarchical mesh not built"
