@@ -4,11 +4,14 @@ Role-equivalent of the reference's FlatBuffers schema
 (reference: horovod/common/wire/message.fbs, message.cc:122-215,317-346).
 We define a compact little-endian layout instead of FlatBuffers.
 
-Why this codec is pure Python (measured decision): a busy 30-request
-cycle costs 59 us to serialize + 196 us to parse, and an idle cycle's
-empty lists cost 1.4 us round-trip — noise against the 1-5 ms cycle
-time. A C++ codec behind ctypes cannot beat that without also moving
-the whole negotiation loop in-core (materializing Python
+Why this codec is pure Python (measured decision, re-validated after
+the struct-batching rewrite): the request path packs/parses each
+Request's fixed fields with one precompiled Struct per segment and
+fills slots directly, putting a 64-rank coordinator cycle at ~1.8 ms
+(~30 us/rank, see benchmarks/RESULTS_cpu.json
+projected_scaling.coordinator_cpu) — ~6x under the 64-chip control
+budget. A C++ codec behind ctypes cannot beat that without also
+moving the whole negotiation loop in-core (materializing Python
 Request/Response objects from C structs costs more than parsing the
 bytes in Python), so the earlier native parity codec was deleted
 rather than wired in.
@@ -181,12 +184,17 @@ def _write_response(w: _Writer, resp: Response) -> None:
     w.u32(len(resp.tensor_names))
     for name in resp.tensor_names:
         w.string(name)
-    w.u32(len(resp.devices))
-    for d in resp.devices:
-        w.i32(d)
-    w.u32(len(resp.tensor_sizes))
-    for s in resp.tensor_sizes:
-        w.i64(s)
+    # vectors as one pack each: every rank parses the broadcast
+    # ResponseList each cycle, and devices/tensor_sizes grow with
+    # world size (devices) and fused batch width (sizes)
+    devices = resp.devices
+    w.u32(len(devices))
+    if devices:
+        w.parts.append(struct.pack(f"<{len(devices)}i", *devices))
+    sizes = resp.tensor_sizes
+    w.u32(len(sizes))
+    if sizes:
+        w.parts.append(struct.pack(f"<{len(sizes)}q", *sizes))
 
 
 def _read_response(r: _Reader) -> Response:
@@ -195,8 +203,18 @@ def _read_response(r: _Reader) -> Response:
     prescale = r.f64()
     postscale = r.f64()
     names = [r.string() for _ in range(r.u32())]
-    devices = [r.i32() for _ in range(r.u32())]
-    sizes = [r.i64() for _ in range(r.u32())]
+    ndev = r.u32()
+    if ndev:
+        devices = list(struct.unpack_from(f"<{ndev}i", r.data, r.off))
+        r.off += 4 * ndev
+    else:
+        devices = []
+    nsz = r.u32()
+    if nsz:
+        sizes = list(struct.unpack_from(f"<{nsz}q", r.data, r.off))
+        r.off += 8 * nsz
+    else:
+        sizes = []
     return Response(response_type=resp_type, tensor_names=names,
                     error_message=err, devices=devices, tensor_sizes=sizes,
                     prescale_factor=prescale, postscale_factor=postscale)

@@ -58,7 +58,7 @@ from horovod_tpu.common.timeline import (
 )
 from horovod_tpu.ops.backend import CollectiveBackend
 from horovod_tpu.ops.socket_ops import (
-    _allgather_layout, _pack_allgather, _pack_fused, _restore,
+    _allgather_layout, _pack_flat, _pack_fused, _restore,
     _to_numpy, _unpack_allgather, _unpack_fused,
 )
 
@@ -392,7 +392,7 @@ class ShmBackend(CollectiveBackend):
         total_elems = sum(rank_counts)
         multi = len(entries) > 1
         with self.activity(names, ACT_MEMCPY_IN_FUSION_BUFFER, multi):
-            packed = _pack_allgather(arrays)
+            packed = _pack_flat(arrays)
         dtype = packed.dtype
         if ctl.is_coordinator:
             ctl.gather_data(b"")

@@ -95,8 +95,9 @@ def _allgather_layout(entries, arrays, response: Response, size: int):
 
 def _pack_flat(arrays: List[np.ndarray]) -> np.ndarray:
     """Flatten + concatenate same-dtype tensors into one fused buffer
-    (the reference's MemcpyInFusionBuffer,
-    collective_operations.cc:35-63): the native one-call pack when
+    (the reference's MemcpyInFusionBuffer for allreduce,
+    collective_operations.cc:35-63, and for allgather — entry order —
+    collective_operations.cc:136-150): the native one-call pack when
     available, numpy concatenation otherwise. Single-tensor packs stay
     a view. The one helper both host planes' allreduce AND allgather
     pack paths share."""
@@ -105,13 +106,6 @@ def _pack_flat(arrays: List[np.ndarray]) -> np.ndarray:
     flats = [np.ascontiguousarray(a).reshape(-1) for a in arrays]
     packed = _native.pack(flats)
     return packed if packed is not None else np.concatenate(flats)
-
-
-def _pack_allgather(arrays: List[np.ndarray]) -> np.ndarray:
-    """This rank's packed allgather contribution: each entry's rows
-    flattened, concatenated in entry order (reference:
-    collective_operations.cc:136-150)."""
-    return _pack_flat(arrays)
 
 
 def _unpack_allgather(entries, arrays, result: np.ndarray, comp,
@@ -238,7 +232,7 @@ class SocketBackend(CollectiveBackend):
         names = [e.tensor_name for e in entries]
         multi = len(entries) > 1  # single-tensor pack is a view
         with self.activity(names, ACT_MEMCPY_IN_FUSION_BUFFER, multi):
-            packed = _pack_allgather(arrays)
+            packed = _pack_flat(arrays)
         gathered = ctl.gather_data(packed)
         if gathered is not None:
             blob = b"".join(gathered)

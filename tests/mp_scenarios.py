@@ -442,6 +442,28 @@ def scenario_fused_allgather(hvd, rank, size):
         if kind == "ALLGATHER" and len(names) > 1:
             raise AssertionError(f"mixed-dtype allgather fused: {names}")
 
+    # empty entries INSIDE a fused batch: one entry empty on every
+    # rank, one empty on rank 0 only, one normal — displacement math
+    # must keep zero-length components straight
+    he = [hvd.allgather_async(np.empty((0, 3), np.float32),
+                              name="fag.e.all"),
+          hvd.allgather_async(np.full((rank, 3), float(rank),
+                                      np.float32), name="fag.e.some"),
+          hvd.allgather_async(np.full((2, 3), float(rank + 10),
+                                      np.float32), name="fag.e.full")]
+    out = hvd.synchronize(he[0])
+    assert out.shape == (0, 3), out.shape
+    out = hvd.synchronize(he[1])
+    assert out.shape == (sum(range(size)), 3)
+    off = 0
+    for r in range(size):
+        np.testing.assert_allclose(out[off:off + r], float(r))
+        off += r
+    out = hvd.synchronize(he[2])
+    assert out.shape == (2 * size, 3)
+    for r in range(size):
+        np.testing.assert_allclose(out[2 * r:2 * r + 2], float(r + 10))
+
 
 def scenario_grouped_atomic(hvd, rank, size):
     """Grouped allreduce atomicity is a guarantee, not best-effort:
@@ -1614,6 +1636,22 @@ def scenario_xla_backend(hvd_mod, rank, size):
     ag_batches = [names for kind, names in seen if kind == "ALLGATHER"]
     assert any(len(b) >= 2 for b in ag_batches), \
         f"no fused xla allgather batch: {ag_batches}"
+
+    # empty entries inside the mesh path: one some-ranks-empty entry
+    # (rank 0 contributes 0 rows) next to a normal one
+    h1 = hvd_mod.allgather_async(
+        jnp.full((rank, 2), float(rank), jnp.float32), name="xla.e.some")
+    h2 = hvd_mod.allgather_async(
+        jnp.full((2, 2), float(rank + 5), jnp.float32), name="xla.e.full")
+    out = np.asarray(hvd_mod.synchronize(h1))
+    assert out.shape == (sum(range(size)), 2), out.shape
+    off = 0
+    for r in range(size):
+        np.testing.assert_allclose(out[off:off + r], float(r))
+        off += r
+    out = np.asarray(hvd_mod.synchronize(h2))
+    for r in range(size):
+        np.testing.assert_allclose(out[2 * r:2 * r + 2], float(r + 5))
 
 
 def scenario_xla_hierarchical(hvd_mod, rank, size):

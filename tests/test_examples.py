@@ -143,7 +143,7 @@ def test_torch_synthetic_benchmark():
 
 
 def test_tensorflow_mnist_eager():
-    out = _run("tensorflow_mnist_eager.py", "--steps", "12")
+    out = _run("tensorflow_mnist_eager.py", "--steps", "40")
     first, last = out.split("loss ")[-1].split(" over ")[0].split(" -> ")
     assert float(last) < float(first)  # it actually learns
 

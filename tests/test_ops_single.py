@@ -211,3 +211,18 @@ class TestIdleBackoff:
         rate_off = idle_rate(0)
         rate_on = idle_rate(25)
         assert rate_off > 3 * rate_on, (rate_off, rate_on)
+
+
+class TestConfigValidation:
+    def test_xla_bcast_rendering_validated(self, monkeypatch):
+        """A typo'd HOROVOD_XLA_BCAST must raise, not silently pick a
+        rendering — per-rank divergence would compile mismatched
+        collectives for the same negotiated broadcast."""
+        import pytest
+        from horovod_tpu.common.config import Config
+
+        monkeypatch.setenv("HOROVOD_XLA_BCAST", "Tree")
+        assert Config.from_env().xla_broadcast == "tree"  # case-folded
+        monkeypatch.setenv("HOROVOD_XLA_BCAST", "ppermute")
+        with pytest.raises(ValueError, match="HOROVOD_XLA_BCAST"):
+            Config.from_env()
