@@ -593,6 +593,16 @@ def scenario_coordinator_fuzz(hvd, rank, size):
         # scenario tests).
         if jobs_rng.rand() < 0.5:
             hvd.barrier(name=f"fz.bar.{start}")
+        # a grouped wave (shared decision + shared member count) rides
+        # the same storm: atomic submission must hold under overlap
+        if jobs_rng.rand() < 0.5:
+            k = int(jobs_rng.randint(2, 7))
+            gouts = hvd.grouped_allreduce(
+                [np.full(12, float(rank + 1) * (m + 1), np.float32)
+                 for m in range(k)],
+                average=False, name=f"fz.grp.{start}")
+            for m, o in enumerate(gouts):
+                np.testing.assert_allclose(o, ssum * (m + 1.0))
         drain, pending = pending[:len(pending) // 2], \
             pending[len(pending) // 2:]
         for job, h in drain:
