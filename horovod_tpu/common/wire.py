@@ -7,10 +7,10 @@ We define a compact little-endian layout instead of FlatBuffers.
 Why this codec is pure Python (measured decision, re-validated after
 the struct-batching rewrite): the request path packs/parses each
 Request's fixed fields with one precompiled Struct per segment and
-fills slots directly, putting a 64-rank coordinator cycle at ~1.8 ms
-(~30 us/rank, see benchmarks/RESULTS_cpu.json
-projected_scaling.coordinator_cpu) — ~6x under the 64-chip control
-budget. A C++ codec behind ctypes cannot beat that without also
+fills slots directly, putting a 64-rank coordinator cycle at ~1 ms
+(~15-30 us/rank across runs, see benchmarks/RESULTS_cpu.json
+projected_scaling.coordinator_cpu) — an order of magnitude under the
+64-chip control budget. A C++ codec behind ctypes cannot beat that without also
 moving the whole negotiation loop in-core (materializing Python
 Request/Response objects from C structs costs more than parsing the
 bytes in Python), so the earlier native parity codec was deleted
