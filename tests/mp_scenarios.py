@@ -465,6 +465,47 @@ def scenario_fused_allgather(hvd, rank, size):
         np.testing.assert_allclose(out[2 * r:2 * r + 2], float(r + 10))
 
 
+def scenario_sparse_allgather_fusion(hvd, rank, size):
+    """The sparse-gradient traffic shape (TF IndexedSlices -> one
+    values + one indices allgather per embedding tensor, the word2vec
+    path): with allgather fusion, a step's 6 tensor pairs execute as
+    ~2 fused batches (f32 values together, i64 indices together)
+    instead of 12 negotiated singles (reference bar:
+    operations.cc:1172-1234)."""
+    seen = _record_batches(hvd)
+    n_tensors = 6
+    handles = []
+    for t in range(n_tensors):
+        rows = rank + 1 + t % 3
+        handles.append((t, "v", hvd.allgather_async(
+            np.full((rows, 8), float(rank * 10 + t), np.float32),
+            name=f"sp.{t}.values")))
+        handles.append((t, "i", hvd.allgather_async(
+            np.arange(rows, dtype=np.int64) + rank * 100,
+            name=f"sp.{t}.indices")))
+    for t, kind, h in handles:
+        out = np.asarray(hvd.synchronize(h))
+        rows = [r + 1 + t % 3 for r in range(size)]
+        assert out.shape[0] == sum(rows), (t, kind, out.shape)
+        off = 0
+        for r in range(size):
+            if kind == "v":
+                np.testing.assert_allclose(out[off:off + rows[r]],
+                                           float(r * 10 + t))
+            else:
+                np.testing.assert_array_equal(
+                    out[off:off + rows[r]],
+                    np.arange(rows[r], dtype=np.int64) + r * 100)
+            off += rows[r]
+    batches = [names for k, names in seen if k == "ALLGATHER"]
+    total = sum(len(b) for b in batches)
+    assert total == 2 * n_tensors, (total, batches)
+    # the whole step must collapse into a few fused batches, not one
+    # negotiation+dispatch per tensor (cycle straddles may split once)
+    assert len(batches) <= 6, [sorted(b) for b in batches]
+    assert any(len(b) >= 3 for b in batches), batches
+
+
 def scenario_grouped_atomic(hvd, rank, size):
     """Grouped allreduce atomicity is a guarantee, not best-effort:
     all members land in ONE fused response even with the default

@@ -287,6 +287,13 @@ def test_fused_allgather(plane):
     run_scenario("fused_allgather", 3, timeout=120.0, extra_env=extra)
 
 
+def test_sparse_allgather_fusion():
+    """word2vec-shaped sparse traffic (values+indices allgather pairs)
+    executes as a few fused batches per step, not per-tensor singles."""
+    run_scenario("sparse_allgather_fusion", 3, timeout=120.0,
+                 extra_env={"HOROVOD_CYCLE_TIME": "25"})
+
+
 def test_grouped_allreduce_atomic():
     """All group members land in ONE fused response even with the
     1 ms cycle ticking and a concurrent thread submitting singles."""
