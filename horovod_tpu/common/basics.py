@@ -111,7 +111,8 @@ def init(comm=None, config: Optional[Config] = None,
             coord = TcpCoordinator(size, port=cfg.controller_port,
                                    secret=secret,
                                    start_timeout=cfg.start_timeout,
-                                   listener=listener)
+                                   listener=listener,
+                                   hierarchical=cfg.hier_controller)
             coord.accept_workers()
             controller = coord
         else:

@@ -81,6 +81,16 @@ class Config:
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
 
+    # Hierarchical CONTROL plane (TPU-native extension): on multi-host
+    # worlds, each remote host's leaf ranks deliver their per-cycle
+    # RequestLists to the host's local root, which forwards ONE
+    # aggregate frame to the coordinator (and relays responses back),
+    # so coordinator fan-in scales with n_hosts instead of world size —
+    # the control-plane analog of the tree gather MPI_Gather gives the
+    # reference for free (reference: operations.cc:1044-1065).
+    # HOROVOD_TPU_HIER_CONTROLLER=0 forces the flat star.
+    hier_controller: bool = True
+
     # XLA broadcast rendering: "psum" (masked psum — one fused
     # allreduce, ~2x payload per link but single-round and pipelined
     # by XLA; measured fastest at N>=8) or "tree" (binary-tree
@@ -155,6 +165,8 @@ class Config:
             "HOROVOD_HIERARCHICAL_ALLREDUCE", c.hierarchical_allreduce)
         c.hierarchical_allgather = _env_bool(
             "HOROVOD_HIERARCHICAL_ALLGATHER", c.hierarchical_allgather)
+        c.hier_controller = _env_bool(
+            "HOROVOD_TPU_HIER_CONTROLLER", c.hier_controller)
         c.xla_broadcast = os.environ.get("HOROVOD_XLA_BCAST",
                                          c.xla_broadcast).lower()
         if c.xla_broadcast not in ("psum", "tree"):

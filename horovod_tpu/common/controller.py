@@ -20,6 +20,7 @@ from __future__ import annotations
 import json
 import os
 import socket
+import struct
 import time
 from typing import Dict, List, Optional
 
@@ -35,11 +36,110 @@ def _my_hostname() -> str:
     return os.environ.get("HOROVOD_HOSTNAME") or socket.gethostname()
 
 
+def _local_root_addr() -> str:
+    """Address same-host leaf ranks use to reach their local root's
+    listener (hierarchical control plane). Loopback is right whenever
+    the host's ranks share a network namespace; per-rank containers
+    that share only HOROVOD_HOSTNAME set HOROVOD_TPU_LOCAL_ROOT_ADDR
+    to a mutually reachable address (the root binds it too)."""
+    return os.environ.get("HOROVOD_TPU_LOCAL_ROOT_ADDR", "127.0.0.1")
+
+
+def host_groups(hostnames: List[str]):
+    """Group ranks by hostname in first-seen host order — THE canonical
+    grouping every control-plane participant must agree on (topology,
+    coordinator aggregation, local-root membership all derive from this
+    one function; reference: operations.cc:729-764).
+
+    Returns (hosts, members) with ``hosts`` the distinct hostnames in
+    first-appearance order and ``members[i]`` the ascending global
+    ranks on ``hosts[i]``."""
+    hosts: List[str] = []
+    for h in hostnames:
+        if h not in hosts:
+            hosts.append(h)
+    members = [[r for r in range(len(hostnames)) if hostnames[r] == h]
+               for h in hosts]
+    return hosts, members
+
+
 # Frame tags on the controller channel.
 TAG_HANDSHAKE = 1
 TAG_REQUESTS = 2    # worker -> coordinator: serialized RequestList
 TAG_RESPONSES = 3   # coordinator -> worker: serialized ResponseList
 TAG_DATA = 4        # data-plane payload (socket fallback backend)
+
+
+_PACK_COUNT = struct.Struct("<I")
+_PACK_LEN = struct.Struct("<Q")
+
+
+def pack_frames(frames: List[bytes]) -> bytes:
+    """Concatenate several per-rank frames into one aggregate payload
+    (hierarchical control plane: a host's local root forwards ONE frame
+    carrying all its ranks' messages — the control-plane rendering of
+    the reference's LOCAL-then-CROSS communicator split,
+    reference: horovod/common/operations.cc:729-764)."""
+    parts = [_PACK_COUNT.pack(len(frames))]
+    for f in frames:
+        parts.append(_PACK_LEN.pack(len(f)))
+        parts.append(bytes(f) if not isinstance(f, (bytes, bytearray))
+                     else f)
+    return b"".join(parts)
+
+
+def unpack_frames(blob: bytes) -> List[bytes]:
+    """Inverse of :func:`pack_frames`."""
+    (n,) = _PACK_COUNT.unpack_from(blob, 0)
+    off = _PACK_COUNT.size
+    out: List[bytes] = []
+    for _ in range(n):
+        (ln,) = _PACK_LEN.unpack_from(blob, off)
+        off += _PACK_LEN.size
+        out.append(bytes(blob[off:off + ln]))
+        off += ln
+    if off != len(blob):
+        raise ConnectionError(
+            f"aggregate frame has {len(blob) - off} trailing bytes")
+    return out
+
+
+def _accept_handshakes(server, secret: bytes, deadline: float,
+                       timeout_msg, validate):
+    """Shared hardened accept loop (coordinator startup and local-root
+    leaf rendezvous): accept, handshake, validate; a stray probe, a
+    garbage frame, or a peer dying mid-handshake is rejected without
+    aborting startup. ``validate(hello) -> rank`` raises
+    ConnectionError (or Key/Value/TypeError) to reject; ``timeout_msg``
+    is a callable so the error reflects progress at expiry. Yields
+    (rank, hello, channel) per accepted peer, forever — the caller
+    stops iterating when it has everyone."""
+    server.settimeout(1.0)
+    while True:
+        if time.monotonic() > deadline:
+            raise TimeoutError(timeout_msg())
+        try:
+            sock, _ = server.accept()
+        except socket.timeout:
+            continue
+        try:
+            sock.settimeout(5.0)
+            ch = network.Channel(sock, secret)
+            tag, payload = ch.recv()
+            if tag != TAG_HANDSHAKE:
+                raise ConnectionError(f"unexpected tag {tag}")
+            hello = json.loads(payload.decode())
+            r = validate(hello)
+        except (ConnectionError, socket.timeout, ValueError,
+                KeyError, TypeError, UnicodeDecodeError) as e:
+            hlog.warning(f"rejected connection during startup: {e}")
+            try:
+                sock.close()
+            except OSError:
+                pass
+            continue
+        sock.settimeout(None)
+        yield r, hello, ch
 
 
 def _as_buffer(payload):
@@ -82,19 +182,14 @@ def compute_topology(rank: int, hostnames: List[str]) -> Topology:
     (reference: operations.cc:729-764; homogeneity check 741-757)."""
     size = len(hostnames)
     my_host = hostnames[rank]
-    local_ranks = [r for r in range(size) if hostnames[r] == my_host]
+    hosts, members = host_groups(hostnames)
+    cross_rank = hosts.index(my_host)
+    cross_size = len(hosts)
+    local_ranks = members[cross_rank]
     local_rank = local_ranks.index(rank)
     local_size = len(local_ranks)
-    # cross communicator: one member per host, split by local_rank
-    hosts_in_order: List[str] = []
-    for h in hostnames:
-        if h not in hosts_in_order:
-            hosts_in_order.append(h)
-    cross_rank = hosts_in_order.index(my_host)
-    cross_size = len(hosts_in_order)
-    local_sizes = [sum(1 for h in hostnames if h == host)
-                   for host in hosts_in_order]
-    local_roots = [hostnames.index(host) for host in hosts_in_order]
+    local_sizes = [len(ms) for ms in members]
+    local_roots = [ms[0] for ms in members]
     is_homogeneous = all(s == local_sizes[0] for s in local_sizes)
     return Topology(rank=rank, size=size, local_rank=local_rank,
                     local_size=local_size, cross_rank=cross_rank,
@@ -196,13 +291,23 @@ class TcpCoordinator(Controller):
     Python per-channel loop is the fallback."""
 
     def __init__(self, size: int, port: int = 0, secret: bytes = b"",
-                 start_timeout: float = 30.0, listener=None):
+                 start_timeout: float = 30.0, listener=None,
+                 hierarchical: bool = True):
         """``listener`` — an already-bound listening socket to adopt
         instead of binding ``port``. Launch layers that must publish
         the coordinator endpoint BEFORE init (Spark rendezvous,
         hvdtpurun's per-host port reservation) hand the bound socket
         over so there is no close-then-rebind window for another
-        process to steal the port."""
+        process to steal the port.
+
+        ``hierarchical`` — allow per-host control-plane aggregation:
+        when the world spans multiple hosts with more than one rank
+        each, remote leaf ranks migrate to their host's local root
+        after the handshake and the coordinator keeps ONE channel per
+        remote host, so per-cycle fan-in is n_hosts + local ranks
+        instead of world size (the control-plane analog of the
+        reference's hierarchical allreduce communicator split,
+        reference: operations.cc:729-764, 822-841)."""
         self._secret = secret
         self._server = listener if listener is not None \
             else network.listen(port)
@@ -211,60 +316,156 @@ class TcpCoordinator(Controller):
         self._hostname = _my_hostname()
         self._size = size
         self._start_timeout = start_timeout
+        self._hierarchical = hierarchical
         self.topology = None  # set by accept_workers
         self._native = None
-        self._worker_fds = None  # ranks 1..size-1 in rank order
+        self._worker_fds = None  # channel owners, ascending rank order
+        # channel owner rank -> all ranks that channel represents
+        # (ascending; owner first). Flat world: every owner maps to
+        # itself. Hierarchical: a remote local root carries its host.
+        self._members: Dict[int, List[int]] = {}
+        self._owner_of: Dict[int, int] = {}
+        self._has_aggregates = False
 
     def accept_workers(self) -> None:
         deadline = time.monotonic() + self._start_timeout
         hostnames = [None] * self._size
         hostnames[0] = self._hostname
-        self._server.settimeout(1.0)
+
+        def _validate(hello):
+            r = int(hello["rank"])
+            if r <= 0 or r >= self._size or r in self._channels:
+                raise ConnectionError(f"bad or duplicate rank {r}")
+            hello["hostname"]  # reject (KeyError) if absent
+            return r
+
+        accepts = _accept_handshakes(
+            self._server, self._secret, deadline,
+            lambda: (f"Only {len(self._channels) + 1}/{self._size} ranks "
+                     f"connected within start timeout; increase "
+                     f"HOROVOD_START_TIMEOUT if startup is slow."),
+            _validate)
         while len(self._channels) < self._size - 1:
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"Only {len(self._channels) + 1}/{self._size} ranks "
-                    f"connected within start timeout; increase "
-                    f"HOROVOD_START_TIMEOUT if startup is slow.")
-            try:
-                sock, _ = self._server.accept()
-            except socket.timeout:
-                continue
-            # A stray probe, a garbage frame, or a worker dying
-            # mid-handshake must not abort startup — reject the
-            # connection and keep waiting for legitimate workers.
-            try:
-                sock.settimeout(5.0)
-                ch = network.Channel(sock, self._secret)
-                tag, payload = ch.recv()
-                if tag != TAG_HANDSHAKE:
-                    raise ConnectionError(f"unexpected tag {tag}")
-                hello = json.loads(payload.decode())
-                r = int(hello["rank"])
-                host = hello["hostname"]
-                if r <= 0 or r >= self._size or r in self._channels:
-                    raise ConnectionError(f"bad or duplicate rank {r}")
-            except (ConnectionError, socket.timeout, ValueError,
-                    KeyError, TypeError, UnicodeDecodeError) as e:
-                hlog.warning(f"rejected connection during startup: {e}",
-                             rank=0)
-                try:
-                    sock.close()
-                except OSError:
-                    pass
-                continue
-            sock.settimeout(None)
-            hostnames[r] = host
+            r, hello, ch = next(accepts)
+            hostnames[r] = hello["hostname"]
             self._channels[r] = ch
         # Broadcast the full hostname list so every rank derives the same
         # topology (reference: operations.cc:729-764).
-        blob = json.dumps({"hostnames": hostnames}).encode()
+        self.topology = compute_topology(0, hostnames)
+        topo = self.topology
+        # Hierarchy pays only when remote hosts have leaf ranks to fold
+        # behind their local root.
+        remote_leaves = (self._size - topo.local_sizes[0]
+                         - (topo.cross_size - 1))
+        hier = (self._hierarchical and topo.cross_size > 1
+                and remote_leaves > 0)
+        blob = json.dumps({"hostnames": hostnames,
+                           "hier": hier}).encode()
         for r, ch in self._channels.items():
             ch.send(blob, TAG_HANDSHAKE)
-        self.topology = compute_topology(0, hostnames)
+        self._members = {r: [r] for r in self._channels}
+        self._peer_ip_override: Dict[int, str] = {}
+        if hier:
+            self._setup_hierarchy(hostnames, deadline)
+        self._owner_of = {}
+        for owner, ms in self._members.items():
+            for m in ms:
+                self._owner_of[m] = owner
+        self._has_aggregates = any(
+            len(ms) > 1 for ms in self._members.values())
         self._init_native()
         hlog.debug(f"coordinator up: {self._size} ranks, "
-                   f"{self.topology.cross_size} hosts", rank=0)
+                   f"{self.topology.cross_size} hosts, "
+                   f"fan-in {len(self._channels)}", rank=0)
+
+    def _setup_hierarchy(self, hostnames: List[str],
+                         deadline: float) -> None:
+        """Collapse each remote host's ranks behind its local root:
+        gather root listener ports, hand the port map to remote leaves,
+        and drop their direct channels. After this the coordinator's
+        per-cycle fan-in is (host-0 local ranks) + (remote hosts).
+        Every blocking recv here is bounded by the same start deadline
+        that bounds accept_workers — a root dying mid-setup must fail
+        the job fast, not hang it."""
+        _, host_members = host_groups(hostnames)
+        root_ports: Dict[str, int] = {}
+        for cross, members in enumerate(host_members[1:], start=1):
+            if len(members) == 1:
+                continue  # solo host: stays a direct channel
+            root = members[0]
+            tag, data = self._recv_by(self._channels[root], deadline,
+                                      f"port report from root {root}")
+            if tag != TAG_HANDSHAKE:
+                raise ConnectionError(
+                    f"expected root port report from rank {root}, got "
+                    f"tag {tag}")
+            root_ports[str(cross)] = int(
+                json.loads(data.decode())["port"])
+        map_blob = json.dumps({"roots": root_ports}).encode()
+        agg_roots: List[int] = []
+        for members in host_members[1:]:
+            if len(members) == 1:
+                continue
+            for leaf in members[1:]:
+                ch = self._channels.pop(leaf)
+                self._members.pop(leaf)
+                ch.send(map_blob, TAG_HANDSHAKE)
+                ch.close()
+            self._members[members[0]] = members
+            agg_roots.append(members[0])
+        # Each root reports the IPs it observed its leaves connect
+        # from, once they all arrive. A non-loopback leaf IP (per-rank
+        # containers, HOROVOD_TPU_LOCAL_ROOT_ADDR set) overrides
+        # worker_peer_ip for that rank so ring rendezvous dials the
+        # leaf's own address; loopback means shared-netns, where the
+        # root channel's IP is the host's reachable address for all
+        # its ranks.
+        for root in agg_roots:
+            tag, data = self._recv_by(self._channels[root], deadline,
+                                      f"leaf-IP report from root {root}")
+            if tag != TAG_HANDSHAKE:
+                raise ConnectionError(
+                    f"expected leaf-IP report from rank {root}, got "
+                    f"tag {tag}")
+            for r, ip in json.loads(data.decode())["leaf_ips"].items():
+                if not ip.startswith("127."):
+                    self._peer_ip_override[int(r)] = ip
+
+    @staticmethod
+    def _recv_by(ch: network.Channel, deadline: float,
+                 what: str) -> tuple:
+        """recv() bounded by the startup deadline."""
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError(
+                f"start timeout expired waiting for {what}; increase "
+                f"HOROVOD_START_TIMEOUT if startup is slow.")
+        ch.sock.settimeout(remaining)
+        try:
+            return ch.recv()
+        except socket.timeout:
+            raise TimeoutError(
+                f"start timeout expired waiting for {what}; increase "
+                f"HOROVOD_START_TIMEOUT if startup is slow.")
+        finally:
+            ch.sock.settimeout(None)
+
+    def _expand(self, out: List[bytes]) -> List[bytes]:
+        """Unpack aggregate frames from local roots into per-rank
+        slots (gather direction)."""
+        if not self._has_aggregates:
+            return out
+        for owner, members in self._members.items():
+            if len(members) == 1:
+                continue
+            frames = unpack_frames(out[owner])
+            if len(frames) != len(members):
+                raise ConnectionError(
+                    f"aggregate from rank {owner} carried "
+                    f"{len(frames)} frames for {len(members)} ranks")
+            for m, f in zip(members, frames):
+                out[m] = f
+        return out
 
     def _init_native(self) -> None:
         from horovod_tpu import native
@@ -337,16 +538,16 @@ class TcpCoordinator(Controller):
             raise ConnectionError(f"native broadcast failed: errno {-rc}")
         return True
 
-    def _native_scatter(self, payloads: List[bytes]) -> None:
-        """Scatter payloads[r] to worker rank r (payloads[0] is local)."""
+    def _native_scatter(self, per_owner: Dict[int, bytes]) -> None:
+        """Scatter per_owner[r] to the channel owned by rank r."""
         lib, ctypes = self._native
         n = len(self._worker_ranks)
         u8p = ctypes.POINTER(ctypes.c_uint8)
-        arrs = [self._as_u8(ctypes, payloads[r])
+        arrs = [self._as_u8(ctypes, per_owner[r])
                 for r in self._worker_ranks]
         ptrs = (u8p * n)(*[ctypes.cast(a, u8p) for a in arrs])
         lens = (ctypes.c_int64 * n)(
-            *[len(payloads[r]) for r in self._worker_ranks])
+            *[len(per_owner[r]) for r in self._worker_ranks])
         rc = lib.hvd_scatter_frames(self._worker_fds, n, TAG_DATA, ptrs,
                                     lens, self._native_secret,
                                     len(self._secret))
@@ -355,7 +556,8 @@ class TcpCoordinator(Controller):
 
     def gather_requests(self, payload: bytes) -> Optional[List[bytes]]:
         if self._native is not None:
-            return self._native_gather(payload, TAG_REQUESTS)
+            return self._expand(self._native_gather(payload,
+                                                    TAG_REQUESTS))
         out: List[bytes] = [b""] * self._size
         out[0] = payload
         for r, ch in self._channels.items():
@@ -364,7 +566,7 @@ class TcpCoordinator(Controller):
                 raise ConnectionError(
                     f"expected TAG_REQUESTS from rank {r}, got {tag}")
             out[r] = data
-        return out
+        return self._expand(out)
 
     def broadcast_responses(self, payload: Optional[bytes]) -> bytes:
         assert payload is not None
@@ -378,7 +580,7 @@ class TcpCoordinator(Controller):
     def gather_data(self, payload: bytes) -> Optional[List[bytes]]:
         payload = _as_buffer(payload)
         if self._native is not None:
-            return self._native_gather(payload, TAG_DATA)
+            return self._expand(self._native_gather(payload, TAG_DATA))
         out: List[bytes] = [b""] * self._size
         out[0] = payload
         for r, ch in self._channels.items():
@@ -387,25 +589,28 @@ class TcpCoordinator(Controller):
                 raise ConnectionError(
                     f"expected TAG_DATA from rank {r}, got {tag}")
             out[r] = data
-        return out
+        return self._expand(out)
 
     def broadcast_data(self, payload: Optional[bytes],
                        root_rank: int = 0) -> bytes:
         payload = _as_buffer(payload)
         if root_rank != 0:
-            # Pull the payload up from the root, then fan out to
-            # everyone EXCEPT the root — it already has the bytes, and
-            # echoing them back would double the root's traffic.
-            tag, payload = self._channels[root_rank].recv()
+            # Pull the payload up from the root's owning channel, then
+            # fan out to every OTHER channel — the owner (the root
+            # itself, or the local root relaying for it) already has
+            # the bytes and has distributed them on its host, and
+            # echoing them back would double its traffic.
+            owner = self._owner_of[root_rank]
+            tag, payload = self._channels[owner].recv()
             if tag != TAG_DATA:
                 raise ConnectionError("expected TAG_DATA from root")
             assert payload is not None
             if self._native is not None:
                 self._native_send_all(payload, TAG_DATA,
-                                      exclude_rank=root_rank)
+                                      exclude_rank=owner)
                 return payload
             for r, ch in self._channels.items():
-                if r != root_rank:
+                if r != owner:
                     ch.send(payload, TAG_DATA)
             return payload
         assert payload is not None
@@ -418,18 +623,30 @@ class TcpCoordinator(Controller):
 
     def scatter_data(self, payloads: Optional[List[bytes]]) -> bytes:
         assert payloads is not None and len(payloads) == self._size
+        per_owner: Dict[int, bytes] = {
+            owner: (payloads[owner] if len(ms) == 1
+                    else pack_frames([_as_buffer(payloads[m])
+                                      for m in ms]))
+            for owner, ms in self._members.items()}
         if self._native is not None:
-            self._native_scatter(payloads)
+            self._native_scatter(per_owner)
             return payloads[0]
         for r, ch in self._channels.items():
-            ch.send(payloads[r], TAG_DATA)
+            ch.send(per_owner[r], TAG_DATA)
         return payloads[0]
 
     def worker_peer_ip(self, rank: int) -> str:
         """IP of worker ``rank`` as seen from this coordinator — the
         address other ranks use to reach that worker's data listener
-        (ring rendezvous, ops/ring.py)."""
-        return self._channels[rank].sock.getpeername()[0]
+        (ring rendezvous, ops/ring.py). Under the hierarchical control
+        plane a shared-netns leaf shares its host's IP, so its local
+        root's channel answers for it; a leaf with its own network
+        identity (non-loopback connect to its root) reported its real
+        IP at setup and that override wins."""
+        ip = self._peer_ip_override.get(rank)
+        if ip is not None:
+            return ip
+        return self._channels[self._owner_of[rank]].sock.getpeername()[0]
 
     def close(self) -> None:
         for ch in self._channels.values():
@@ -438,7 +655,20 @@ class TcpCoordinator(Controller):
 
 
 class TcpWorker(Controller):
-    """Ranks 1..size-1: one persistent connection to the coordinator."""
+    """Ranks 1..size-1: one persistent connection upward.
+
+    Flat world: the upward channel goes straight to the coordinator.
+    Hierarchical world (coordinator announced ``hier`` in the
+    handshake): a remote host's local_rank-0 process becomes the host's
+    LOCAL ROOT — it keeps the coordinator channel, accepts loopback
+    connections from its host's leaf ranks, and relays every
+    control/data primitive between them and the coordinator, packing
+    the host's per-rank frames into one aggregate frame upward
+    (pack_frames). Remote leaf ranks migrate: they drop the coordinator
+    channel and point their upward channel at the local root instead —
+    every op below then works unchanged for them. This is the
+    control-plane rendering of the reference's LOCAL/CROSS communicator
+    split (reference: horovod/common/operations.cc:729-764)."""
 
     def __init__(self, rank: int, size: int, addr: str, port: int,
                  secret: bytes = b"", start_timeout: float = 30.0):
@@ -452,41 +682,152 @@ class TcpWorker(Controller):
         tag, payload = self._ch.recv()
         if tag != TAG_HANDSHAKE:
             raise ConnectionError("handshake failed")
-        hostnames = json.loads(payload.decode())["hostnames"]
+        info = json.loads(payload.decode())
+        hostnames = info["hostnames"]
         self.topology = compute_topology(rank, hostnames)
+        # rank -> loopback channel of each local leaf (local roots only)
+        self._children: Dict[int, network.Channel] = {}
+        self._members: List[int] = [rank]  # this host's ranks, ascending
+        if (info.get("hier") and self.topology.cross_rank != 0
+                and self.topology.local_size > 1):
+            _, host_members = host_groups(hostnames)
+            members = host_members[self.topology.cross_rank]
+            if self.topology.local_rank == 0:
+                self._become_local_root(members, secret, start_timeout)
+            else:
+                self._become_leaf(rank, secret, start_timeout)
+
+    def _become_local_root(self, members: List[int], secret: bytes,
+                           start_timeout: float) -> None:
+        """Open a same-host listener, report its port upward, accept
+        this host's leaf ranks."""
+        srv = network.listen(0, host=_local_root_addr())
+        port = srv.getsockname()[1]
+        self._ch.send(json.dumps({"port": port}).encode(), TAG_HANDSHAKE)
+        expected = set(members[1:])
+
+        def _validate(hello):
+            r = int(hello["rank"])
+            if r not in expected:
+                raise ConnectionError(f"unexpected rank {r}")
+            return r
+
+        accepts = _accept_handshakes(
+            srv, secret, time.monotonic() + start_timeout,
+            lambda: (f"local root {self.rank}: leaves "
+                     f"{sorted(expected)} did not connect within start "
+                     f"timeout"),
+            _validate)
+        while expected:
+            r, _, ch = next(accepts)
+            ch.send(b"{}", TAG_HANDSHAKE)  # accept ack
+            self._children[r] = ch
+            expected.discard(r)
+        srv.close()
+        self._members = members
+        # Report the IPs the leaves connected from so the coordinator
+        # can answer worker_peer_ip correctly when leaves have their
+        # own network identity (non-loopback deployments).
+        leaf_ips = {r: ch.sock.getpeername()[0]
+                    for r, ch in self._children.items()}
+        self._ch.send(json.dumps({"leaf_ips": leaf_ips}).encode(),
+                      TAG_HANDSHAKE)
+
+    def _become_leaf(self, rank: int, secret: bytes,
+                     start_timeout: float) -> None:
+        """Receive the root-port map, then swap the upward channel from
+        the coordinator to this host's local root."""
+        tag, data = self._ch.recv()
+        if tag != TAG_HANDSHAKE:
+            raise ConnectionError(
+                f"expected root-port map, got tag {tag}")
+        ports = json.loads(data.decode())["roots"]
+        port = int(ports[str(self.topology.cross_rank)])
+        self._ch.close()
+        self._ch = network.connect(_local_root_addr(), port, secret,
+                                   timeout=start_timeout,
+                                   retry_deadline=start_timeout)
+        self._ch.send(json.dumps({"rank": rank}).encode(), TAG_HANDSHAKE)
+        tag, _ = self._ch.recv()
+        if tag != TAG_HANDSHAKE:
+            raise ConnectionError("local root handshake failed")
+
+    # -- per-cycle primitives (relay through _children when present) -----
+    def _recv_child(self, r: int, tag: int) -> bytes:
+        t, data = self._children[r].recv()
+        if t != tag:
+            raise ConnectionError(
+                f"expected tag {tag} from local rank {r}, got {t}")
+        return data
+
+    def _gather_up(self, payload, tag: int) -> None:
+        if self._children:
+            payload = pack_frames([
+                payload if r == self.rank else self._recv_child(r, tag)
+                for r in self._members])
+        self._ch.send(payload, tag)
 
     def gather_requests(self, payload: bytes) -> Optional[List[bytes]]:
-        self._ch.send(payload, TAG_REQUESTS)
+        self._gather_up(payload, TAG_REQUESTS)
         return None
 
     def broadcast_responses(self, payload: Optional[bytes]) -> bytes:
         tag, data = self._ch.recv()
         if tag != TAG_RESPONSES:
             raise ConnectionError(f"expected TAG_RESPONSES, got {tag}")
+        for ch in self._children.values():
+            ch.send(data, TAG_RESPONSES)
         return data
 
     def gather_data(self, payload: bytes) -> Optional[List[bytes]]:
-        self._ch.send(_as_buffer(payload), TAG_DATA)
+        self._gather_up(_as_buffer(payload), TAG_DATA)
         return None
 
     def broadcast_data(self, payload: Optional[bytes],
                        root_rank: int = 0) -> bytes:
         payload = _as_buffer(payload)
         if payload is not None and self.rank == root_rank:
-            # Root sends up; the coordinator fans out to the others
-            # only — our own copy is already authoritative.
+            # Root sends up; the coordinator fans out to the other
+            # channels only — our own copy is already authoritative,
+            # and our local leaves get it straight from us.
             self._ch.send(payload, TAG_DATA)
+            for ch in self._children.values():
+                ch.send(payload, TAG_DATA)
             return payload
+        if root_rank in self._children:
+            # The root is one of our leaves: relay its payload upward
+            # and to its local siblings; the coordinator serves the
+            # rest of the world and skips this whole host.
+            data = self._recv_child(root_rank, TAG_DATA)
+            self._ch.send(data, TAG_DATA)
+            for r, ch in self._children.items():
+                if r != root_rank:
+                    ch.send(data, TAG_DATA)
+            return data
         tag, data = self._ch.recv()
         if tag != TAG_DATA:
             raise ConnectionError(f"expected TAG_DATA, got {tag}")
+        for ch in self._children.values():
+            ch.send(data, TAG_DATA)
         return data
 
     def scatter_data(self, payloads: Optional[List[bytes]]) -> bytes:
         tag, data = self._ch.recv()
         if tag != TAG_DATA:
             raise ConnectionError(f"expected TAG_DATA, got {tag}")
+        if self._children:
+            frames = unpack_frames(data)
+            mine: Optional[bytes] = None
+            for r, f in zip(self._members, frames):
+                if r == self.rank:
+                    mine = f
+                else:
+                    self._children[r].send(f, TAG_DATA)
+            assert mine is not None
+            return mine
         return data
 
     def close(self) -> None:
+        for ch in self._children.values():
+            ch.close()
         self._ch.close()

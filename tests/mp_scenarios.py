@@ -1130,6 +1130,100 @@ def scenario_rank_subset_order(hvd, rank, size):
                                    np.full(5, float(total)))
 
 
+def scenario_hier_controller(hvd, rank, size):
+    """Hierarchical control plane on a forced multihost topology
+    (HOROVOD_HOSTNAME set by the harness): remote leaves must have
+    migrated behind their host's local root, the coordinator must hold
+    one channel per remote host, and every collective — hence every
+    relayed control/data primitive, including broadcast from each kind
+    of rank — must still be exact (control-plane analog of
+    reference: horovod/common/operations.cc:729-764)."""
+    from horovod_tpu.common import basics as _b
+
+    rt = _b.runtime()
+    ctl = rt.controller
+    topo = ctl.topology
+    assert topo.cross_size > 1, "scenario expects a multihost topology"
+    if rank == 0:
+        # Fan-in = host-0 leaves + one channel per remote host.
+        expected_fanin = (topo.local_sizes[0] - 1) + (topo.cross_size - 1)
+        assert len(ctl._channels) == expected_fanin, (
+            len(ctl._channels), expected_fanin)
+        assert ctl._has_aggregates, ctl._members
+        agg = {o: ms for o, ms in ctl._members.items() if len(ms) > 1}
+        assert agg, "no aggregate channels at the coordinator"
+    elif topo.local_rank == 0:
+        assert len(ctl._children) == topo.local_size - 1, ctl._children
+    else:
+        assert not ctl._children
+        if topo.cross_rank != 0:
+            # migrated: upward channel is the loopback root, not the
+            # coordinator listener
+            assert ctl._ch.sock.getpeername()[0] == "127.0.0.1"
+
+    # allreduce incl. fusion through the aggregated gather
+    handles = [hvd.allreduce_async(
+        np.full(8, float(rank + 1) * (i + 1), np.float64),
+        average=False, name=f"hc/ar{i}") for i in range(12)]
+    ssum = sum(range(1, size + 1))
+    for i, h in enumerate(handles):
+        np.testing.assert_allclose(
+            hvd.synchronize(h), np.full(8, ssum * (i + 1), np.float64))
+
+    # variable-dim0 allgather (exercises per-rank sizes surviving the
+    # aggregate frame unpack in rank order)
+    out = hvd.allgather(np.full((rank + 1, 2), float(rank), np.float32),
+                        name="hc/ag")
+    off = 0
+    for r in range(size):
+        np.testing.assert_allclose(out[off:off + r + 1],
+                                   np.full((r + 1, 2), float(r)))
+        off += r + 1
+
+    # broadcast from EVERY root: coordinator, host-0 leaf, remote
+    # root, remote leaf — each takes a different relay branch
+    for root in range(size):
+        x = np.full((5,), float(rank * 10), np.float64)
+        outb = hvd.broadcast(x, root_rank=root, name=f"hc/bc{root}")
+        np.testing.assert_allclose(outb, np.full((5,), float(root * 10)))
+
+    # alltoall + reducescatter + barrier over the relayed data plane
+    per = 2
+    x = np.arange(size * per, dtype=np.float32) + 100 * rank
+    outa = hvd.alltoall(x, name="hc/a2a")
+    expected = np.concatenate(
+        [np.arange(rank * per, (rank + 1) * per) + 100 * src
+         for src in range(size)]).astype(np.float32)
+    np.testing.assert_allclose(outa, expected)
+
+    x = np.arange(size * 3, dtype=np.float32) * (rank + 1)
+    outr = hvd.reducescatter(x, name="hc/rs")
+    np.testing.assert_allclose(
+        outr, np.arange(rank * 3, (rank + 1) * 3) * ssum)
+
+    hvd.barrier(name="hc/bar")
+
+
+def scenario_flat_controller_multihost(hvd, rank, size):
+    """With HOROVOD_TPU_HIER_CONTROLLER=0 a multihost topology keeps
+    the flat star: every worker stays directly connected to the
+    coordinator and no aggregate channels exist."""
+    from horovod_tpu.common import basics as _b
+
+    ctl = _b.runtime().controller
+    assert ctl.topology.cross_size > 1
+    if rank == 0:
+        assert len(ctl._channels) == size - 1, len(ctl._channels)
+        assert not ctl._has_aggregates
+    else:
+        assert not ctl._children
+    out = hvd.allreduce(np.full(6, float(rank + 1), np.float32),
+                        average=False, name="flat/ar")
+    np.testing.assert_allclose(
+        out, np.full(6, sum(range(1, size + 1)), np.float32))
+    hvd.barrier(name="flat/bar")
+
+
 def scenario_topology(hvd, rank, size):
     assert hvd.rank() == rank
     assert hvd.size() == size

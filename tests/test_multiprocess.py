@@ -176,6 +176,44 @@ def test_shm_hierarchical_allreduce_uneven_hosts():
             "HOROVOD_HOSTNAME": f"fakehost{min(rank, 1)}"})
 
 
+def test_hier_controller_two_hosts():
+    """4 ranks on 2 fake hosts: remote leaves migrate behind their
+    local root, coordinator fan-in drops to 2, and the full collective
+    mix stays exact end-to-end through the aggregated control plane."""
+    run_scenario(
+        "hier_controller", 4, timeout=180.0,
+        per_rank_env=lambda rank: {
+            "HOROVOD_HOSTNAME": f"fakehost{rank // 2}"})
+
+
+def test_hier_controller_uneven_hosts():
+    """5 ranks split 2+3: the remote host aggregates three ranks; the
+    rank-order of frames inside the aggregate must survive."""
+    run_scenario(
+        "hier_controller", 5, timeout=180.0,
+        per_rank_env=lambda rank: {
+            "HOROVOD_HOSTNAME": f"fakehost{min(rank // 2, 1)}"})
+
+
+def test_hier_controller_three_hosts():
+    """6 ranks on 3 fake hosts (2 each): multiple aggregate channels
+    at the coordinator simultaneously."""
+    run_scenario(
+        "hier_controller", 6, timeout=240.0,
+        per_rank_env=lambda rank: {
+            "HOROVOD_HOSTNAME": f"fakehost{rank // 2}"})
+
+
+def test_hier_controller_disabled_falls_back_flat():
+    """HOROVOD_TPU_HIER_CONTROLLER=0 on the same topology keeps the
+    flat star: no migration, no aggregate channels."""
+    run_scenario(
+        "flat_controller_multihost", 4, timeout=180.0,
+        extra_env={"HOROVOD_TPU_HIER_CONTROLLER": "0"},
+        per_rank_env=lambda rank: {
+            "HOROVOD_HOSTNAME": f"fakehost{rank // 2}"})
+
+
 def test_shape_mismatch_error():
     run_scenario("shape_mismatch_error", 2)
 
