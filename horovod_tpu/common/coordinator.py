@@ -17,6 +17,7 @@ provides by construction.
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Dict, List, Tuple
 
 from horovod_tpu.common import logging as hlog
@@ -281,10 +282,15 @@ def fuse_responses(responses: List[Response],
                if slice_numels is not None
                else (ResponseType.ALLREDUCE,))
     slice_numels = slice_numels or {}
-    queue = list(responses)
+    # Deques keep every enqueue/dequeue O(1): the previous list.pop(0)
+    # version shifted the whole remainder on each pop, which made even
+    # the no-fusion pass O(n^2) — invisible at 8 tensors/cycle, real
+    # money in a 64-rank many-tensor storm (guarded by
+    # tests/test_coordinator.py::test_coordinator_cycle_cost_64_ranks).
+    queue = deque(responses)
     fused: List[Response] = []
     while queue:
-        resp = queue.pop(0)
+        resp = queue.popleft()
         if resp.response_type not in fusable:
             fused.append(resp)
             continue
@@ -293,9 +299,9 @@ def fuse_responses(responses: List[Response],
         if tensor_bytes >= fusion_threshold_bytes:
             fused.append(resp)
             continue
-        skipped: List[Response] = []
+        skipped: deque = deque()
         while queue:
-            cand = queue.pop(0)
+            cand = queue.popleft()
             joinable = (
                 cand.response_type == resp.response_type
                 and dtypes[cand.tensor_names[0]] == dtype

@@ -26,7 +26,8 @@ from horovod_tpu.ops import Average, Sum  # noqa: F401
 from horovod_tpu.keras import callbacks  # noqa: F401
 
 
-def _distributed_class(cls, compression, op: int):
+def _distributed_class(cls, compression, op: int,
+                       sparse_as_dense: bool = False):
     """Subclass of optimizer class ``cls`` whose ``apply_gradients``
     first averages gradients across workers (reference:
     _keras/__init__.py:20-70 create_distributed_optimizer, which
@@ -74,7 +75,14 @@ def _distributed_class(cls, compression, op: int):
         if backend == "tensorflow":
             import tensorflow as tf
             if isinstance(g, tf.IndexedSlices):
-                return _reduce_sparse(g, idx, tf)
+                if sparse_as_dense:
+                    # densify (scatter-add) and ride the dense reduce —
+                    # wins when the embedding is small enough that one
+                    # psum beats gathering all ranks' slices
+                    # (reference: tensorflow/__init__.py:157,195-202)
+                    g = tf.convert_to_tensor(g)
+                else:
+                    return _reduce_sparse(g, idx, tf)
             if not tf.executing_eagerly():
                 out = tf.py_function(
                     lambda t: _host_allreduce(t.numpy(), idx), [g],
@@ -120,14 +128,15 @@ def _distributed_class(cls, compression, op: int):
 
 
 def DistributedOptimizer(optimizer, compression=Compression.none,
-                         op: int = Average, name: Optional[str] = None):
+                         op: int = Average, name: Optional[str] = None,
+                         sparse_as_dense: bool = False):
     """Wrap a live Keras-3 optimizer instance; see _distributed_class.
 
     The instance is re-classed rather than rebuilt from config: a
     from_config round-trip would silently drop accumulated slot
     variables / iteration count on load_model-restored optimizers."""
     optimizer.__class__ = _distributed_class(
-        optimizer.__class__, compression, op)
+        optimizer.__class__, compression, op, sparse_as_dense)
     return optimizer
 
 

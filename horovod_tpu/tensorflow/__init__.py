@@ -39,19 +39,29 @@ def _to_tf(arr, like):
 
 
 def allreduce(tensor, op: int = Average, name: Optional[str] = None,
-              compression=Compression.none):
+              compression=Compression.none,
+              sparse_as_dense: bool = False):
     """Sparse tensors (tf.IndexedSlices) take the allgather path like
-    the reference (reference: horovod/tensorflow/__init__.py:46-92)."""
+    the reference (reference: horovod/tensorflow/__init__.py:46-92),
+    unless ``sparse_as_dense`` densifies them first — a win for
+    moderately sized embeddings where one dense psum beats gathering
+    every rank's slices (reference: horovod/tensorflow/__init__.py:
+    157,195-202 convert_to_tensor before allreduce)."""
     import tensorflow as tf
     if isinstance(tensor, tf.IndexedSlices):
-        values = allgather(tensor.values, name=f"{name}.values"
-                           if name else None)
-        indices = allgather(tensor.indices, name=f"{name}.indices"
-                            if name else None)
-        if op == Average:
-            values = values / size()
-        return tf.IndexedSlices(values, indices,
-                                dense_shape=tensor.dense_shape)
+        if sparse_as_dense:
+            # scatter-add into the dense shape; duplicated indices sum,
+            # matching the gather path's effective gradient.
+            tensor = tf.convert_to_tensor(tensor)
+        else:
+            values = allgather(tensor.values, name=f"{name}.values"
+                               if name else None)
+            indices = allgather(tensor.indices, name=f"{name}.indices"
+                                if name else None)
+            if op == Average:
+                values = values / size()
+            return tf.IndexedSlices(values, indices,
+                                    dense_shape=tensor.dense_shape)
     resolved = name if name is not None else _ops._auto_name("allreduce")
 
     def _host_allreduce(t, op_name):
@@ -221,9 +231,12 @@ class DistributedGradientTape:
 
 
 def DistributedOptimizer(optimizer, compression=Compression.none,
-                         op: int = Average):
+                         op: int = Average,
+                         sparse_as_dense: bool = False):
     """Wrap a tf.keras optimizer: apply_gradients averages first
-    (reference: horovod/tensorflow/__init__.py:151-249)."""
+    (reference: horovod/tensorflow/__init__.py:151-249;
+    ``sparse_as_dense`` densifies IndexedSlices gradients before the
+    reduce, :157,195-202)."""
     cls = optimizer.__class__
 
     class _Distributed(cls):
@@ -236,9 +249,10 @@ def DistributedOptimizer(optimizer, compression=Compression.none,
                 if g is None:
                     reduced.append((None, v))
                     continue
-                reduced.append((allreduce(g, op=op,
-                                          name=f"tfopt.grad.{i}",
-                                          compression=compression), v))
+                reduced.append((allreduce(
+                    g, op=op, name=f"tfopt.grad.{i}",
+                    compression=compression,
+                    sparse_as_dense=sparse_as_dense), v))
             return super().apply_gradients(reduced, *args, **kwargs)
 
     config = optimizer.get_config()
@@ -247,16 +261,58 @@ def DistributedOptimizer(optimizer, compression=Compression.none,
     return dist
 
 
-class BroadcastGlobalVariablesHook:
-    """TF1 SessionRunHook stub kept for API parity; eager TF2 should
-    call broadcast_variables instead (reference:
-    horovod/tensorflow/__init__.py:117-148)."""
+_hook_cls = None
 
-    def __init__(self, root_rank: int = 0, device: str = ""):
-        self.root_rank = root_rank
 
-    def after_create_session(self, session, coord):
-        broadcast_global_variables(self.root_rank)
+def BroadcastGlobalVariablesHook(root_rank: int = 0, device: str = ""):
+    """SessionRunHook that broadcasts rank 0's global variables after
+    session creation (reference: horovod/tensorflow/__init__.py:
+    117-148). Returns an instance of a real
+    ``tf.compat.v1.train.SessionRunHook`` subclass (built lazily so
+    importing this module never imports TF), so estimator/
+    MonitoredSession isinstance checks accept it and the broadcast
+    actually runs — in graph mode through the session (read via
+    ``session.run``, write via ``Variable.load``), in eager through
+    ``broadcast_variables``."""
+    global _hook_cls
+    if _hook_cls is None:
+        import tensorflow as tf
+        try:
+            base = tf.compat.v1.train.SessionRunHook
+        except AttributeError:  # exotic TF builds without compat.v1
+            base = object
+
+        class _BroadcastHook(base):
+            def __init__(self, root_rank: int, device: str = ""):
+                self.root_rank = root_rank
+
+            def begin(self):
+                pass
+
+            def after_create_session(self, session, coord):
+                import tensorflow as tf
+                variables = tf.compat.v1.global_variables()
+                if session is None:  # eager / no-session harnesses
+                    broadcast_variables(variables, self.root_rank)
+                    return
+                for i, var in enumerate(variables):
+                    host = np.asarray(session.run(var))
+                    out = _ops.broadcast(host, root_rank=self.root_rank,
+                                         name=f"tf.hook.bcast.{i}")
+                    var.load(np.asarray(out).astype(host.dtype)
+                             .reshape(host.shape), session)
+
+            def before_run(self, run_context):
+                return None
+
+            def after_run(self, run_context, run_values):
+                pass
+
+            def end(self, session):
+                pass
+
+        _hook_cls = _BroadcastHook
+    return _hook_cls(root_rank, device)
 
 
 __all__ = [

@@ -28,6 +28,34 @@ except ImportError:
     pass
 
 
+# Modules whose tests spawn real worker processes (TCP worlds, example
+# smoke runs, launchers): the expensive integration tier. Everything
+# else is the fast in-process tier (reference precedent: the
+# single-process vs mpirun suite split, .travis.yml:109-122).
+_MP_MODULES = {
+    "test_multiprocess", "test_examples", "test_launcher",
+    "test_spark", "test_autotune_mp", "test_timeline",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "mp: spawns worker subprocesses (slow integration "
+        "tier; deselect with -m 'not mp' for the ~2-minute fast "
+        "suite)")
+    config.addinivalue_line(
+        "markers", "fast: in-process unit tier (alias: -m fast == "
+        "-m 'not mp')")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.module.__name__.rsplit(".", 1)[-1] in _MP_MODULES:
+            item.add_marker(pytest.mark.mp)
+        else:
+            item.add_marker(pytest.mark.fast)
+
+
 @pytest.fixture()
 def hvd_world():
     """A fresh size-1 horovod_tpu world per test."""

@@ -1458,6 +1458,70 @@ def scenario_jax_adapter(hvd_mod, rank, size):
 
 
 
+def scenario_tf_sparse_as_dense(hvd_mod, rank, size):
+    """sparse_as_dense=True must produce the same effective gradient
+    as the IndexedSlices gather path, bit-for-bit on exactly
+    representable values (reference:
+    horovod/tensorflow/__init__.py:157,195-202). Uses overlapping AND
+    duplicated indices so scatter-add summing is actually exercised."""
+    import tensorflow as tf
+    import horovod_tpu.tensorflow as hvd_tf
+
+    # rank r touches rows {r, r+1} of a 4-row embedding, with row
+    # r+1 duplicated — integer-valued floats keep both paths exact
+    values = tf.constant(np.array(
+        [[2.0 * (rank + 1)] * 3,
+         [4.0 * (rank + 1)] * 3,
+         [6.0 * (rank + 1)] * 3], np.float32))
+    indices = tf.constant(np.array([rank, rank + 1, rank + 1], np.int64))
+    dense_shape = tf.constant([size + 1, 3], tf.int64)
+
+    def _make():
+        return tf.IndexedSlices(values, indices, dense_shape=dense_shape)
+
+    # gather path -> IndexedSlices; densify to compare
+    sparse_out = hvd_tf.allreduce(_make(), op=hvd_tf.Average,
+                                  name="sad.gather")
+    assert isinstance(sparse_out, tf.IndexedSlices)
+    via_gather = tf.scatter_nd(
+        tf.expand_dims(sparse_out.indices, 1), sparse_out.values,
+        dense_shape).numpy()
+
+    # dense path -> plain tensor
+    dense_out = hvd_tf.allreduce(_make(), op=hvd_tf.Average,
+                                 name="sad.dense", sparse_as_dense=True)
+    assert not isinstance(dense_out, tf.IndexedSlices)
+    assert dense_out.shape == (size + 1, 3)
+
+    np.testing.assert_array_equal(dense_out.numpy(), via_gather)
+
+    # and through DistributedOptimizer(sparse_as_dense=True): the
+    # applied update must equal the gather-path update exactly
+    var = tf.Variable(np.zeros((size + 1, 3), np.float32))
+    opt = hvd_tf.DistributedOptimizer(
+        tf.keras.optimizers.SGD(1.0), sparse_as_dense=True)
+    opt.apply_gradients([(_make(), var)])
+    np.testing.assert_array_equal(var.numpy(), -via_gather)
+
+
+def scenario_tf_broadcast_hook(hvd_mod, rank, size):
+    """BroadcastGlobalVariablesHook must be a REAL SessionRunHook that
+    broadcasts rank 0's variables through a TF1 MonitoredTrainingSession
+    (reference: horovod/tensorflow/__init__.py:117-148)."""
+    import tensorflow as tf
+    tf.compat.v1.disable_eager_execution()
+    import horovod_tpu.tensorflow as hvd_tf
+
+    v = tf.compat.v1.get_variable(
+        "v", initializer=np.full((3, 2), float(rank + 7), np.float32))
+    hook = hvd_tf.BroadcastGlobalVariablesHook(0)
+    assert isinstance(hook, tf.compat.v1.train.SessionRunHook), type(hook)
+    with tf.compat.v1.train.MonitoredTrainingSession(
+            hooks=[hook]) as sess:
+        out = sess.run(v)
+    np.testing.assert_allclose(out, np.full((3, 2), 7.0))
+
+
 def scenario_keras_optimizer(hvd_mod, rank, size):
     """keras DistributedOptimizer: rank-divergent data, identical
     weights after fit (reference analog: test_keras.py:62-186 +

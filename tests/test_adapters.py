@@ -197,3 +197,52 @@ def test_tf_broadcast_variables(hvd_world, tf_mod):
     v = tf.Variable([5.0, 6.0])
     hvd_tf.broadcast_variables([v], root_rank=0)
     np.testing.assert_allclose(v.numpy(), [5.0, 6.0])
+
+
+# ---------------------------------------------------------------------------
+# mxnet (real wheel, optional)
+# ---------------------------------------------------------------------------
+
+def test_mxnet_real_wheel(hvd_world):
+    """Exercise the MXNet adapter against a REAL mxnet wheel when one
+    is importable. No wheel exists for TPU images, so this leg skips
+    VISIBLY there — the skip message is the honest record that
+    real-NDArray semantics (dtype promotion, views, engine-deferred
+    init) are otherwise validated only by the protocol double
+    (tests/fake_mxnet.py; see docs/parity.md). With a wheel present it
+    validates the round-trip the double cannot: adapter outputs must be
+    genuine mx.nd.NDArrays that the engine accepts downstream."""
+    mx = pytest.importorskip(
+        "mxnet",
+        reason="no real mxnet wheel on this image - MXNet adapter "
+               "semantics validated only against the NDArray-protocol "
+               "double (tests/fake_mxnet.py); see docs/parity.md")
+    import horovod_tpu.mxnet as hmx
+
+    x = mx.nd.array(np.arange(6, dtype=np.float32))
+    out = hmx.allreduce(x, average=False, name="mxreal.ar")
+    assert isinstance(out, mx.nd.NDArray)
+    np.testing.assert_allclose(out.asnumpy(), np.arange(6))
+    # engine accepts the result downstream (not just a protocol look-alike)
+    np.testing.assert_allclose((out * 2).asnumpy(), np.arange(6) * 2)
+
+    hmx.allreduce_(x, average=True, name="mxreal.ar_")
+    np.testing.assert_allclose(x.asnumpy(), np.arange(6))
+
+    g = hmx.allgather(mx.nd.array(np.ones((2, 3), np.float32)),
+                      name="mxreal.ag")
+    assert isinstance(g, mx.nd.NDArray) and g.shape == (2, 3)
+
+    b = hmx.broadcast(mx.nd.array(np.full(4, 7.0, np.float64)),
+                      root_rank=0, name="mxreal.bc")
+    assert b.dtype == np.float64
+    np.testing.assert_allclose(b.asnumpy(), 7.0)
+
+    params = {"w": mx.nd.zeros((3,)), "b": mx.nd.ones((2,))}
+    hmx.broadcast_parameters(params, root_rank=0)
+    opt = hmx.DistributedOptimizer(mx.optimizer.SGD(learning_rate=0.1))
+    w = mx.nd.ones((3,))
+    grad = mx.nd.full((3,), 2.0)
+    state = opt.create_state(0, w)
+    opt.update(0, w, grad, state)
+    np.testing.assert_allclose(w.asnumpy(), 1.0 - 0.1 * 2.0, rtol=1e-5)

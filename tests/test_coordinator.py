@@ -203,3 +203,72 @@ class TestFusion:
                        tensor_names=["x"], error_message="boom")
         fused = fuse_responses([err], {}, 1 << 20)
         assert fused == [err]
+
+
+class TestCycleCost:
+    """Coordinator cycle-cost regression guards: the 64-rank
+    many-tensor storm the scaling projection depends on must stay
+    cheap (docs/benchmarks.md budgets ~1 ms/cycle at 64 ranks; bounds
+    here are several-x that so scheduler noise on a shared vCPU can't
+    flake them, while a complexity regression — e.g. the list.pop(0)
+    scan fuse_responses used to do — still trips them by an order of
+    magnitude)."""
+
+    def test_fuse_responses_scales_linearly(self):
+        """20k pass-through responses (each over threshold) must fuse
+        in far less than the seconds the quadratic pop(0) version
+        took — the deque walk does ~20k O(1) steps."""
+        import time as _t
+        n = 20_000
+        dtypes = {f"t{i}": DataType.FLOAT32 for i in range(n)}
+        responses = [
+            Response(response_type=ResponseType.ALLREDUCE,
+                     tensor_names=[f"t{i}"], devices=[-1, -1],
+                     tensor_sizes=[1024])
+            for i in range(n)]
+        t0 = _t.perf_counter()
+        fused = fuse_responses(responses, dtypes,
+                               fusion_threshold_bytes=64)
+        elapsed = _t.perf_counter() - t0
+        assert len(fused) == n
+        assert elapsed < 0.5, f"fuse_responses took {elapsed:.2f}s " \
+            f"for {n} pass-through responses - complexity regression"
+
+    def test_coordinator_cycle_cost_64_ranks(self):
+        """Full coordinator half-cycle (parse 64 RequestLists, count
+        readiness, construct + fuse + serialize) at 64 simulated ranks
+        x 8 tensors. Min-of-7 bounds the intrinsic cost free of
+        scheduler noise."""
+        import time as _t
+
+        from horovod_tpu.common import wire
+        from horovod_tpu.common.message import RequestList, ResponseList
+
+        n_ranks, tensors = 64, 8
+        payloads = [
+            wire.serialize_request_list(RequestList([
+                _req(r, name=f"grad.{t}", shape=(1024,))
+                for t in range(tensors)]))
+            for r in range(n_ranks)]
+        best = float("inf")
+        for _ in range(7):
+            t0 = _t.perf_counter()
+            table = MessageTable()
+            dtypes, slices = {}, {}
+            for data in payloads:
+                rl = wire.parse_request_list(data)
+                for req in rl.requests:
+                    dtypes[req.tensor_name] = req.tensor_type
+                    slices[req.tensor_name] = 1
+                    table.increment_tensor_count(req, n_ranks)
+            responses = [construct_response(table, name, n_ranks)
+                         for name in table.pop_ready()]
+            fused = fuse_responses(responses, dtypes, 64 << 20, slices)
+            wire.serialize_response_list(ResponseList(fused))
+            best = min(best, _t.perf_counter() - t0)
+        assert len(fused) == 1  # all 8 grads fuse into one batch
+        budget_s = 5e-3
+        assert best < budget_s, (
+            f"coordinator cycle took {best * 1e3:.2f} ms at "
+            f"{n_ranks} ranks (budget {budget_s * 1e3:.0f} ms) - "
+            f"per-cycle cost regression")

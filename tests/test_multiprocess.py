@@ -277,6 +277,18 @@ def test_tf_allreduce_grad():
     run_scenario("tf_allreduce_grad", 2, timeout=180.0)
 
 
+def test_tf_sparse_as_dense():
+    """sparse_as_dense=True matches the IndexedSlices gather path
+    bit-for-bit on an embedding gradient."""
+    run_scenario("tf_sparse_as_dense", 2, timeout=180.0)
+
+
+def test_tf_broadcast_hook():
+    """BroadcastGlobalVariablesHook drives a real TF1
+    MonitoredTrainingSession broadcast."""
+    run_scenario("tf_broadcast_hook", 2, timeout=180.0)
+
+
 def test_tf_gather_bcast_grad():
     """Differentiable allgather (variable dim-0) and broadcast
     (root-only gradient), 3 ranks."""
