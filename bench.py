@@ -1,27 +1,31 @@
-"""Synthetic ResNet-50 throughput benchmark (driver-run, real TPU).
+"""Synthetic training benchmarks (driver-run, real TPU).
 
 TPU-native re-founding of the reference's synthetic benchmarks
 (reference: examples/pytorch_synthetic_benchmark.py:95-110,
-examples/tensorflow_synthetic_benchmark.py; docs/benchmarks.md:12-33):
-same workload (ResNet-50, synthetic ImageNet-shaped data, SGD-momentum)
-— but with THIS framework in the measured loop, the way a user would
-run it: ``horovod_tpu.jax.DistributedOptimizer`` wrapping the optax
+examples/tensorflow_synthetic_benchmark.py; docs/benchmarks.md:12-33),
+with THIS framework in the measured loop the way a user would run it:
+``horovod_tpu.jax.DistributedOptimizer`` wrapping the optax
 transformation inside a shard_map'd train step over the device mesh
 (gradient pmean over the data axis), parameters broadcast through the
 framework at start, and donated buffers so XLA updates weights in
 place.
 
-Also reported: MFU, from XLA's own per-step flop count
-(compiled cost analysis; analytic ResNet-50 fallback) against the
-chip's peak bf16 FLOPs.
+Two workloads, one JSON line:
+
+1. **ResNet-50** (the reference's own headline): ImageNet-shaped
+   synthetic data, SGD-momentum, batch 256. HBM-roofline-bound on
+   every TPU generation — its MFU cap is ~33.5% on v5e and the bench
+   reports achieved bandwidth + MFU vs that cap (docs/benchmarks.md
+   "MFU roofline study").
+2. **Transformer-LM** (compute-bound): 12-layer d=2048 735M-param
+   causal LM, seq 2048, bf16, pallas flash attention, chunked
+   lm-head cross-entropy, SGD-momentum. This is the workload that can
+   actually demonstrate framework speed on the MXU — its steady-state
+   training MFU is emitted as ``transformer_hvd_train_mfu``.
 
 Baseline: the reference's published example readout is 1656.82 img/s on
 16 Pascal GPUs = 103.55 img/s per device (docs/benchmarks.md:29-33).
 ``vs_baseline`` is img/s-per-chip divided by that number.
-
-Prints exactly one JSON line:
-    {"metric": ..., "value": N, "unit": "images/sec/chip",
-     "vs_baseline": N, "mfu": N, ...}
 
 The collective-path microbenches (bus bandwidth through the full
 negotiate->fuse->execute pipeline, N-process scaling efficiency) live
@@ -74,6 +78,103 @@ def _tpu_gen() -> str:
 
 def _peak_flops(n_dev: int) -> float:
     return _PEAK_BF16.get(_tpu_gen(), _PEAK_BF16["v5e"]) * n_dev
+
+
+def _bench_transformer(n_dev: int) -> dict:
+    """Steady-state transformer-LM training MFU with the framework in
+    the loop (the compute-bound companion to the ResNet leg). MFU
+    convention: model flops = tokens x (6 x matmul-params +
+    12 x L x S x d) — the PaLM accounting, full causal square, on the
+    same peak-spec basis as the chip's bf16 rating; the causal kernels
+    execute ~5% fewer (flops_ratio reports it)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu.jax as hvd
+    from horovod_tpu import spmd
+    from horovod_tpu.models.transformer import (
+        TransformerConfig, TransformerLM, lm_loss_from_hidden,
+    )
+    from horovod_tpu.utils.timing import steady_state_sec_per_step
+
+    per_chip_batch = int(os.environ.get("HVD_BENCH_LM_BATCH", "4"))
+    seq = int(os.environ.get("HVD_BENCH_LM_SEQ", "2048"))
+    batch = per_chip_batch * n_dev
+    cfg = TransformerConfig(vocab_size=32000, num_layers=12,
+                            num_heads=16, head_dim=128,
+                            max_seq_len=seq, dtype=jnp.bfloat16)
+    model = TransformerLM(cfg)
+    rng = jax.random.key(0)
+    tokens = jnp.zeros((batch, seq), jnp.int32)
+    mesh = spmd.create_mesh({"data": n_dev})
+    if n_dev > 1:
+        tokens = jax.device_put(tokens, spmd.batch_sharding(mesh))
+    variables = jax.jit(lambda r, t: model.init(r, t))(rng, tokens)
+    params = variables["params"]
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+    d = cfg.embed_dim
+
+    tx = hvd.DistributedOptimizer(
+        optax.sgd(0.01, momentum=0.9), axis="data")
+    opt_state = tx.init(params)
+    params = hvd.broadcast_parameters(params, root_rank=0)
+
+    def loss_fn(p, t):
+        hidden = model.apply({"params": p}, t, return_hidden=True)
+        return lm_loss_from_hidden(hidden, p["lm_head"]["kernel"], t)
+
+    def step(p, os_, t):
+        loss, grads = jax.value_and_grad(loss_fn)(p, t)
+        updates, new_os = tx.update(grads, os_, p)
+        return optax.apply_updates(p, updates), new_os, loss
+
+    from jax.sharding import PartitionSpec as P
+    rep = P()
+    step = jax.shard_map(step, mesh=mesh, in_specs=(rep, rep, P("data")),
+                         out_specs=(rep, rep, rep), check_vma=False)
+    train = jax.jit(step, donate_argnums=(0, 1)).lower(
+        params, opt_state, tokens).compile()
+    hw_flops = None
+    try:
+        ca = train.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        fl = float(ca["flops"])
+        hw_flops = fl if np.isfinite(fl) and fl > 0 else None
+    except Exception:
+        pass
+
+    st = {"p": params, "os": opt_state}
+
+    def one_step():
+        st["p"], st["os"], loss = train(st["p"], st["os"], tokens)
+        return loss
+
+    sec = steady_state_sec_per_step(
+        one_step, lambda l: float(l), warmup_steps=5, chunks=4,
+        chunk_steps=15)
+    tokens_per_step = batch * seq
+    # matmul params: everything but the embedding table (a gather);
+    # the fp32 lm_head IS a matmul and is included in n_params.
+    p_mm = n_params - cfg.vocab_size * d
+    model_flops = tokens_per_step * (
+        6 * p_mm + 12 * cfg.num_layers * seq * d)
+    peak = _peak_flops(n_dev)
+    out = {
+        "config": f"L{cfg.num_layers} d{d} S{seq} B{batch} "
+                  f"V{cfg.vocab_size}",
+        "n_params_M": round(n_params / 1e6, 1),
+        "tokens_per_sec": round(tokens_per_step / sec),
+        "sec_per_step": round(sec, 4),
+        "mfu": round(model_flops / sec / peak, 4),
+    }
+    if hw_flops is not None:
+        out["hfu"] = round(hw_flops / sec / peak, 4)
+        out["flops_ratio_executed_vs_model"] = round(
+            hw_flops / model_flops, 3)
+    return out
 
 
 def main() -> None:
@@ -239,6 +340,16 @@ def main() -> None:
             cap * model_step_flops / hw_step_flops, 4)
         result["mfu_vs_roofline"] = round(
             result["mfu"] / result["roofline_mfu_cap"], 4)
+
+    # Second, compute-bound metric: transformer-LM training MFU (the
+    # proof the ResNet number is the workload's roofline, not the
+    # framework). Failure must not cost the primary metric.
+    try:
+        lm = _bench_transformer(n_dev)
+        result["transformer_hvd_train_mfu"] = lm["mfu"]
+        result["transformer"] = lm
+    except Exception as e:
+        result["transformer_error"] = repr(e)
     print(json.dumps(result))
     hvd.shutdown()
 

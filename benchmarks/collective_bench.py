@@ -380,9 +380,8 @@ def _project_scaling(overheads: dict, hier_overheads: dict,
     # Relay hop cost: how much a measured hierarchical world exceeds
     # the pure fan-in prediction (extra leaf->root->coordinator hop;
     # on this 1-vCPU host it also absorbs the extra processes'
-    # scheduling). The UPPER residual is charged — deliberately
-    # conservative (with two layouts this is the worst measurement,
-    # not a median). Clamp at 0 so noise can't make hierarchy look
+    # scheduling). The WORST residual is charged — deliberately
+    # conservative. Clamp at 0 so noise can't make hierarchy look
     # better than the fan-in model allows.
     residuals = []
     hier_meas = {}
@@ -393,8 +392,7 @@ def _project_scaling(overheads: dict, hier_overheads: dict,
             "barrier_us": d["barrier_us"], "fanin": d["fanin"],
             "fit_pred_us": round(pred, 1),
         }
-    hop = max(0.0, sorted(residuals)[len(residuals) // 2]) \
-        if residuals else 0.0
+    hop = max(0.0, max(residuals)) if residuals else 0.0
     budget_us = step_budget_ms * 1e3
     proj = {}
     for n in (8, 16, 64):
@@ -686,6 +684,7 @@ def main() -> None:
                 fanin = (per_host - 1) + (n_hosts - 1)
                 vals = [_run_world(
                     "overhead", np_,
+                    extra_env={"HOROVOD_TPU_HIER_CONTROLLER": "1"},
                     per_rank_env=lambda r, ph=per_host: {
                         "HOROVOD_HOSTNAME": f"benchhost{r // ph}"})
                     for _ in range(3)]
