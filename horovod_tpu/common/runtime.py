@@ -89,6 +89,8 @@ class Runtime:
         self._error: Optional[Exception] = None
         # Autotune plumbing: bytes reduced this cycle.
         self._cycle_bytes = 0
+        # Monotone id for async-nestable timeline batches.
+        self._batch_seq = 0
         # Idle backoff: after _IDLE_GRACE empty cycles the loop ramps
         # its sleep toward config.idle_backoff_ms instead of spinning
         # the negotiation at full cycle rate forever (the reference
@@ -323,6 +325,49 @@ class Runtime:
                 self.parameter_manager.fusion_threshold_bytes()
         return resp_list
 
+    class _SpanCloser:
+        """Closes a fused batch's timeline COLLECTIVE + top-level spans
+        exactly once, when the LAST entry's completion callback fires —
+        so async (InProgress) collectives trace their true duration
+        instead of their issue time, the way the reference's CUDA
+        finalizer thread drives Timeline end
+        (reference: cuda_operations.cc:148-179). The deferred spans are
+        Chrome ASYNC NESTABLE events keyed by a per-batch id: a tensor
+        may legally re-negotiate the same name while its previous batch
+        is still in flight, and deferred plain B/E events would mispair
+        on the per-pid stack. Thread-safe: async callbacks arrive from
+        finalizer threads; the timeline is a queue fed from any
+        thread."""
+
+        __slots__ = ("_timeline", "_names", "_op_name", "_batch_id",
+                     "_remaining", "_lock", "_closed")
+
+        def __init__(self, timeline, names, op_name: str,
+                     batch_id: int, n_entries: int):
+            self._timeline = timeline
+            self._names = names
+            self._op_name = op_name
+            self._batch_id = batch_id
+            self._remaining = n_entries
+            self._lock = threading.Lock()
+            self._closed = False
+
+        def entry_done(self) -> None:
+            with self._lock:
+                self._remaining -= 1
+                if self._remaining > 0 or self._closed:
+                    return
+                self._closed = True
+            self._close()
+
+        def _close(self) -> None:
+            for n in self._names:
+                self._timeline.async_end(n, ACT_COLLECTIVE,
+                                         self._batch_id)
+            for n in self._names:
+                self._timeline.async_end(n, self._op_name,
+                                         self._batch_id)
+
     def _perform_operations(self, resp_list: ResponseList) -> None:
         """Execute each agreed response and fire callbacks
         (reference: operations.cc:450-539 PerformOperation)."""
@@ -341,9 +386,24 @@ class Runtime:
             if not entries and response.response_type != ResponseType.BARRIER:
                 continue
             names = [e.tensor_name for e in entries]
-            for e in entries:
-                self.timeline.start(
-                    e.tensor_name, response.response_type.name)
+            op_name = response.response_type.name
+            # Async-capable batches trace through async-nestable span
+            # events closed at COMPLETION by _SpanCloser; everything
+            # else keeps the reference's plain B/E spans.
+            use_async_spans = (self.finalizer is not None
+                               and self.timeline.enabled
+                               and bool(entries))
+            closer = None
+            if use_async_spans:
+                self._batch_seq += 1
+                closer = self._SpanCloser(self.timeline, names, op_name,
+                                          self._batch_seq, len(entries))
+                for n in names:
+                    self.timeline.async_start(n, op_name,
+                                              self._batch_seq)
+            else:
+                for e in entries:
+                    self.timeline.start(e.tensor_name, op_name)
             # Input readiness: the reference polls CUDA ReadyEvents here
             # (operations.cc:507-518) because its backends consume raw
             # device pointers. JAX tensors are futures — every consumer
@@ -356,16 +416,34 @@ class Runtime:
             self.timeline.activity_start_all(names, ACT_QUEUE)
             self.timeline.activity_end_all(names)
 
-            self.timeline.activity_start_all(names, ACT_COLLECTIVE)
+            # Async backends fire entry callbacks from finalizer threads
+            # when the collective COMPLETES; pre-wrap them so the batch's
+            # timeline spans close at that true end (sync backends fire
+            # the same wrappers in-loop below — same path, same result).
+            if use_async_spans:
+                for n in names:
+                    self.timeline.async_start(n, ACT_COLLECTIVE,
+                                              self._batch_seq)
+                for e in entries:
+                    user_cb = e.callback
+
+                    def _cb(status, _u=user_cb, _c=closer):
+                        _c.entry_done()
+                        if _u:
+                            _u(status)
+
+                    e.callback = _cb
+            else:
+                self.timeline.activity_start_all(names, ACT_COLLECTIVE)
             try:
                 status = self.op_manager.execute(entries, response)
             except Exception as e:
                 status = Status.UnknownError(
                     f"collective execution failed: {e!r}")
-            self.timeline.activity_end_all(names)
-
-            for e in entries:
-                self.timeline.end(e.tensor_name)
+            if closer is None:
+                self.timeline.activity_end_all(names)
+                for e in entries:
+                    self.timeline.end(e.tensor_name)
             self._cycle_bytes += sum(
                 getattr(e.tensor, "nbytes", 0) for e in entries)
             if not status.in_progress():

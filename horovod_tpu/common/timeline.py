@@ -44,6 +44,8 @@ class _NoOpTimeline:
     def activity_start_all(self, names, activity): pass
     def activity_end_all(self, names): pass
     def end(self, name): pass
+    def async_start(self, name, event_name, batch_id): pass
+    def async_end(self, name, event_name, batch_id): pass
     def mark_cycle_start(self): pass
     def shutdown(self): pass
 
@@ -131,6 +133,29 @@ class Timeline(_NoOpTimeline):
 
     def end(self, name: str) -> None:
         self._emit("E", name, "")
+
+    # -- async (deferred-close) spans -----------------------------------
+    # Chrome/Perfetto ASYNC NESTABLE events ("b"/"e"), paired by
+    # (category, id, name) instead of the per-pid B/E stack. Used for
+    # collectives whose spans close at COMPLETION (async backends): a
+    # tensor legally re-negotiates the same name while its previous
+    # batch is still in flight, and deferred plain-E events would then
+    # mispair with the new spans. The id is unique per (batch, TENSOR)
+    # — viewers pair async events globally by (cat, id, name), not per
+    # pid, so a batch-only id would merge a fused batch's N tensors
+    # into one async tree and mispair their spans with each other.
+    def _async_id(self, name: str, batch_id: int) -> str:
+        return f"{batch_id}.{self._pid(name)}"
+
+    def async_start(self, name: str, event_name: str,
+                    batch_id: int) -> None:
+        self._emit("b", name, event_name, cat="hvd",
+                   id=self._async_id(name, batch_id))
+
+    def async_end(self, name: str, event_name: str,
+                  batch_id: int) -> None:
+        self._emit("e", name, event_name, cat="hvd",
+                   id=self._async_id(name, batch_id))
 
     def mark_cycle_start(self) -> None:
         if self.mark_cycles:

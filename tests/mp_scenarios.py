@@ -1863,6 +1863,74 @@ def scenario_xla_backend(hvd_mod, rank, size):
         np.testing.assert_allclose(out[2 * r:2 * r + 2], float(r + 5))
 
 
+def scenario_xla_async_overlap(hvd_mod, rank, size):
+    """END-TO-END negotiation/execution overlap on the real XLA plane:
+    a deliberately slow big collective (completion-observation delayed
+    2.5 s) must not stop later cycles from negotiating, issuing, and
+    COMPLETING smaller collectives through the real TCP gather — and
+    rank 0's timeline must show the smalls' NEGOTIATE spans inside the
+    big one's COLLECTIVE span (reference purpose: FinalizeCUDAQueue,
+    cuda_operations.cc:148-179)."""
+    import time as _t
+
+    jax = _init_jax_distributed(rank, size)
+    import jax.numpy as jnp
+    from horovod_tpu.common import basics as _b
+
+    # Warm the compiled paths AND measure this host's real round-trip
+    # cost, so every timing bound below scales with the machine
+    # instead of hard-coding wall-clock races.
+    t0 = _t.monotonic()
+    for i in range(3):
+        hvd_mod.allreduce(jnp.full((4,), 1.0, jnp.float32),
+                          average=False, name=f"ov.warm.{i}")
+    rtt = max(0.05, (_t.monotonic() - t0) / 3)
+    issue_wait = max(0.3, 3 * rtt)
+    delay = max(2.5, 20 * rtt)
+
+    rt = _b.runtime()
+    xla = [b for b in rt.op_manager._backends if b.name == "xla_mesh"][0]
+    orig_observe = xla._observe
+    BIG = 1 << 16
+
+    def slow_observe(outs):
+        if any(getattr(o, "size", 0) >= BIG for o in outs):
+            _t.sleep(delay)
+        return orig_observe(outs)
+
+    xla._observe = slow_observe
+
+    ssum = sum(range(1, size + 1))
+    h_big = hvd_mod.allreduce_async(
+        jnp.full((BIG,), float(rank + 1), jnp.float32),
+        average=False, name="ov.big")
+    _t.sleep(issue_wait)  # let the big negotiate in its own cycle
+
+    for i in range(3):
+        out = hvd_mod.synchronize(hvd_mod.allreduce_async(
+            jnp.full((4,), float(rank + 1 + i), jnp.float32),
+            average=False, name=f"ov.small.{i}"))
+        np.testing.assert_allclose(np.asarray(out), ssum + i * size)
+    # the smalls completed end-to-end while the big is still in flight
+    assert not hvd_mod.poll(h_big), \
+        "big collective completed before its delay - no overlap proven"
+    np.testing.assert_allclose(
+        np.asarray(hvd_mod.synchronize(h_big)), ssum)
+
+    hvd_mod.shutdown()  # flush the timeline writer
+    if rank != 0:
+        return
+    from tests.trace_utils import (
+        collective_span, load_trace, negotiate_start_ts,
+    )
+    _, by_name = load_trace(os.environ["HOROVOD_TIMELINE"])
+    c_start, c_end = collective_span(by_name["ov.big"])
+    assert c_end - c_start >= 0.8 * delay * 1e6, (c_start, c_end, delay)
+    for i in range(3):
+        neg = negotiate_start_ts(by_name[f"ov.small.{i}"])
+        assert c_start < neg < c_end, (i, c_start, neg, c_end)
+
+
 def scenario_xla_hierarchical(hvd_mod, rank, size):
     """HOROVOD_HIERARCHICAL_ALLREDUCE: allreduce rides the factored
     (cross, local) mesh (all ranks share this host -> cross=1,

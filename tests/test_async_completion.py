@@ -169,3 +169,48 @@ def test_sync_mode_keeps_blocking_semantics():
     finally:
         rt.request_shutdown()
         rt.join(10.0)
+
+
+def test_timeline_negotiation_interleaves_with_slow_collective(tmp_path):
+    """End-to-end overlap EVIDENCE: with async completion on, the
+    timeline must show tensor 2's NEGOTIATE_ALLREDUCE beginning INSIDE
+    tensor 1's COLLECTIVE span — i.e. cycle k+1's negotiation ran while
+    cycle k's collective was still in flight, and the COLLECTIVE span
+    closes at true completion (the CUDA-finalizer-driven Timeline end
+    of the reference, cuda_operations.cc:148-179)."""
+
+    cfg = Config()
+    cfg.cycle_time_ms = 1.0
+    cfg.stall_check_disable = True
+    cfg.timeline_path = str(tmp_path / "overlap.json")
+    backend = GatedAsyncBackend()
+    rt = Runtime(cfg, LocalController(), OperationManager([backend]))
+    rt.start()
+    done = {}
+    try:
+        _enqueue(rt, "big.0", done)
+        with backend.issued_cv:
+            assert backend.issued_cv.wait_for(
+                lambda: "big.0" in backend.issued, timeout=10.0)
+        _enqueue(rt, "small.1", done)
+        assert done["small.1"].wait(10.0)
+        assert not done["big.0"].is_set()
+        backend.gate.set()
+        assert done["big.0"].wait(10.0)
+    finally:
+        backend.gate.set()
+        rt.request_shutdown()
+        rt.join(10.0)
+
+    from tests.trace_utils import (
+        collective_span, load_trace, negotiate_start_ts,
+    )
+
+    _, by_name = load_trace(cfg.timeline_path)
+    coll_start, coll_end = collective_span(by_name["big.0"])
+    neg_ts = negotiate_start_ts(by_name["small.1"])
+    _, small_done = collective_span(by_name["small.1"])
+    # small.1 negotiated AND completed strictly inside big.0's
+    # COLLECTIVE span
+    assert coll_start < neg_ts < coll_end, (coll_start, neg_ts, coll_end)
+    assert coll_start < small_done < coll_end, (small_done, coll_end)

@@ -493,6 +493,18 @@ def test_xla_mesh_backend_tree_broadcast():
                  extra_env={"HOROVOD_XLA_BCAST": "tree"})
 
 
+def test_xla_async_overlap_end_to_end(tmp_path):
+    """Negotiation/execution overlap proven END-TO-END: a deliberately
+    slow big XLA collective stays in flight while later cycles
+    negotiate and complete small collectives through the real TCP
+    gather; rank 0's timeline shows the interleave."""
+    run_scenario(
+        "xla_async_overlap", 2, timeout=240.0,
+        per_rank_env=lambda rank: (
+            {"HOROVOD_TIMELINE": str(tmp_path / "overlap.json")}
+            if rank == 0 else {}))
+
+
 def test_xla_hierarchical_allreduce():
     run_scenario("xla_hierarchical", 2, timeout=180.0,
                  extra_env={"HOROVOD_HIERARCHICAL_ALLREDUCE": "1"})

@@ -1,0 +1,39 @@
+"""Shared Chrome-trace parsing for timeline assertions (used by
+tests/test_async_completion.py and tests/mp_scenarios.py — one copy so
+span-format changes cannot silently diverge the two)."""
+
+import json
+
+
+def load_trace(path):
+    """Returns (events, by_tensor_name) with metadata events dropped
+    from the per-tensor groups."""
+    with open(path) as f:
+        events = json.load(f)
+    pid_names = {e["pid"]: e["args"]["name"] for e in events
+                 if e.get("ph") == "M"
+                 and e.get("name") == "process_name"}
+    by_name = {}
+    for e in events:
+        if e.get("ph") == "M":
+            continue
+        by_name.setdefault(pid_names.get(e.get("pid")), []).append(e)
+    return events, by_name
+
+
+def collective_span(evts):
+    """(start_ts, end_ts) of a tensor's async-nestable COLLECTIVE span
+    (ph b/e paired by id)."""
+    b = next(e for e in evts
+             if e["ph"] == "b" and e.get("name") == "COLLECTIVE")
+    e_ = next(e for e in evts
+              if e["ph"] == "e" and e.get("name") == "COLLECTIVE"
+              and e.get("id") == b["id"])
+    return b["ts"], e_["ts"]
+
+
+def negotiate_start_ts(evts, op: str = "ALLREDUCE"):
+    """ts of the tensor's NEGOTIATE_<op> begin event."""
+    return next(e["ts"] for e in evts
+                if e["ph"] == "B"
+                and e.get("name") == f"NEGOTIATE_{op}")
