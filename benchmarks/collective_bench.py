@@ -505,23 +505,97 @@ def worker_bcast_render(rank: int, size: int) -> None:
     print("RESULT " + json.dumps(report), flush=True)
 
 
-def _run_bcast_render(timeout: float = 300.0) -> dict:
+def worker_ragged_allgather(rank: int, size: int) -> None:
+    """The fused variable-dim0 allgather's two renderings under heavy
+    rank skew (1 big / 7 tiny), 8 virtual devices, one process: the
+    padded all_gather moves N x max(dim0) while the masked-psum
+    rendering moves ~2x the TRUE bytes (ops/xla_ops.py skew guard;
+    reference behavior target: MPI_Allgatherv,
+    mpi_operations.cc:95-173). Reports compiled bytes-accessed and
+    execution medians — machine-independent evidence the guard's
+    chosen side moves less data."""
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    ndev = 8
+    devs = jax.devices()[:ndev]
+    mesh = Mesh(np.array(devs), ("p",))
+    sn = 64                      # slice numel (row width)
+    rows = [4096] + [1] * (ndev - 1)
+    m = max(rows)
+    # Every device's local shard is padded to max rows (SPMD inputs
+    # share one shape); what differs is how much the COLLECTIVE moves.
+    x = jax.device_put(np.ones((ndev * m * sn,), np.float32),
+                       NamedSharding(mesh, P("p")))
+
+    def padded(t):
+        return jnp.ravel(jax.lax.all_gather(t, "p"))
+
+    offs, acc = [], 0
+    for r in range(ndev):
+        offs.append(acc * sn)
+        acc += rows[r]
+    total = (acc + m) * sn
+    offs_const = np.asarray(offs, np.int32)
+
+    def psum_scatter(t):
+        r = jax.lax.axis_index("p")
+        buf = jnp.zeros((total,), t.dtype)
+        buf = jax.lax.dynamic_update_slice(
+            buf, t, (jnp.take(jnp.asarray(offs_const), r),))
+        return jax.lax.psum(buf, "p")
+
+    report = {"rows": rows, "slice_numel": sn, "n_devices": ndev,
+              "true_MB": round(acc * sn * 4 / 1e6, 2),
+              "padded_MB": round(ndev * m * sn * 4 / 1e6, 2)}
+    for name, body in (("padded_gather", padded),
+                       ("psum_scatter", psum_scatter)):
+        fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("p"),
+                                   out_specs=P(), check_vma=False))
+        compiled = fn.lower(x).compile()
+        try:
+            ca = compiled.cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            report[f"{name}_bytes_accessed"] = ca.get("bytes accessed")
+        except Exception:
+            pass
+        jax.block_until_ready(compiled(x))  # warmup
+        ts = []
+        for _ in range(ALLREDUCE_ITERS):
+            t0 = time.perf_counter()
+            jax.block_until_ready(compiled(x))
+            ts.append((time.perf_counter() - t0) * 1e6)
+        _, med, _ = _quantiles(ts)
+        report[f"{name}_us"] = round(med, 1)
+    pb = report.get("padded_gather_bytes_accessed")
+    sb = report.get("psum_scatter_bytes_accessed")
+    if pb and sb:
+        report["bytes_ratio_padded_over_psum"] = round(pb / sb, 2)
+    print("RESULT " + json.dumps(report), flush=True)
+
+
+def _run_single_proc(worker: str, timeout: float = 300.0) -> dict:
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PALLAS_AXON_POOL_IPS", None)
     p = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--worker",
-         "bcast_render", "--rank", "0", "--size", "1"],
+         worker, "--rank", "0", "--size", "1"],
         cwd=REPO, env=env, capture_output=True, timeout=timeout)
     out = p.stdout.decode()
     if p.returncode != 0:
-        raise RuntimeError(f"bcast_render exited {p.returncode}:\n"
+        raise RuntimeError(f"{worker} exited {p.returncode}:\n"
                            f"{out}\n{p.stderr.decode()}")
     for line in out.splitlines():
         if line.startswith("RESULT "):
             return json.loads(line[len("RESULT "):])
-    raise RuntimeError(f"no RESULT from bcast_render:\n{out}")
+    raise RuntimeError(f"no RESULT from {worker}:\n{out}")
 
 
 def _run_world(mode: str, size: int, timeout: float = 600.0,
@@ -575,7 +649,8 @@ def main() -> None:
     ap.add_argument("--np", type=int, default=8)
     ap.add_argument("--worker",
                     choices=["allreduce", "train", "fixed_compute",
-                             "bcast_render", "overhead"])
+                             "bcast_render", "ragged_allgather",
+                             "overhead"])
     ap.add_argument("--rank", type=int)
     ap.add_argument("--size", type=int)
     ap.add_argument("--skip-variants", action="store_true",
@@ -587,6 +662,7 @@ def main() -> None:
          "train": worker_train,
          "fixed_compute": worker_fixed_compute,
          "bcast_render": worker_bcast_render,
+         "ragged_allgather": worker_ragged_allgather,
          "overhead": worker_overhead}[args.worker](
              args.rank, args.size)
         return
@@ -636,7 +712,7 @@ def main() -> None:
         print("== broadcast rendering (8 virtual devices, 4 MiB) ==",
               flush=True)
         try:
-            bc = _run_bcast_render()
+            bc = _run_single_proc("bcast_render")
             print(f"  masked psum {bc.get('masked_psum_us')} us   "
                   f"ppermute {bc.get('ppermute_us')} us   "
                   f"speedup {bc.get('speedup')}x   bytes accessed "
@@ -647,6 +723,20 @@ def main() -> None:
             # still reach RESULTS_cpu.json.
             bc = {"error": repr(e)}
             print(f"  bcast_render failed: {e!r}", flush=True)
+
+    rag = {}
+    if not args.skip_variants:
+        print("== ragged allgather skew guard (1 big / 7 tiny, 8 "
+              "virtual devices) ==", flush=True)
+        try:
+            rag = _run_single_proc("ragged_allgather")
+            print(f"  padded gather {rag.get('padded_gather_us')} us   "
+                  f"psum scatter {rag.get('psum_scatter_us')} us   "
+                  f"(true {rag.get('true_MB')} MB vs padded "
+                  f"{rag.get('padded_MB')} MB)", flush=True)
+        except Exception as e:
+            rag = {"error": repr(e)}
+            print(f"  ragged_allgather failed: {e!r}", flush=True)
 
     print(f"== scaling (fixed {FIXED_COMPUTE_S * 1e3:.0f} ms compute — "
           f"parallelizable, isolates comm overhead) ==", flush=True)
@@ -741,6 +831,7 @@ def main() -> None:
         "timeshare_ideal": round(ideal, 4),
         "efficiency_vs_achievable": round(min(eff / ideal, 1.0), 4),
         "broadcast_rendering": bc,
+        "ragged_allgather": rag,
         "projected_scaling": projection,
         "fixed_compute_ms": FIXED_COMPUTE_S * 1e3,
         "fixed_compute_steps_per_sec": {

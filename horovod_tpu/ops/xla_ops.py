@@ -39,6 +39,32 @@ from horovod_tpu.ops.backend import CollectiveBackend
 _AXIS = "hvd_proc"
 
 
+def ragged_psum_wins(sizes, slice_numels, world_size: int) -> bool:
+    """Skew guard for the fused variable-dim0 allgather: True when the
+    masked-psum rendering moves fewer bytes than the padded all_gather.
+
+    The padded all_gather's wire traffic scales with
+    ``world_size x max(dim0)`` per entry, the reference's
+    ``MPI_Allgatherv`` with the TRUE bytes
+    (reference: mpi_operations.cc:95-173). A psum over a zero-scattered
+    output buffer moves ~2x the true bytes (reduce-scatter +
+    all-gather phases), so it wins once the skew exceeds roughly
+    ``max(dim0) > 2 x mean(dim0)``. Inputs come from the broadcast
+    Response (entry-major ``sizes``), so every rank decides alike.
+    """
+    if world_size <= 1:
+        return False
+    padded_elems = 0
+    psum_elems = 0
+    for ec, sn in enumerate(slice_numels):
+        rows = sizes[ec * world_size:(ec + 1) * world_size]
+        m = max(rows)
+        padded_elems += world_size * m * sn
+        # psum buffer: true rows + one max-block of overlap slack
+        psum_elems += (sum(rows) + m) * sn
+    return 2 * psum_elems < padded_elems
+
+
 class XlaMeshBackend(CollectiveBackend):
     name = "xla_mesh"
 
@@ -295,6 +321,25 @@ class XlaMeshBackend(CollectiveBackend):
 
         size = self._size_fn()
         sizes = response.tensor_sizes  # entry-major: [ec*size + rc]
+        hier = (self._mesh2d is not None and getattr(
+            self._config, "hierarchical_allgather", False))
+        # Ragged-skew guard: under heavy dim-0 skew the padded
+        # all_gather's N x max wire bytes dwarf the true payload; the
+        # masked-psum rendering moves ~2x the TRUE bytes instead. The
+        # decision is a pure function of the broadcast response, so
+        # every rank picks the same rendering. Flat mesh only: under
+        # hierarchical allgather the displaced cost is the two-level
+        # gather's, which the byte model doesn't describe, and the
+        # psum would cross DCN undecomposed.
+        slice_numels = []
+        for ec, e in enumerate(entries):
+            sn = 1
+            for d in e.tensor.shape[1:]:
+                sn *= int(d)
+            slice_numels.append(sn)
+        if not hier and ragged_psum_wins(sizes, slice_numels, size):
+            return self._execute_allgather_psum(entries, response,
+                                                slice_numels)
         # Pad every entry to its own max dim-0, flatten, concatenate:
         # one all_gather moves the whole fused batch — the TPU
         # rendering of the reference's fused MPI_Allgatherv
@@ -312,8 +357,6 @@ class XlaMeshBackend(CollectiveBackend):
             flats.append(jnp.ravel(x))
         flat = (jnp.concatenate(flats) if len(flats) > 1 else flats[0])
 
-        hier = (self._mesh2d is not None and getattr(
-            self._config, "hierarchical_allgather", False))
         if hier:
             # Two-level gather (reference: MPIHierarchicalAllgather,
             # mpi_operations.cc:179-329): gather the host's shards
@@ -345,9 +388,7 @@ class XlaMeshBackend(CollectiveBackend):
         for ec, e in enumerate(entries):
             rows = sizes[ec * size:(ec + 1) * size]
             slice_shape = slices[ec]
-            slice_numel = 1
-            for d in slice_shape:
-                slice_numel *= d
+            slice_numel = slice_numels[ec]
             block = max_dim0s[ec] * slice_numel
             parts = [
                 g[r][ent_off:ent_off + rows[r] * slice_numel].reshape(
@@ -357,6 +398,86 @@ class XlaMeshBackend(CollectiveBackend):
                 jnp.concatenate(parts, axis=0) if size > 1
                 else parts[0])
             ent_off += block
+        return self._complete(entries)
+
+    def _execute_allgather_psum(self, entries, response: Response,
+                                slice_numels) -> Status:
+        """Skewed (allgatherv-shaped) fused allgather: each rank
+        zero-scatters its padded block at its TRUE row offset into a
+        buffer laid out by real row counts, and one psum assembles the
+        result — wire bytes track ~2x the true payload instead of the
+        padded path's N x max (the guard in execute_allgather picks
+        this rendering only when that is the cheaper side; reference
+        behavior target: MPI_Allgatherv, mpi_operations.cc:95-173).
+
+        Correctness of the overlap: rank r's padded block spans
+        ``[off_r, off_r + max*sn)`` while rank r+1's rows begin at
+        ``off_r + rows_r*sn`` — every position a rank does not own
+        receives only its padding ZEROS, so the psum reconstructs each
+        row exactly once. One trailing max-block of slack per entry
+        keeps the last rank's padded block in bounds."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        size = self._size_fn()
+        sizes = response.tensor_sizes
+        max_dim0s, slice_shapes, flats = [], [], []
+        rank_offsets = []   # [entry][rank] element offset of true rows
+        total = 0
+        for ec, e in enumerate(entries):
+            x = e.tensor
+            rows = sizes[ec * size:(ec + 1) * size]
+            m = max(rows)
+            sn = slice_numels[ec]
+            pad = m - x.shape[0]
+            if pad:
+                x = jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
+            max_dim0s.append(m)
+            slice_shapes.append(tuple(x.shape[1:]))
+            flats.append(jnp.ravel(x))
+            offs, acc = [], 0
+            for r in range(size):
+                offs.append(total + acc * sn)
+                acc += rows[r]
+            rank_offsets.append(offs)
+            total += (acc + m) * sn   # true rows + overlap slack
+        flat = (jnp.concatenate(flats) if len(flats) > 1 else flats[0])
+        offs_const = np.asarray(rank_offsets, np.int32)  # [E, size]
+        block_lens = [m * sn for m, sn in zip(max_dim0s, slice_numels)]
+
+        def body(x):
+            r = jax.lax.axis_index(_AXIS)
+            buf = jnp.zeros((total,), x.dtype)
+            in_off = 0
+            for ec, blen in enumerate(block_lens):
+                blk = jax.lax.dynamic_slice(x, (in_off,), (blen,))
+                off = jnp.take(jnp.asarray(offs_const[ec]), r)
+                buf = jax.lax.dynamic_update_slice(buf, blk, (off,))
+                in_off += blen
+            # psum promotes bool to int; each slot has exactly one
+            # non-zero contributor, so casting back is exact.
+            return jax.lax.psum(buf, _AXIS).astype(x.dtype)
+
+        # slice_numels joins the key: the body's offsets/layout derive
+        # from them, and same flat shape + sizes with different slice
+        # widths would otherwise collide on a wrong executable.
+        out = self._run_shard_op("allgather_psum", flat, P(), body,
+                                 extra=(tuple(sizes),
+                                        tuple(slice_numels)))
+        g = out.addressable_data(0)
+        for ec, e in enumerate(entries):
+            rows = sizes[ec * size:(ec + 1) * size]
+            sn = slice_numels[ec]
+            ss = slice_shapes[ec]
+            parts = [
+                g[rank_offsets[ec][r]:
+                  rank_offsets[ec][r] + rows[r] * sn].reshape(
+                      (rows[r],) + ss)
+                for r in range(size)]
+            e.output = jax.device_put(
+                jnp.concatenate(parts, axis=0) if size > 1
+                else parts[0])
         return self._complete(entries)
 
     # -- broadcast (ncclBcast role, two renderings) ----------------------

@@ -1931,6 +1931,40 @@ def scenario_xla_async_overlap(hvd_mod, rank, size):
         assert c_start < neg < c_end, (i, c_start, neg, c_end)
 
 
+def scenario_xla_ragged_allgather(hvd_mod, rank, size):
+    """Heavy dim-0 skew (one big rank, the rest tiny) must flip the
+    fused allgather onto the masked-psum rendering — wire bytes track
+    the true payload like MPI_Allgatherv (reference:
+    mpi_operations.cc:95-173) — and still return exact rank-ordered
+    rows; mild skew must stay on the padded all_gather."""
+    jax = _init_jax_distributed(rank, size)
+    import jax.numpy as jnp
+    from horovod_tpu.common import basics as _b
+
+    # skewed: rank 0 contributes 64 rows, everyone else 1
+    rows = 64 if rank == 0 else 1
+    x = jnp.full((rows, 3), float(rank), jnp.float32)
+    out = hvd_mod.allgather(x, name="rag.skew")
+    expected = np.concatenate(
+        [np.full((64 if r == 0 else 1, 3), float(r), np.float32)
+         for r in range(size)])
+    np.testing.assert_allclose(np.asarray(out), expected)
+
+    # uniform: stays on the padded all_gather path
+    u = hvd_mod.allgather(
+        jnp.full((2, 3), float(rank), jnp.float32), name="rag.uni")
+    np.testing.assert_allclose(
+        np.asarray(u),
+        np.concatenate([np.full((2, 3), float(r), np.float32)
+                        for r in range(size)]))
+
+    rt = _b.runtime()
+    xla = [b for b in rt.op_manager._backends if b.name == "xla_mesh"][0]
+    kinds = {k[0] for k in xla._cache}
+    assert "allgather_psum" in kinds, kinds   # skewed case used psum
+    assert "allgather" in kinds, kinds        # uniform case stayed padded
+
+
 def scenario_xla_hierarchical(hvd_mod, rank, size):
     """HOROVOD_HIERARCHICAL_ALLREDUCE: allreduce rides the factored
     (cross, local) mesh (all ranks share this host -> cross=1,

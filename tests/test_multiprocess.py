@@ -505,6 +505,13 @@ def test_xla_async_overlap_end_to_end(tmp_path):
             if rank == 0 else {}))
 
 
+def test_xla_ragged_allgather_skew_guard():
+    """1 big / 4 tiny ranks: the fused allgather switches to the
+    masked-psum (allgatherv-shaped) rendering; uniform shapes keep the
+    padded all_gather."""
+    run_scenario("xla_ragged_allgather", 5, timeout=300.0)
+
+
 def test_xla_hierarchical_allreduce():
     run_scenario("xla_hierarchical", 2, timeout=180.0,
                  extra_env={"HOROVOD_HIERARCHICAL_ALLREDUCE": "1"})

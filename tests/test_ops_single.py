@@ -226,3 +226,34 @@ class TestConfigValidation:
         monkeypatch.setenv("HOROVOD_XLA_BCAST", "ppermute")
         with pytest.raises(ValueError, match="HOROVOD_XLA_BCAST"):
             Config.from_env()
+
+
+class TestRaggedPsumDecision:
+    """Skew guard for the fused variable-dim0 allgather on the XLA
+    plane (reference behavior target: MPI_Allgatherv moves true bytes,
+    mpi_operations.cc:95-173)."""
+
+    def test_heavy_skew_picks_psum(self):
+        from horovod_tpu.ops.xla_ops import ragged_psum_wins
+        # 1 rank with 64 rows, 7 with 1: padded = 8*64, psum = 2*(71+64)
+        sizes = [64, 1, 1, 1, 1, 1, 1, 1]
+        assert ragged_psum_wins(sizes, [1], 8)
+
+    def test_uniform_keeps_padded_gather(self):
+        from horovod_tpu.ops.xla_ops import ragged_psum_wins
+        assert not ragged_psum_wins([4] * 8, [1], 8)
+        # mild skew below the ~2x-mean crossover
+        assert not ragged_psum_wins([6, 4, 4, 4, 4, 4, 4, 4], [1], 8)
+
+    def test_two_rank_world_never_psum(self):
+        from horovod_tpu.ops.xla_ops import ragged_psum_wins
+        # psum's 2x true bytes can't beat 2 x max at N=2
+        assert not ragged_psum_wins([1024, 1], [8], 2)
+        assert not ragged_psum_wins([4, 4], [8], 1)
+
+    def test_fused_batch_accounts_all_entries(self):
+        from horovod_tpu.ops.xla_ops import ragged_psum_wins
+        # entry 0 skewed, entry 1 uniform and large: batch-level byte
+        # totals decide (uniform bulk outweighs the skewed entry)
+        sizes = [64, 1, 1, 1] + [256, 256, 256, 256]
+        assert not ragged_psum_wins(sizes, [1, 64], 4)
