@@ -283,6 +283,79 @@ def worker_overhead(rank: int, size: int) -> None:
     hvd.shutdown()
 
 
+AUTOTUNE_VALUE_TENSORS = 24
+AUTOTUNE_VALUE_BYTES = 32 << 10
+AUTOTUNE_VALUE_STEPS = 40
+
+
+def worker_autotune_value(rank: int, size: int) -> None:
+    """Autotune VALUE demo (not just mechanics): a fusion-sensitive
+    workload — many small allreduces per step — measured under (a)
+    well-tuned defaults, (b) deliberately bad defaults (tiny fusion
+    threshold: every tensor negotiates and executes alone), and
+    (c) the same bad defaults with HOROVOD_AUTOTUNE=1, measured AFTER
+    the Bayesian tuner converges. The orchestrator reports how much of
+    the well-tuned throughput autotune recovers (scoring intent:
+    reference parameter_manager.cc:145-171)."""
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics as _b
+
+    hvd.init()
+    rt = _b.runtime()
+    xs = [np.full((AUTOTUNE_VALUE_BYTES // 4,), float(rank + 1),
+                  np.float32) for _ in range(AUTOTUNE_VALUE_TENSORS)]
+
+    def step(tag):
+        hs = [hvd.allreduce_async(x, average=False,
+                                  name=f"av.{tag}.{i}")
+              for i, x in enumerate(xs)]
+        for h in hs:
+            hvd.synchronize(h)
+
+    pm = rt.parameter_manager
+    if pm is not None:
+        # Drive traffic until the coordinator's tuner converges;
+        # rank 0 broadcasts the done flag so every rank exits the
+        # loop on the same iteration.
+        converged = False
+        for i in range(4000):
+            step(f"c{i}")
+            flag = 0.0 if rank != 0 else (0.0 if pm.tuning else 1.0)
+            done = hvd.broadcast(np.asarray([flag]), root_rank=0,
+                                 name=f"av.done/{i}")
+            if float(done[0]) == 1.0:
+                converged = True
+                break
+        if not converged:
+            if rank == 0:
+                print("RESULT " + json.dumps(
+                    {"error": "autotune did not converge"}), flush=True)
+            hvd.shutdown()
+            return
+
+    for i in range(3):
+        step(f"w{i}")
+    hvd.barrier(name="av.bar")
+    times = []
+    for i in range(AUTOTUNE_VALUE_STEPS):
+        t0 = time.perf_counter()
+        step(f"m{i}")
+        times.append(time.perf_counter() - t0)
+    _, med, _ = _quantiles(times)
+    if rank == 0:
+        out = {"steps_per_sec": round(1.0 / med, 3),
+               "us_per_step": round(med * 1e6, 1),
+               "tensors_per_step": AUTOTUNE_VALUE_TENSORS,
+               "bytes_per_tensor": AUTOTUNE_VALUE_BYTES}
+        if pm is not None:
+            out["tuned_fusion_threshold_bytes"] = \
+                pm.fusion_threshold_bytes()
+            out["tuned_cycle_time_ms"] = round(pm.cycle_time_ms(), 2)
+        print("RESULT " + json.dumps(out), flush=True)
+    hvd.shutdown()
+
+
 def _coordinator_cpu_bench() -> dict:
     """Pure-Python microbench of the coordinator's per-cycle CPU work —
     parse N RequestLists, count readiness, construct+fuse responses,
@@ -650,7 +723,7 @@ def main() -> None:
     ap.add_argument("--worker",
                     choices=["allreduce", "train", "fixed_compute",
                              "bcast_render", "ragged_allgather",
-                             "overhead"])
+                             "overhead", "autotune_value"])
     ap.add_argument("--rank", type=int)
     ap.add_argument("--size", type=int)
     ap.add_argument("--skip-variants", action="store_true",
@@ -663,6 +736,7 @@ def main() -> None:
          "fixed_compute": worker_fixed_compute,
          "bcast_render": worker_bcast_render,
          "ragged_allgather": worker_ragged_allgather,
+         "autotune_value": worker_autotune_value,
          "overhead": worker_overhead}[args.worker](
              args.rank, args.size)
         return
@@ -737,6 +811,39 @@ def main() -> None:
         except Exception as e:
             rag = {"error": repr(e)}
             print(f"  ragged_allgather failed: {e!r}", flush=True)
+
+    av = {}
+    if not args.skip_variants:
+        print("== autotune value (bad defaults -> tuned recovery, "
+              "np=4) ==", flush=True)
+        try:
+            csv_path = os.path.join(REPO, "benchmarks",
+                                    "autotune_value.csv")
+            well = _run_world("autotune_value", 4, timeout=900.0)
+            bad = _run_world("autotune_value", 4, timeout=900.0,
+                             extra_env={
+                                 "HOROVOD_FUSION_THRESHOLD": "1024"})
+            rec = _run_world("autotune_value", 4, timeout=900.0,
+                             extra_env={
+                                 "HOROVOD_FUSION_THRESHOLD": "1024",
+                                 "HOROVOD_AUTOTUNE": "1",
+                                 "HOROVOD_AUTOTUNE_LOG": csv_path})
+            av = {"well_tuned": well, "bad_defaults": bad,
+                  "autotuned_from_bad": rec,
+                  "autotune_log": "benchmarks/autotune_value.csv"}
+            if "steps_per_sec" in well and "steps_per_sec" in bad:
+                av["bad_fraction"] = round(
+                    bad["steps_per_sec"] / well["steps_per_sec"], 3)
+            if "steps_per_sec" in well and "steps_per_sec" in rec:
+                av["recovered_fraction"] = round(
+                    rec["steps_per_sec"] / well["steps_per_sec"], 3)
+            print(f"  well-tuned {well.get('steps_per_sec')} steps/s   "
+                  f"bad {bad.get('steps_per_sec')}   autotuned "
+                  f"{rec.get('steps_per_sec')}   recovered "
+                  f"{av.get('recovered_fraction')}", flush=True)
+        except Exception as e:
+            av = {"error": repr(e)}
+            print(f"  autotune_value failed: {e!r}", flush=True)
 
     print(f"== scaling (fixed {FIXED_COMPUTE_S * 1e3:.0f} ms compute — "
           f"parallelizable, isolates comm overhead) ==", flush=True)
@@ -832,6 +939,7 @@ def main() -> None:
         "efficiency_vs_achievable": round(min(eff / ideal, 1.0), 4),
         "broadcast_rendering": bc,
         "ragged_allgather": rag,
+        "autotune_value": av,
         "projected_scaling": projection,
         "fixed_compute_ms": FIXED_COMPUTE_S * 1e3,
         "fixed_compute_steps_per_sec": {

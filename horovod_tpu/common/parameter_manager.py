@@ -52,6 +52,13 @@ class ParameterManager:
         self._scores = []
 
     # -- values consumed by the runtime ---------------------------------
+    @property
+    def tuning(self) -> bool:
+        """True while the coordinator's optimizer is still exploring;
+        False once converged (or on workers, which never tune). The
+        public convergence probe for benchmarks/tests."""
+        return self._tuning
+
     def fusion_threshold_bytes(self) -> int:
         return int(self._current[0] * _MB)
 

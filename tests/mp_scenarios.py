@@ -928,7 +928,7 @@ def scenario_autotune(hvd, rank, size):
     for i in range(2000):
         hvd.allreduce(x, average=False, name=f"at.{i}")
         # world-consistent loop exit: rank 0 broadcasts its tuning state
-        flag = 0.0 if rank != 0 else (0.0 if pm._tuning else 1.0)
+        flag = 0.0 if rank != 0 else (0.0 if pm.tuning else 1.0)
         done = hvd.broadcast(np.asarray([flag]), root_rank=0,
                              name=f"at.done/{i}")
         if float(done[0]) == 1.0:

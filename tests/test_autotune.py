@@ -96,12 +96,12 @@ class TestParameterManager:
     def test_tunes_then_converges(self, tmp_path):
         pm = self._make(tmp_path)
         initial = (pm.fusion_threshold_bytes(), pm.cycle_time_ms())
-        assert pm._tuning
+        assert pm.tuning
         # drive enough cycles: warmup 1 sample + 4 samples × 3 medians,
         # 2 cycles each
         for _ in range(2 * (1 + 4 * 3) + 4):
             pm.on_cycle(1 << 20)
-        assert not pm._tuning
+        assert not pm.tuning
         assert 0 <= pm.fusion_threshold_bytes() <= 64 << 20
         assert 1.0 <= pm.cycle_time_ms() <= 100.0
         log = (tmp_path / "autotune.csv").read_text().strip().splitlines()
