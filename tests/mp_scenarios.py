@@ -774,6 +774,32 @@ def scenario_rank_death(hvd, rank, size):
     hvd.shutdown()
 
 
+def scenario_rank_death_hier(hvd, rank, size):
+    """A REMOTE LEAF dying under the hierarchical control plane: its
+    local root's relay recv fails, the root's background loop tears
+    down, the coordinator sees that host's channel die, and every
+    survivor errors out cleanly on its next collective — no hang at
+    any tier of the hierarchy."""
+    import time
+    from horovod_tpu.common import basics as _b
+    from horovod_tpu.common.status import HorovodInternalError
+
+    topo = _b.runtime().controller.topology
+    assert topo.cross_size > 1, "scenario expects a multihost topology"
+    x = np.full(50, float(rank + 1), np.float32)
+    out = hvd.allreduce(x, average=False, name="rdh.ok")
+    np.testing.assert_allclose(out, sum(range(1, size + 1)))
+    if rank == size - 1:  # the last host's leaf (migrated behind root)
+        os._exit(0)
+    time.sleep(0.5)
+    try:
+        hvd.allreduce(x, average=False, name="rdh.after")
+        raise AssertionError("collective after a leaf death must fail")
+    except HorovodInternalError:
+        pass
+    hvd.shutdown()
+
+
 def scenario_coordinator_death(hvd, rank, size):
     """The COORDINATOR (rank 0, which also hosts the controller socket)
     dying abruptly is the worst failure: every worker's control channel

@@ -35,3 +35,23 @@ def test_autotune_two_process_sync_and_log(tmp_path):
         assert 0.0 <= float(mb) <= 64.0
         assert 1.0 <= float(ms) <= 100.0
         assert float(score) >= 0.0
+
+
+def test_autotune_sync_through_hier_controller(tmp_path):
+    """Tuned values must reach MIGRATED LEAVES too: with 4 ranks on 2
+    fake hosts the ResponseList trailer rides the local root's relay,
+    and the adoption assertions inside scenario_autotune run on every
+    tier of the hierarchy."""
+    log = str(tmp_path / "autotune_hier.csv")
+    run_scenario(
+        "autotune", 4, timeout=240.0,
+        extra_env={
+            "HOROVOD_AUTOTUNE": "1",
+            "HOROVOD_AUTOTUNE_LOG": log,
+            "HOROVOD_AUTOTUNE_WARMUP_SAMPLES": "1",
+            "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE": "2",
+            "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES": str(_MAX_SAMPLES),
+        },
+        per_rank_env=lambda rank: {
+            "HOROVOD_HOSTNAME": f"fakehost{rank // 2}"})
+    assert os.path.exists(log)

@@ -461,6 +461,29 @@ def test_coordinator_death_fails_workers_cleanly():
     run_scenario("coordinator_death", 3, timeout=60.0)
 
 
+def test_rank_death_hier_leaf_fails_survivors_cleanly():
+    """Kill a remote LEAF under the hierarchical control plane (4
+    ranks, 2 fake hosts): the death must propagate leaf -> local root
+    -> coordinator -> world without hanging any tier."""
+    run_scenario(
+        "rank_death_hier", 4, timeout=90.0,
+        per_rank_env=lambda rank: {
+            "HOROVOD_HOSTNAME": f"fakehost{rank // 2}"})
+
+
+def test_ring_data_plane_with_hier_controller():
+    """Large payloads on the TCP ring while the CONTROL plane is
+    hierarchical: ring rendezvous (listener ports via relayed
+    gather/broadcast, peer IPs via the owner-channel map) must still
+    connect every rank."""
+    run_scenario(
+        "ring_allreduce", 4, timeout=240.0,
+        extra_env={"HOROVOD_TPU_RING_THRESHOLD": "1024",
+                   "HOROVOD_TPU_SHM": "0"},
+        per_rank_env=lambda rank: {
+            "HOROVOD_HOSTNAME": f"fakehost{rank // 2}"})
+
+
 def test_rank_subset_init():
     """init(comm=[1, 2]) on 3 processes: the 2-rank subset allreduces
     while the third abstains in a size-1 world."""
@@ -537,3 +560,14 @@ def test_xla_hierarchical_allgather():
         extra_env={"HOROVOD_HIERARCHICAL_ALLGATHER": "1"},
         per_rank_env=lambda rank: {
             "HOROVOD_HOSTNAME": f"fakehost{rank // 2}"})
+
+
+def test_coordinator_fuzz_through_hier_controller():
+    """The 240-job mixed-collective fuzz with every rank's requests
+    riding aggregated frames (3 ranks, 2 fake hosts): randomized
+    per-rank submission order must still negotiate to one exact total
+    order through the relay tier."""
+    run_scenario(
+        "coordinator_fuzz", 3, timeout=300.0,
+        per_rank_env=lambda rank: {
+            "HOROVOD_HOSTNAME": f"fakehost{min(rank, 1)}"})
