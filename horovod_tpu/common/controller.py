@@ -142,6 +142,104 @@ def _accept_handshakes(server, secret: bytes, deadline: float,
         yield r, hello, ch
 
 
+class _NativeFanout:
+    """poll(2)-based frame gather/broadcast/scatter over a fixed set of
+    peer channels through the native core (native/hvdtpu.cc, GIL
+    released) — the per-cycle hot path shared by the coordinator (its
+    worker channels) and by hierarchical local roots (their leaf
+    children). :meth:`create` returns None when the native library is
+    unavailable or there are no peers; callers then fall back to the
+    per-channel Python loops."""
+
+    def __init__(self, lib, ctypes_mod, channels: Dict[int, "network.Channel"],
+                 secret: bytes):
+        self._lib = lib
+        self._ct = ctypes_mod
+        self.ranks = sorted(channels)
+        fds = [channels[r].sock.fileno() for r in self.ranks]
+        self._fds = (ctypes_mod.c_int * len(fds))(*fds)
+        self._secret = secret
+        self._secret_buf = (ctypes_mod.c_uint8 * max(
+            1, len(secret))).from_buffer_copy(secret or b"\x00")
+
+    @classmethod
+    def create(cls, channels, secret: bytes):
+        if not channels:
+            return None
+        from horovod_tpu import native
+        lib = native.get()
+        if lib is None:
+            return None
+        import ctypes
+        return cls(lib, ctypes, channels, secret)
+
+    def _as_u8(self, data):
+        """bytes/buffer → ctypes u8 array at memcpy speed (never a
+        per-byte Python loop — these sit on the per-cycle hot path)."""
+        return (self._ct.c_uint8 * max(1, len(data))).from_buffer_copy(
+            data or b"\x00")
+
+    def gather(self, expect_tag: int) -> Dict[int, bytes]:
+        """One frame from every peer; returns {rank: payload}."""
+        ct = self._ct
+        n = len(self.ranks)
+        u8p = ct.POINTER(ct.c_uint8)
+        bufs = (u8p * n)()
+        lens = (ct.c_int64 * n)()
+        tags = (ct.c_uint8 * n)()
+        out: Dict[int, bytes] = {}
+        try:
+            rc = self._lib.hvd_gather_frames(
+                self._fds, n, self._secret_buf, len(self._secret),
+                bufs, lens, tags, -1)
+            if rc != 0:
+                # partial frames may already be malloc'd; the finally
+                # block frees them.
+                raise ConnectionError(f"native gather failed: errno {-rc}")
+            for i, r in enumerate(self.ranks):
+                if tags[i] != expect_tag:
+                    raise ConnectionError(
+                        f"expected tag {expect_tag} from rank {r}, "
+                        f"got {tags[i]}")
+                out[r] = ct.string_at(bufs[i], lens[i])
+        finally:
+            for i in range(n):
+                if bufs[i]:
+                    self._lib.hvd_free(bufs[i])
+        return out
+
+    def send_all(self, payload, tag: int,
+                 exclude_rank: Optional[int] = None) -> None:
+        ct = self._ct
+        if exclude_rank is None:
+            fds, n = self._fds, len(self.ranks)
+        else:
+            sub = [fd for r, fd in zip(self.ranks, self._fds)
+                   if r != exclude_rank]
+            fds, n = (ct.c_int * len(sub))(*sub), len(sub)
+        buf = self._as_u8(payload)
+        rc = self._lib.hvd_broadcast_frame(
+            fds, n, tag, buf, len(payload), self._secret_buf,
+            len(self._secret))
+        if rc != 0:
+            raise ConnectionError(f"native broadcast failed: errno {-rc}")
+
+    def scatter(self, per_rank: Dict[int, bytes], tag: int) -> None:
+        """Send per_rank[r] to each peer r."""
+        ct = self._ct
+        n = len(self.ranks)
+        u8p = ct.POINTER(ct.c_uint8)
+        arrs = [self._as_u8(per_rank[r]) for r in self.ranks]
+        ptrs = (u8p * n)(*[ct.cast(a, u8p) for a in arrs])
+        lens = (ct.c_int64 * n)(
+            *[len(per_rank[r]) for r in self.ranks])
+        rc = self._lib.hvd_scatter_frames(
+            self._fds, n, tag, ptrs, lens, self._secret_buf,
+            len(self._secret))
+        if rc != 0:
+            raise ConnectionError(f"native scatter failed: errno {-rc}")
+
+
 def _as_buffer(payload):
     """Normalize a data-plane payload to a flat byte view. Callers may
     pass numpy arrays straight through (zero-copy send path); the
@@ -318,8 +416,7 @@ class TcpCoordinator(Controller):
         self._start_timeout = start_timeout
         self._hierarchical = hierarchical
         self.topology = None  # set by accept_workers
-        self._native = None
-        self._worker_fds = None  # channel owners, ascending rank order
+        self._fanout: Optional[_NativeFanout] = None
         # channel owner rank -> all ranks that channel represents
         # (ascending; owner first). Flat world: every owner maps to
         # itself. Hierarchical: a remote local root carries its host.
@@ -373,7 +470,9 @@ class TcpCoordinator(Controller):
                 self._owner_of[m] = owner
         self._has_aggregates = any(
             len(ms) > 1 for ms in self._members.values())
-        self._init_native()
+        if self._size > 1:
+            self._fanout = _NativeFanout.create(self._channels,
+                                                self._secret)
         hlog.debug(f"coordinator up: {self._size} ranks, "
                    f"{self.topology.cross_size} hosts, "
                    f"fan-in {len(self._channels)}", rank=0)
@@ -467,129 +566,39 @@ class TcpCoordinator(Controller):
                 out[m] = f
         return out
 
-    def _init_native(self) -> None:
-        from horovod_tpu import native
-        lib = native.get()
-        if lib is None or self._size <= 1:
-            return
-        import ctypes
-        ranks = sorted(self._channels)
-        fds = [self._channels[r].sock.fileno() for r in ranks]
-        self._native = (lib, ctypes)
-        self._worker_ranks = ranks
-        self._worker_fds = (ctypes.c_int * len(fds))(*fds)
-        self._native_secret = (ctypes.c_uint8 * max(
-            1, len(self._secret))).from_buffer_copy(
-                self._secret or b"\x00")
-
-    @staticmethod
-    def _as_u8(ctypes, data: bytes):
-        """bytes → ctypes u8 array at memcpy speed (never a per-byte
-        Python loop — these sit on the per-cycle hot path)."""
-        return (ctypes.c_uint8 * max(1, len(data))).from_buffer_copy(
-            data or b"\x00")
-
-    def _native_gather(self, payload: bytes, expect_tag: int):
-        lib, ctypes = self._native
-        n = len(self._worker_ranks)
-        u8p = ctypes.POINTER(ctypes.c_uint8)
-        bufs = (u8p * n)()
-        lens = (ctypes.c_int64 * n)()
-        tags = (ctypes.c_uint8 * n)()
-        try:
-            rc = lib.hvd_gather_frames(self._worker_fds, n,
-                                       self._native_secret,
-                                       len(self._secret), bufs, lens,
-                                       tags, -1)
-            if rc != 0:
-                # partial frames may already be malloc'd; the finally
-                # block frees them.
-                raise ConnectionError(
-                    f"native gather failed: errno {-rc}")
-            out: List[bytes] = [b""] * self._size
-            out[0] = payload
-            for i, r in enumerate(self._worker_ranks):
-                if tags[i] != expect_tag:
-                    raise ConnectionError(
-                        f"expected tag {expect_tag} from rank {r}, got "
-                        f"{tags[i]}")
-                out[r] = ctypes.string_at(bufs[i], lens[i])
-        finally:
-            for i in range(n):
-                if bufs[i]:
-                    lib.hvd_free(bufs[i])
-        return out
-
-    def _native_send_all(self, payload: bytes, tag: int,
-                         exclude_rank: Optional[int] = None) -> bool:
-        lib, ctypes = self._native
-        if exclude_rank is None:
-            fds, n = self._worker_fds, len(self._worker_ranks)
-        else:
-            sub = [fd for r, fd in zip(self._worker_ranks,
-                                       self._worker_fds)
-                   if r != exclude_rank]
-            fds, n = (ctypes.c_int * len(sub))(*sub), len(sub)
-        buf = self._as_u8(ctypes, payload)
-        rc = lib.hvd_broadcast_frame(fds, n, tag, buf,
-                                     len(payload), self._native_secret,
-                                     len(self._secret))
-        if rc != 0:
-            raise ConnectionError(f"native broadcast failed: errno {-rc}")
-        return True
-
-    def _native_scatter(self, per_owner: Dict[int, bytes]) -> None:
-        """Scatter per_owner[r] to the channel owned by rank r."""
-        lib, ctypes = self._native
-        n = len(self._worker_ranks)
-        u8p = ctypes.POINTER(ctypes.c_uint8)
-        arrs = [self._as_u8(ctypes, per_owner[r])
-                for r in self._worker_ranks]
-        ptrs = (u8p * n)(*[ctypes.cast(a, u8p) for a in arrs])
-        lens = (ctypes.c_int64 * n)(
-            *[len(per_owner[r]) for r in self._worker_ranks])
-        rc = lib.hvd_scatter_frames(self._worker_fds, n, TAG_DATA, ptrs,
-                                    lens, self._native_secret,
-                                    len(self._secret))
-        if rc != 0:
-            raise ConnectionError(f"native scatter failed: errno {-rc}")
-
-    def gather_requests(self, payload: bytes) -> Optional[List[bytes]]:
-        if self._native is not None:
-            return self._expand(self._native_gather(payload,
-                                                    TAG_REQUESTS))
+    def _gather_frames(self, payload, expect_tag: int) -> List[bytes]:
+        """One frame per channel (native poll loop when available),
+        rank-indexed with this rank's own payload at 0, aggregate
+        frames expanded to their member ranks."""
         out: List[bytes] = [b""] * self._size
         out[0] = payload
-        for r, ch in self._channels.items():
-            tag, data = ch.recv()
-            if tag != TAG_REQUESTS:
-                raise ConnectionError(
-                    f"expected TAG_REQUESTS from rank {r}, got {tag}")
-            out[r] = data
+        if self._fanout is not None:
+            for r, data in self._fanout.gather(expect_tag).items():
+                out[r] = data
+        else:
+            for r, ch in self._channels.items():
+                tag, data = ch.recv()
+                if tag != expect_tag:
+                    raise ConnectionError(
+                        f"expected tag {expect_tag} from rank {r}, "
+                        f"got {tag}")
+                out[r] = data
         return self._expand(out)
+
+    def gather_requests(self, payload: bytes) -> Optional[List[bytes]]:
+        return self._gather_frames(payload, TAG_REQUESTS)
 
     def broadcast_responses(self, payload: Optional[bytes]) -> bytes:
         assert payload is not None
-        if self._native is not None:
-            self._native_send_all(payload, TAG_RESPONSES)
+        if self._fanout is not None:
+            self._fanout.send_all(payload, TAG_RESPONSES)
             return payload
         for ch in self._channels.values():
             ch.send(payload, TAG_RESPONSES)
         return payload
 
     def gather_data(self, payload: bytes) -> Optional[List[bytes]]:
-        payload = _as_buffer(payload)
-        if self._native is not None:
-            return self._expand(self._native_gather(payload, TAG_DATA))
-        out: List[bytes] = [b""] * self._size
-        out[0] = payload
-        for r, ch in self._channels.items():
-            tag, data = ch.recv()
-            if tag != TAG_DATA:
-                raise ConnectionError(
-                    f"expected TAG_DATA from rank {r}, got {tag}")
-            out[r] = data
-        return self._expand(out)
+        return self._gather_frames(_as_buffer(payload), TAG_DATA)
 
     def broadcast_data(self, payload: Optional[bytes],
                        root_rank: int = 0) -> bytes:
@@ -605,8 +614,8 @@ class TcpCoordinator(Controller):
             if tag != TAG_DATA:
                 raise ConnectionError("expected TAG_DATA from root")
             assert payload is not None
-            if self._native is not None:
-                self._native_send_all(payload, TAG_DATA,
+            if self._fanout is not None:
+                self._fanout.send_all(payload, TAG_DATA,
                                       exclude_rank=owner)
                 return payload
             for r, ch in self._channels.items():
@@ -614,8 +623,8 @@ class TcpCoordinator(Controller):
                     ch.send(payload, TAG_DATA)
             return payload
         assert payload is not None
-        if self._native is not None:
-            self._native_send_all(payload, TAG_DATA)
+        if self._fanout is not None:
+            self._fanout.send_all(payload, TAG_DATA)
             return payload
         for ch in self._channels.values():
             ch.send(payload, TAG_DATA)
@@ -628,8 +637,8 @@ class TcpCoordinator(Controller):
                     else pack_frames([_as_buffer(payloads[m])
                                       for m in ms]))
             for owner, ms in self._members.items()}
-        if self._native is not None:
-            self._native_scatter(per_owner)
+        if self._fanout is not None:
+            self._fanout.scatter(per_owner, TAG_DATA)
             return payloads[0]
         for r, ch in self._channels.items():
             ch.send(per_owner[r], TAG_DATA)
@@ -668,7 +677,10 @@ class TcpWorker(Controller):
     channel and point their upward channel at the local root instead —
     every op below then works unchanged for them. This is the
     control-plane rendering of the reference's LOCAL/CROSS communicator
-    split (reference: horovod/common/operations.cc:729-764)."""
+    split (reference: horovod/common/operations.cc:729-764). The root's
+    per-cycle child fan-in/fan-out rides the same native poll(2) hot
+    path as the coordinator's (_NativeFanout), so the hierarchy adds a
+    hop without adding a Python per-channel loop."""
 
     def __init__(self, rank: int, size: int, addr: str, port: int,
                  secret: bytes = b"", start_timeout: float = 30.0):
@@ -687,6 +699,7 @@ class TcpWorker(Controller):
         self.topology = compute_topology(rank, hostnames)
         # rank -> loopback channel of each local leaf (local roots only)
         self._children: Dict[int, network.Channel] = {}
+        self._child_fanout: Optional[_NativeFanout] = None
         self._members: List[int] = [rank]  # this host's ranks, ascending
         if (info.get("hier") and self.topology.cross_rank != 0
                 and self.topology.local_size > 1):
@@ -694,6 +707,8 @@ class TcpWorker(Controller):
             members = host_members[self.topology.cross_rank]
             if self.topology.local_rank == 0:
                 self._become_local_root(members, secret, start_timeout)
+                self._child_fanout = _NativeFanout.create(
+                    self._children, secret)
             else:
                 self._become_leaf(rank, secret, start_timeout)
 
@@ -760,11 +775,25 @@ class TcpWorker(Controller):
                 f"expected tag {tag} from local rank {r}, got {t}")
         return data
 
+    def _send_children(self, data, tag: int,
+                       exclude_rank: Optional[int] = None) -> None:
+        if self._child_fanout is not None:
+            self._child_fanout.send_all(data, tag,
+                                        exclude_rank=exclude_rank)
+            return
+        for r, ch in self._children.items():
+            if r != exclude_rank:
+                ch.send(data, tag)
+
     def _gather_up(self, payload, tag: int) -> None:
         if self._children:
-            payload = pack_frames([
-                payload if r == self.rank else self._recv_child(r, tag)
-                for r in self._members])
+            if self._child_fanout is not None:
+                frames = self._child_fanout.gather(tag)
+            else:
+                frames = {r: self._recv_child(r, tag)
+                          for r in self._children}
+            frames[self.rank] = payload
+            payload = pack_frames([frames[r] for r in self._members])
         self._ch.send(payload, tag)
 
     def gather_requests(self, payload: bytes) -> Optional[List[bytes]]:
@@ -775,8 +804,7 @@ class TcpWorker(Controller):
         tag, data = self._ch.recv()
         if tag != TAG_RESPONSES:
             raise ConnectionError(f"expected TAG_RESPONSES, got {tag}")
-        for ch in self._children.values():
-            ch.send(data, TAG_RESPONSES)
+        self._send_children(data, TAG_RESPONSES)
         return data
 
     def gather_data(self, payload: bytes) -> Optional[List[bytes]]:
@@ -791,8 +819,7 @@ class TcpWorker(Controller):
             # channels only — our own copy is already authoritative,
             # and our local leaves get it straight from us.
             self._ch.send(payload, TAG_DATA)
-            for ch in self._children.values():
-                ch.send(payload, TAG_DATA)
+            self._send_children(payload, TAG_DATA)
             return payload
         if root_rank in self._children:
             # The root is one of our leaves: relay its payload upward
@@ -800,15 +827,12 @@ class TcpWorker(Controller):
             # rest of the world and skips this whole host.
             data = self._recv_child(root_rank, TAG_DATA)
             self._ch.send(data, TAG_DATA)
-            for r, ch in self._children.items():
-                if r != root_rank:
-                    ch.send(data, TAG_DATA)
+            self._send_children(data, TAG_DATA, exclude_rank=root_rank)
             return data
         tag, data = self._ch.recv()
         if tag != TAG_DATA:
             raise ConnectionError(f"expected TAG_DATA, got {tag}")
-        for ch in self._children.values():
-            ch.send(data, TAG_DATA)
+        self._send_children(data, TAG_DATA)
         return data
 
     def scatter_data(self, payloads: Optional[List[bytes]]) -> bytes:
@@ -818,10 +842,16 @@ class TcpWorker(Controller):
         if self._children:
             frames = unpack_frames(data)
             mine: Optional[bytes] = None
+            per_child: Dict[int, bytes] = {}
             for r, f in zip(self._members, frames):
                 if r == self.rank:
                     mine = f
                 else:
+                    per_child[r] = f
+            if self._child_fanout is not None:
+                self._child_fanout.scatter(per_child, TAG_DATA)
+            else:
+                for r, f in per_child.items():
                     self._children[r].send(f, TAG_DATA)
             assert mine is not None
             return mine
