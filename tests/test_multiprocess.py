@@ -111,6 +111,21 @@ def test_wide_world_smoke():
     run_scenario("allreduce_fused", 12, timeout=180.0)
 
 
+def test_wide_world_hier_smoke():
+    """16 ranks as 4 fake hosts x 4: the deepest hierarchy the suite
+    runs — 3 local leaves + 3 aggregate root channels at the
+    coordinator, 3-leaf relays at every remote root — with exact
+    results on plain and FUSED batches."""
+    run_scenario(
+        "allreduce", 16, timeout=300.0,
+        per_rank_env=lambda rank: {
+            "HOROVOD_HOSTNAME": f"fakehost{rank // 4}"})
+    run_scenario(
+        "allreduce_fused", 16, timeout=300.0,
+        per_rank_env=lambda rank: {
+            "HOROVOD_HOSTNAME": f"fakehost{rank // 4}"})
+
+
 @pytest.mark.parametrize("size", [3, 4])
 def test_ring_allreduce(size):
     """Large payloads take the 2-phase ring data plane (threshold
