@@ -1984,6 +1984,16 @@ def scenario_xla_ragged_allgather(hvd_mod, rank, size):
         np.concatenate([np.full((2, 3), float(r), np.float32)
                         for r in range(size)]))
 
+    # bool under the same skew: the psum rendering promotes to int
+    # internally and must cast back — output dtype and values exact
+    b = hvd_mod.allgather(
+        jnp.full((rows, 2), rank % 2 == 0, jnp.bool_), name="rag.bool")
+    assert np.asarray(b).dtype == np.bool_, np.asarray(b).dtype
+    np.testing.assert_array_equal(
+        np.asarray(b),
+        np.concatenate([np.full((64 if r == 0 else 1, 2), r % 2 == 0,
+                                np.bool_) for r in range(size)]))
+
     rt = _b.runtime()
     xla = [b for b in rt.op_manager._backends if b.name == "xla_mesh"][0]
     kinds = {k[0] for k in xla._cache}
