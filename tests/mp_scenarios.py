@@ -852,6 +852,50 @@ def scenario_subset_world(hvd, rank, size):
 scenario_subset_world.no_auto_init = True
 
 
+def scenario_subset_world_hier(hvd, rank, size):
+    """init(comm=[2..5]) on a 6-process launch with fake hosts
+    rank//2: the sub-world spans two multi-rank hosts, so the
+    HIERARCHICAL control plane activates INSIDE the subset — the
+    sub-coordinator (global rank 2, renumbered 0) keeps one local leaf
+    channel plus one aggregate channel for the remote host, and every
+    collective stays exact; abstaining ranks keep local worlds."""
+    assert size == 6, "scenario expects 6 launched processes"
+    hvd.init(comm=[2, 3, 4, 5])
+    from horovod_tpu.common import basics as _b
+
+    if rank < 2:
+        assert hvd.size() == 1
+        out = hvd.allreduce(np.full(3, 5.0, np.float32),
+                            average=False, name="solo.ar")
+        np.testing.assert_allclose(out, 5.0)
+        return
+    assert hvd.size() == 4 and hvd.rank() == rank - 2
+    ctl = _b.runtime().controller
+    assert ctl.topology.cross_size == 2, ctl.topology.cross_size
+    if hvd.rank() == 0:
+        # 1 local leaf + 1 remote aggregate root
+        assert len(ctl._channels) == 2, len(ctl._channels)
+        assert ctl._has_aggregates
+
+    x = np.full(5, float(rank), np.float32)  # global ranks 2..5
+    out = hvd.allreduce(x, average=False, name="subh.ar")
+    np.testing.assert_allclose(out, 14.0)  # 2+3+4+5, never ranks 0/1
+    for root in range(4):
+        b = hvd.broadcast(np.full(2, float(rank), np.float64),
+                          root_rank=root, name=f"subh.bc{root}")
+        np.testing.assert_allclose(b, float(root + 2))
+    g = hvd.allgather(np.full((hvd.rank() + 1, 2), float(rank),
+                              np.float32), name="subh.ag")
+    off = 0
+    for r in range(4):
+        np.testing.assert_allclose(
+            np.asarray(g)[off:off + r + 1], float(r + 2))
+        off += r + 1
+
+
+scenario_subset_world_hier.no_auto_init = True
+
+
 def scenario_mxnet(hvd, rank, size):
     """Execute the whole MXNet adapter surface under a real 2-process
     world via the NDArray-protocol double (tests/fake_mxnet.py):

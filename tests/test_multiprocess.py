@@ -505,6 +505,15 @@ def test_rank_subset_init():
     run_scenario("subset_world", 3, timeout=120.0)
 
 
+def test_subset_world_hierarchical():
+    """A rank-subset sub-world spanning two multi-rank fake hosts
+    activates the hierarchical control plane inside the subset."""
+    run_scenario(
+        "subset_world_hier", 6, timeout=240.0,
+        per_rank_env=lambda rank: {
+            "HOROVOD_HOSTNAME": f"fakehost{rank // 2}"})
+
+
 def test_mxnet_adapter():
     """The MXNet adapter executes end-to-end against the NDArray
     protocol double under a real 2-process world."""
