@@ -595,3 +595,15 @@ def test_coordinator_fuzz_through_hier_controller():
         "coordinator_fuzz", 3, timeout=300.0,
         per_rank_env=lambda rank: {
             "HOROVOD_HOSTNAME": f"fakehost{min(rank, 1)}"})
+
+
+def test_hmac_secret_through_hierarchy():
+    """One shared HOROVOD_SECRET_KEY across a fake 2-host topology:
+    every tier of the hierarchical control plane (coordinator <-> root
+    and root <-> leaf channels, native or Python) authenticates frames
+    and collectives stay exact."""
+    run_scenario(
+        "allreduce", 4,
+        extra_env={"HOROVOD_SECRET_KEY": "round5-hier-secret"},
+        per_rank_env=lambda rank: {
+            "HOROVOD_HOSTNAME": f"fakehost{rank // 2}"})
