@@ -62,8 +62,13 @@ from horovod_tpu.ops import (
 )
 
 from horovod_tpu.common.compression import Compression
+from horovod_tpu.common.status import (
+    HorovodInternalError,
+    WorldAbortedError,
+)
 
 __all__ = [
+    "HorovodInternalError", "WorldAbortedError",
     "__version__",
     "init", "shutdown", "initialized",
     "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",

@@ -112,7 +112,9 @@ def init(comm=None, config: Optional[Config] = None,
                                    secret=secret,
                                    start_timeout=cfg.start_timeout,
                                    listener=listener,
-                                   hierarchical=cfg.hier_controller)
+                                   hierarchical=cfg.hier_controller,
+                                   heartbeat_interval=cfg.heartbeat_interval_s,
+                                   heartbeat_timeout=cfg.heartbeat_timeout_s)
             coord.accept_workers()
             controller = coord
         else:
@@ -122,7 +124,9 @@ def init(comm=None, config: Optional[Config] = None,
                     "multi-process init (use the hvdtpurun launcher).")
             controller = TcpWorker(rank, size, cfg.controller_addr,
                                    cfg.controller_port, secret=secret,
-                                   start_timeout=cfg.start_timeout)
+                                   start_timeout=cfg.start_timeout,
+                                   heartbeat_interval=cfg.heartbeat_interval_s,
+                                   heartbeat_timeout=cfg.heartbeat_timeout_s)
 
         from horovod_tpu.ops.shm_ops import ShmBackend
         socket_backend = SocketBackend(controller, secret=secret,

@@ -115,6 +115,16 @@ class Config:
     stall_check_time_seconds: float = 60.0
     stall_shutdown_time_seconds: float = 0.0
 
+    # Fail-fast liveness (TPU-native extension; the reference has no
+    # peer-death detection — a SIGKILL'd rank leaves peers blocked in
+    # MPI forever until the launcher kills the world). PING frames ride
+    # idle gather waits every heartbeat_interval_s; a control channel
+    # silent for heartbeat_timeout_s is declared dead and the world
+    # aborts with WorldAbortedError. timeout <= 0 disables detection
+    # (reference behavior).
+    heartbeat_interval_s: float = 5.0
+    heartbeat_timeout_s: float = 30.0
+
     # Autotune (reference: operations.cc:862-871, parameter_manager.cc)
     autotune: bool = False
     autotune_log: str = ""
@@ -188,6 +198,10 @@ class Config:
         c.stall_shutdown_time_seconds = _env_float(
             "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS",
             c.stall_shutdown_time_seconds)
+        c.heartbeat_interval_s = _env_float(
+            "HOROVOD_HEARTBEAT_INTERVAL", c.heartbeat_interval_s)
+        c.heartbeat_timeout_s = _env_float(
+            "HOROVOD_HEARTBEAT_TIMEOUT", c.heartbeat_timeout_s)
         c.autotune = _env_bool("HOROVOD_AUTOTUNE", c.autotune)
         c.autotune_log = os.environ.get("HOROVOD_AUTOTUNE_LOG", "")
         c.autotune_warmup_samples = _env_int(

@@ -17,15 +17,19 @@ hostname exactly like the reference's ``MPI_Comm_split_type(SHARED)`` +
 
 from __future__ import annotations
 
+import errno
 import json
 import os
+import select
 import socket
 import struct
 import time
 from typing import Dict, List, Optional
 
+from horovod_tpu.common import heartbeat
 from horovod_tpu.common import logging as hlog
 from horovod_tpu.common import network
+from horovod_tpu.common.status import WorldAbortedError, world_abort_message
 
 def _my_hostname() -> str:
     """Hostname used for local/cross topology grouping. The
@@ -68,6 +72,136 @@ TAG_HANDSHAKE = 1
 TAG_REQUESTS = 2    # worker -> coordinator: serialized RequestList
 TAG_RESPONSES = 3   # coordinator -> worker: serialized ResponseList
 TAG_DATA = 4        # data-plane payload (socket fallback backend)
+TAG_PING = 5        # downward liveness beacon (heartbeat.encode_ping)
+TAG_ABORT = 6       # world abort notice (heartbeat.encode_abort)
+
+
+def _dead_peers(channels: Dict[int, "network.Channel"]) -> List[int]:
+    """Ranks whose channel socket is dead (hung up, errored, or
+    orderly-closed), probed without blocking. Called only on failure
+    paths, to turn an anonymous transport error from a fan-out
+    primitive into a named origin rank."""
+    dead: List[int] = []
+    for r, ch in channels.items():
+        try:
+            fd = ch.sock.fileno()
+        except OSError:
+            fd = -1
+        if fd < 0:
+            # Locally closed (e.g. an injected sever): dead by
+            # definition, and poll.register would raise on it.
+            dead.append(r)
+            continue
+        try:
+            p = select.poll()
+            p.register(fd, select.POLLIN)
+            events = p.poll(0)
+            if not events:
+                continue
+            mask = events[0][1]
+            if mask & (select.POLLHUP | select.POLLERR | select.POLLNVAL):
+                dead.append(r)
+            elif mask & select.POLLIN:
+                # Readable could be a buffered frame OR an orderly
+                # close; peek distinguishes without consuming.
+                if ch.sock.recv(1, socket.MSG_PEEK) == b"":
+                    dead.append(r)
+        except OSError:
+            dead.append(r)
+    return dead
+
+
+def _abort_error(origin: int, cause: str,
+                 resolved: bool = False) -> WorldAbortedError:
+    """``resolved=True`` marks an AUTHORITATIVE notice decoded off the
+    wire: the runtime's failure handler then commits the origin as-is
+    instead of re-draining the control plane for a better one."""
+    err = WorldAbortedError(world_abort_message(origin, cause),
+                            origin_rank=origin, cause=cause)
+    err.resolved = resolved
+    return err
+
+
+def _drain_abort(channels: Dict[int, "network.Channel"],
+                 grace_s: float) -> Optional[tuple]:
+    """Sweep the control channels for a queued (or just-arriving,
+    within ``grace_s``) TAG_ABORT notice → (origin, cause), else None.
+
+    A locally inferred transport blame can race the authoritative
+    notice from the rank that actually DETECTED the failure: its
+    teardown closes channels, and to peers that close is a second,
+    misattributable failure (e.g. a ring survivor names its dead
+    neighbor and collapses; this rank only sees the survivor's close).
+    Failure path only — never runs in a healthy world. Non-abort
+    frames found in the sweep are discarded; the world is already
+    dead, nothing will negotiate them."""
+    deadline = time.monotonic() + grace_s
+    while True:
+        for ch in channels.values():
+            # Bypass the channel's liveness slicing: a 50 ms cap per
+            # read keeps the sweep prompt even over partial frames
+            # flushed by a dying peer.
+            prev_hb, ch._hb = ch._hb, None
+            try:
+                prev_to = ch.sock.gettimeout()
+                ch.sock.settimeout(0.05)
+                try:
+                    p = select.poll()
+                    p.register(ch.sock.fileno(), select.POLLIN)
+                    while p.poll(0):
+                        tag, data = ch.recv()
+                        if tag == TAG_ABORT:
+                            return heartbeat.decode_abort(data)
+                finally:
+                    ch.sock.settimeout(prev_to)
+            except (OSError, ValueError):
+                pass  # dead/garbled channel: nothing to learn here
+            finally:
+                ch._hb = prev_hb
+        if time.monotonic() >= deadline:
+            return None
+        time.sleep(0.02)
+
+
+def _maybe_ping(ctl, channels: Dict[int, "network.Channel"],
+                sender_rank: int) -> None:
+    """Shared PING fan-out for both tree tiers (coordinator → owners,
+    local root → leaves): rate-limited to the controller's configured
+    interval (idle slices can tick faster — see _NativeFanout), send
+    failures swallowed (the recv/abort paths own the reporting)."""
+    now = time.monotonic()
+    if now - ctl._last_ping < _ping_interval(ctl._hb_timeout,
+                                            ctl._hb_interval):
+        return
+    ctl._last_ping = now
+    ctl._ping_seq += 1
+    payload = heartbeat.encode_ping(sender_rank, ctl._ping_seq)
+    for ch in channels.values():
+        try:
+            ch.send(payload, TAG_PING)
+        except OSError:
+            pass
+
+
+def _hb_normalized(timeout_s: float, interval_s: float) -> tuple:
+    """(timeout_s, interval_s) with the interval clamped into
+    (0, timeout/2] — same normalization Channel.arm applies, so the
+    native fanout's slice loop can't busy-poll on interval<=0,
+    overshoot the deadline by a whole oversized interval, or tick
+    on_idle (the PING beacon) fewer than twice per peer deadline
+    window."""
+    half = timeout_s / 2.0
+    interval_s = min(interval_s, half) if interval_s > 0 else half
+    return timeout_s, interval_s
+
+
+def _ping_interval(timeout_s: float, interval_s: float) -> float:
+    """The PING send gate must beacon at least twice per deadline
+    window regardless of the configured interval — gating on a raw
+    interval >= the timeout would starve every waiting receiver of
+    proof-of-life and falsely abort a healthy world."""
+    half = timeout_s / 2.0
+    return min(interval_s, half) if interval_s > 0 else half
 
 
 _PACK_COUNT = struct.Struct("<I")
@@ -152,18 +286,35 @@ class _NativeFanout:
     per-channel Python loops."""
 
     def __init__(self, lib, ctypes_mod, channels: Dict[int, "network.Channel"],
-                 secret: bytes):
+                 secret: bytes, hb=None):
         self._lib = lib
         self._ct = ctypes_mod
         self.ranks = sorted(channels)
         fds = [channels[r].sock.fileno() for r in self.ranks]
+        self._fd_list = fds
         self._fds = (ctypes_mod.c_int * len(fds))(*fds)
         self._secret = secret
         self._secret_buf = (ctypes_mod.c_uint8 * max(
             1, len(secret))).from_buffer_copy(secret or b"\x00")
+        # (timeout_s, interval_s, on_idle) liveness deadline for gather,
+        # or None: the native poll loop then waits in interval slices,
+        # firing on_idle (PING fan-out) per idle slice and failing after
+        # timeout_s of total silence — same semantics as Channel.arm.
+        # The slice is additionally capped at timeout/(2*fan_in): the
+        # native call keeps absorbing frames as long as each arrives
+        # within one slice and only returns to Python (where on_idle
+        # can run) after a fully idle slice, so a trickle of fan_in
+        # frames can starve PINGs for at most fan_in*slice <= timeout/2
+        # — keeping every waiting peer's own recv deadline safe.
+        if hb is not None:
+            timeout_s, interval_s, on_idle = hb
+            interval_s = min(interval_s,
+                             timeout_s / (2.0 * max(1, len(fds))))
+            hb = (timeout_s, interval_s, on_idle)
+        self._hb = hb
 
     @classmethod
-    def create(cls, channels, secret: bytes):
+    def create(cls, channels, secret: bytes, hb=None):
         if not channels:
             return None
         from horovod_tpu import native
@@ -171,7 +322,7 @@ class _NativeFanout:
         if lib is None:
             return None
         import ctypes
-        return cls(lib, ctypes, channels, secret)
+        return cls(lib, ctypes, channels, secret, hb=hb)
 
     def _as_u8(self, data):
         """bytes/buffer → ctypes u8 array at memcpy speed (never a
@@ -180,32 +331,99 @@ class _NativeFanout:
             data or b"\x00")
 
     def gather(self, expect_tag: int) -> Dict[int, bytes]:
-        """One frame from every peer; returns {rank: payload}."""
+        """One frame from every peer; returns {rank: payload}. With a
+        liveness deadline set, the native poll loop runs in interval
+        slices: frames already received in a slice are harvested (the
+        peers that delivered them are not re-polled), on_idle fires per
+        empty slice, and total silence past the timeout raises. A
+        TAG_ABORT frame from any peer surfaces as WorldAbortedError."""
         ct = self._ct
-        n = len(self.ranks)
         u8p = ct.POINTER(ct.c_uint8)
-        bufs = (u8p * n)()
-        lens = (ct.c_int64 * n)()
-        tags = (ct.c_uint8 * n)()
         out: Dict[int, bytes] = {}
-        try:
-            rc = self._lib.hvd_gather_frames(
-                self._fds, n, self._secret_buf, len(self._secret),
-                bufs, lens, tags, -1)
-            if rc != 0:
-                # partial frames may already be malloc'd; the finally
-                # block frees them.
-                raise ConnectionError(f"native gather failed: errno {-rc}")
-            for i, r in enumerate(self.ranks):
-                if tags[i] != expect_tag:
+        pending = list(range(len(self.ranks)))
+        if self._hb is None:
+            timeout_ms, deadline = -1, None
+            timeout_s = interval_s = 0.0
+            on_idle = None
+        else:
+            timeout_s, interval_s, on_idle = self._hb
+            timeout_ms = max(1, int(interval_s * 1000))
+            deadline = time.monotonic() + timeout_s
+        while pending:
+            n = len(pending)
+            fds = (ct.c_int * n)(*[self._fd_list[i] for i in pending])
+            bufs = (u8p * n)()
+            lens = (ct.c_int64 * n)()
+            tags = (ct.c_uint8 * n)()
+            still: List[int] = []
+            try:
+                rc = self._lib.hvd_gather_frames(
+                    fds, n, self._secret_buf, len(self._secret),
+                    bufs, lens, tags, timeout_ms)
+                if rc in (-errno.EAGAIN, -errno.EWOULDBLOCK) \
+                        and self._hb is not None:
+                    # SO_RCVTIMEO (armed by Channel.arm on these same
+                    # fds) fired inside the native blocking read: a
+                    # peer stalled MID-FRAME — poll saw readability
+                    # but the rest of the frame never arrived within
+                    # the heartbeat timeout. The native call doesn't
+                    # report WHICH fd timed out, so only blame a rank
+                    # when it's unambiguous; otherwise origin=-1
+                    # ("unknown rank") with the candidates in the
+                    # cause — naming a possibly-healthy peer in the
+                    # machine-readable field would be worse.
+                    waiting = [self.ranks[i] for i in pending]
+                    origin = waiting[0] if len(waiting) == 1 else -1
+                    raise _abort_error(
+                        origin,
+                        f"peer stalled mid-frame (silent for "
+                        f"{timeout_s:g}s with a frame outstanding; "
+                        f"candidates: rank(s) {waiting}) — presumed "
+                        f"dead (heartbeat timeout)")
+                if rc != 0 and rc != -errno.ETIMEDOUT:
+                    # partial frames may already be malloc'd; the
+                    # finally block frees them.
                     raise ConnectionError(
-                        f"expected tag {expect_tag} from rank {r}, "
-                        f"got {tags[i]}")
-                out[r] = ct.string_at(bufs[i], lens[i])
-        finally:
-            for i in range(n):
-                if bufs[i]:
-                    self._lib.hvd_free(bufs[i])
+                        f"native gather failed: errno {-rc}")
+                for j, i in enumerate(pending):
+                    r = self.ranks[i]
+                    if not bufs[j]:
+                        still.append(i)
+                        continue
+                    if tags[j] == TAG_ABORT:
+                        origin, cause = heartbeat.decode_abort(
+                            ct.string_at(bufs[j], lens[j]))
+                        raise _abort_error(origin, cause, resolved=True)
+                    if tags[j] != expect_tag:
+                        raise ConnectionError(
+                            f"expected tag {expect_tag} from rank {r}, "
+                            f"got {tags[j]}")
+                    out[r] = ct.string_at(bufs[j], lens[j])
+            finally:
+                for j in range(n):
+                    if bufs[j]:
+                        self._lib.hvd_free(bufs[j])
+            if rc == -errno.ETIMEDOUT:
+                if on_idle is not None:
+                    on_idle()
+                if len(still) != len(pending):
+                    # some frames landed this slice: the world is
+                    # moving — restart the silence window
+                    deadline = time.monotonic() + timeout_s
+                elif time.monotonic() > deadline:
+                    # The gather knows exactly which ranks were silent
+                    # — name the first as the abort origin (a merely
+                    # wedged peer has a live socket, so the generic
+                    # _dead_peers probe upstream would find nothing).
+                    waiting = [self.ranks[i] for i in still]
+                    raise _abort_error(
+                        waiting[0],
+                        f"no control frame from rank(s) {waiting} for "
+                        f"{timeout_s:g}s — peer presumed dead "
+                        f"(heartbeat timeout; raise "
+                        f"HOROVOD_HEARTBEAT_TIMEOUT if peers "
+                        f"legitimately stall longer)")
+            pending = still
         return out
 
     def send_all(self, payload, tag: int,
@@ -350,6 +568,26 @@ class Controller:
                 b"\x01" if ok else b"\x00") == b"\x01"
         return self.broadcast_data(None) == b"\x01"
 
+    def abort(self, origin_rank: int, cause: str) -> None:
+        """Best-effort fan-out of a world ABORT notice to every peer
+        this controller talks to directly (coordinator: all owner
+        channels; worker: upward + local leaves). Never raises — it
+        runs on failure paths where channels may already be dead."""
+
+    def sever_connection(self, target_rank: Optional[int] = None) -> None:
+        """Fault injection: abruptly close a control channel (to
+        ``target_rank`` when this controller owns several, else the
+        upward/all channels), simulating link loss."""
+
+    def drain_abort_notice(self, grace_s: float = 0.0) -> Optional[tuple]:
+        """Failure path only: sweep this controller's channels for a
+        queued TAG_ABORT → (origin_rank, cause), waiting up to
+        ``grace_s`` for one in flight. Lets a rank that inferred a
+        blame from an anonymous transport error defer to the
+        authoritative notice from the rank that actually detected the
+        failure (see _drain_abort)."""
+        return None
+
     def close(self) -> None:
         pass
 
@@ -390,7 +628,9 @@ class TcpCoordinator(Controller):
 
     def __init__(self, size: int, port: int = 0, secret: bytes = b"",
                  start_timeout: float = 30.0, listener=None,
-                 hierarchical: bool = True):
+                 hierarchical: bool = True,
+                 heartbeat_interval: float = 5.0,
+                 heartbeat_timeout: float = 30.0):
         """``listener`` — an already-bound listening socket to adopt
         instead of binding ``port``. Launch layers that must publish
         the coordinator endpoint BEFORE init (Spark rendezvous,
@@ -415,6 +655,10 @@ class TcpCoordinator(Controller):
         self._size = size
         self._start_timeout = start_timeout
         self._hierarchical = hierarchical
+        self._hb_interval = heartbeat_interval
+        self._hb_timeout = heartbeat_timeout
+        self._ping_seq = 0
+        self._last_ping = 0.0
         self.topology = None  # set by accept_workers
         self._fanout: Optional[_NativeFanout] = None
         # channel owner rank -> all ranks that channel represents
@@ -445,6 +689,7 @@ class TcpCoordinator(Controller):
         while len(self._channels) < self._size - 1:
             r, hello, ch = next(accepts)
             hostnames[r] = hello["hostname"]
+            ch.peer = f"rank {r} ({ch.peer})"
             self._channels[r] = ch
         # Broadcast the full hostname list so every rank derives the same
         # topology (reference: operations.cc:729-764).
@@ -470,9 +715,16 @@ class TcpCoordinator(Controller):
                 self._owner_of[m] = owner
         self._has_aggregates = any(
             len(ms) > 1 for ms in self._members.values())
+        hb = None
+        if self._hb_timeout and self._hb_timeout > 0:
+            hb = _hb_normalized(self._hb_timeout, self._hb_interval) \
+                + (self._ping_peers,)
+            for ch in self._channels.values():
+                ch.arm(self._hb_timeout, self._hb_interval,
+                       on_idle=self._ping_peers)
         if self._size > 1:
             self._fanout = _NativeFanout.create(self._channels,
-                                                self._secret)
+                                                self._secret, hb=hb)
         hlog.debug(f"coordinator up: {self._size} ranks, "
                    f"{self.topology.cross_size} hosts, "
                    f"fan-in {len(self._channels)}", rank=0)
@@ -566,23 +818,67 @@ class TcpCoordinator(Controller):
                 out[m] = f
         return out
 
+    def _ping_peers(self) -> None:
+        """Fired per idle gather slice: tell every worker the world is
+        alive (the straggler the gather waits on is silent TO THEM
+        too — without this, their recv deadlines would false-fire on
+        a merely slow peer)."""
+        _maybe_ping(self, self._channels, 0)
+
+    def _recv_ctrl(self, r: int, ch: network.Channel,
+                   expect_tag: int) -> bytes:
+        """One control frame from rank ``r``'s channel: PINGs are
+        liveness-only and skipped, ABORT raises the structured error,
+        transport failures are named after the peer."""
+        while True:
+            try:
+                tag, data = ch.recv()
+            except WorldAbortedError:
+                raise
+            except (ConnectionError, OSError) as e:
+                raise _abort_error(
+                    r, f"control channel to {ch.peer} failed: {e}") \
+                    from e
+            if tag == TAG_PING:
+                continue
+            if tag == TAG_ABORT:
+                origin, cause = heartbeat.decode_abort(data)
+                raise _abort_error(origin, cause, resolved=True)
+            if tag != expect_tag:
+                raise ConnectionError(
+                    f"expected tag {expect_tag} from rank {r}, "
+                    f"got {tag}")
+            return data
+
+    def _raise_transport(self, e: Exception) -> None:
+        """Turn an anonymous transport error from a fan-out primitive
+        into a WorldAbortedError naming the dead peer when one can be
+        identified (native gather and broadcast errors carry no rank)."""
+        dead = _dead_peers(self._channels)
+        if dead:
+            raise _abort_error(
+                dead[0], f"connection to rank {dead[0]} lost: {e}") \
+                from e
+        raise _abort_error(0, f"coordinator transport failure: {e}") \
+            from e
+
     def _gather_frames(self, payload, expect_tag: int) -> List[bytes]:
         """One frame per channel (native poll loop when available),
         rank-indexed with this rank's own payload at 0, aggregate
         frames expanded to their member ranks."""
         out: List[bytes] = [b""] * self._size
         out[0] = payload
-        if self._fanout is not None:
-            for r, data in self._fanout.gather(expect_tag).items():
-                out[r] = data
-        else:
-            for r, ch in self._channels.items():
-                tag, data = ch.recv()
-                if tag != expect_tag:
-                    raise ConnectionError(
-                        f"expected tag {expect_tag} from rank {r}, "
-                        f"got {tag}")
-                out[r] = data
+        try:
+            if self._fanout is not None:
+                for r, data in self._fanout.gather(expect_tag).items():
+                    out[r] = data
+            else:
+                for r, ch in self._channels.items():
+                    out[r] = self._recv_ctrl(r, ch, expect_tag)
+        except WorldAbortedError:
+            raise
+        except (ConnectionError, OSError) as e:
+            self._raise_transport(e)
         return self._expand(out)
 
     def gather_requests(self, payload: bytes) -> Optional[List[bytes]]:
@@ -590,12 +886,15 @@ class TcpCoordinator(Controller):
 
     def broadcast_responses(self, payload: Optional[bytes]) -> bytes:
         assert payload is not None
-        if self._fanout is not None:
-            self._fanout.send_all(payload, TAG_RESPONSES)
+        try:
+            if self._fanout is not None:
+                self._fanout.send_all(payload, TAG_RESPONSES)
+                return payload
+            for ch in self._channels.values():
+                ch.send(payload, TAG_RESPONSES)
             return payload
-        for ch in self._channels.values():
-            ch.send(payload, TAG_RESPONSES)
-        return payload
+        except (ConnectionError, OSError) as e:
+            self._raise_transport(e)
 
     def gather_data(self, payload: bytes) -> Optional[List[bytes]]:
         return self._gather_frames(_as_buffer(payload), TAG_DATA)
@@ -603,32 +902,35 @@ class TcpCoordinator(Controller):
     def broadcast_data(self, payload: Optional[bytes],
                        root_rank: int = 0) -> bytes:
         payload = _as_buffer(payload)
-        if root_rank != 0:
-            # Pull the payload up from the root's owning channel, then
-            # fan out to every OTHER channel — the owner (the root
-            # itself, or the local root relaying for it) already has
-            # the bytes and has distributed them on its host, and
-            # echoing them back would double its traffic.
-            owner = self._owner_of[root_rank]
-            tag, payload = self._channels[owner].recv()
-            if tag != TAG_DATA:
-                raise ConnectionError("expected TAG_DATA from root")
+        try:
+            if root_rank != 0:
+                # Pull the payload up from the root's owning channel,
+                # then fan out to every OTHER channel — the owner (the
+                # root itself, or the local root relaying for it)
+                # already has the bytes and has distributed them on its
+                # host, and echoing them back would double its traffic.
+                owner = self._owner_of[root_rank]
+                payload = self._recv_ctrl(owner, self._channels[owner],
+                                          TAG_DATA)
+                if self._fanout is not None:
+                    self._fanout.send_all(payload, TAG_DATA,
+                                          exclude_rank=owner)
+                    return payload
+                for r, ch in self._channels.items():
+                    if r != owner:
+                        ch.send(payload, TAG_DATA)
+                return payload
             assert payload is not None
             if self._fanout is not None:
-                self._fanout.send_all(payload, TAG_DATA,
-                                      exclude_rank=owner)
+                self._fanout.send_all(payload, TAG_DATA)
                 return payload
-            for r, ch in self._channels.items():
-                if r != owner:
-                    ch.send(payload, TAG_DATA)
+            for ch in self._channels.values():
+                ch.send(payload, TAG_DATA)
             return payload
-        assert payload is not None
-        if self._fanout is not None:
-            self._fanout.send_all(payload, TAG_DATA)
-            return payload
-        for ch in self._channels.values():
-            ch.send(payload, TAG_DATA)
-        return payload
+        except WorldAbortedError:
+            raise
+        except (ConnectionError, OSError) as e:
+            self._raise_transport(e)
 
     def scatter_data(self, payloads: Optional[List[bytes]]) -> bytes:
         assert payloads is not None and len(payloads) == self._size
@@ -637,12 +939,15 @@ class TcpCoordinator(Controller):
                     else pack_frames([_as_buffer(payloads[m])
                                       for m in ms]))
             for owner, ms in self._members.items()}
-        if self._fanout is not None:
-            self._fanout.scatter(per_owner, TAG_DATA)
+        try:
+            if self._fanout is not None:
+                self._fanout.scatter(per_owner, TAG_DATA)
+                return payloads[0]
+            for r, ch in self._channels.items():
+                ch.send(per_owner[r], TAG_DATA)
             return payloads[0]
-        for r, ch in self._channels.items():
-            ch.send(per_owner[r], TAG_DATA)
-        return payloads[0]
+        except (ConnectionError, OSError) as e:
+            self._raise_transport(e)
 
     def worker_peer_ip(self, rank: int) -> str:
         """IP of worker ``rank`` as seen from this coordinator — the
@@ -656,6 +961,27 @@ class TcpCoordinator(Controller):
         if ip is not None:
             return ip
         return self._channels[self._owner_of[rank]].sock.getpeername()[0]
+
+    def abort(self, origin_rank: int, cause: str) -> None:
+        payload = heartbeat.encode_abort(origin_rank, cause)
+        for ch in self._channels.values():
+            try:
+                ch.send(payload, TAG_ABORT)
+            except Exception:
+                pass  # that peer is already dead/unreachable
+
+    def sever_connection(self, target_rank: Optional[int] = None) -> None:
+        if target_rank is not None:
+            owner = self._owner_of.get(target_rank, target_rank)
+            ch = self._channels.get(owner)
+            if ch is not None:
+                ch.close()
+            return
+        for ch in self._channels.values():
+            ch.close()
+
+    def drain_abort_notice(self, grace_s: float = 0.0) -> Optional[tuple]:
+        return _drain_abort(self._channels, grace_s)
 
     def close(self) -> None:
         for ch in self._channels.values():
@@ -683,11 +1009,19 @@ class TcpWorker(Controller):
     hop without adding a Python per-channel loop."""
 
     def __init__(self, rank: int, size: int, addr: str, port: int,
-                 secret: bytes = b"", start_timeout: float = 30.0):
+                 secret: bytes = b"", start_timeout: float = 30.0,
+                 heartbeat_interval: float = 5.0,
+                 heartbeat_timeout: float = 30.0):
         self.coordinator_addr = addr  # rank 0's reachable address
+        self._hb_interval = heartbeat_interval
+        self._hb_timeout = heartbeat_timeout
+        self._ping_seq = 0
+        self._last_ping = 0.0
+        self._up_rank = 0  # who the upward channel talks to
         self._ch = network.connect(addr, port, secret,
                                    timeout=start_timeout,
                                    retry_deadline=start_timeout)
+        self._ch.peer = f"coordinator ({self._ch.peer})"
         hello = json.dumps({
             "rank": rank, "hostname": _my_hostname()}).encode()
         self._ch.send(hello, TAG_HANDSHAKE)
@@ -707,10 +1041,19 @@ class TcpWorker(Controller):
             members = host_members[self.topology.cross_rank]
             if self.topology.local_rank == 0:
                 self._become_local_root(members, secret, start_timeout)
-                self._child_fanout = _NativeFanout.create(
-                    self._children, secret)
             else:
                 self._become_leaf(rank, secret, start_timeout)
+        hb = None
+        if self._hb_timeout and self._hb_timeout > 0:
+            hb = _hb_normalized(self._hb_timeout, self._hb_interval) \
+                + (self._ping_children,)
+            self._ch.arm(self._hb_timeout, self._hb_interval)
+            for r, ch in self._children.items():
+                ch.arm(self._hb_timeout, self._hb_interval,
+                       on_idle=self._ping_children)
+        if self._children:
+            self._child_fanout = _NativeFanout.create(
+                self._children, secret, hb=hb)
 
     def _become_local_root(self, members: List[int], secret: bytes,
                            start_timeout: float) -> None:
@@ -736,6 +1079,7 @@ class TcpWorker(Controller):
         while expected:
             r, _, ch = next(accepts)
             ch.send(b"{}", TAG_HANDSHAKE)  # accept ack
+            ch.peer = f"rank {r} ({ch.peer})"
             self._children[r] = ch
             expected.discard(r)
         srv.close()
@@ -762,48 +1106,127 @@ class TcpWorker(Controller):
         self._ch = network.connect(_local_root_addr(), port, secret,
                                    timeout=start_timeout,
                                    retry_deadline=start_timeout)
+        root = self.topology.local_roots[self.topology.cross_rank]
+        self._up_rank = root
+        self._ch.peer = f"local root rank {root} ({self._ch.peer})"
         self._ch.send(json.dumps({"rank": rank}).encode(), TAG_HANDSHAKE)
         tag, _ = self._ch.recv()
         if tag != TAG_HANDSHAKE:
             raise ConnectionError("local root handshake failed")
 
     # -- per-cycle primitives (relay through _children when present) -----
+    def _ping_children(self) -> None:
+        """Fired per idle slice of the child gather (a straggler leaf
+        must not look dead to its waiting siblings)."""
+        if self._children:
+            _maybe_ping(self, self._children, self.rank)
+
+    def _relay_children_safe(self, data, tag: int) -> None:
+        """Best-effort PING/ABORT relay downward — never raises (runs
+        on liveness/failure paths)."""
+        for ch in self._children.values():
+            try:
+                ch.send(data, tag)
+            except Exception:
+                pass
+
+    def _recv_up(self, expect_tag: int) -> bytes:
+        """One frame from the upward channel. PINGs prove the world is
+        alive (forwarded down so leaf deadlines reset too); ABORT
+        relays down then raises; silence past the heartbeat deadline
+        or a dead socket names the upward peer as the origin."""
+        while True:
+            try:
+                tag, data = self._ch.recv()
+            except WorldAbortedError:
+                raise
+            except (ConnectionError, OSError) as e:
+                raise _abort_error(
+                    self._up_rank,
+                    f"control channel to {self._ch.peer} failed: {e}") \
+                    from e
+            if tag == TAG_PING:
+                self._relay_children_safe(data, TAG_PING)
+                continue
+            if tag == TAG_ABORT:
+                origin, cause = heartbeat.decode_abort(data)
+                self._relay_children_safe(data, TAG_ABORT)
+                raise _abort_error(origin, cause, resolved=True)
+            if tag != expect_tag:
+                raise ConnectionError(
+                    f"expected tag {expect_tag} from {self._ch.peer}, "
+                    f"got {tag}")
+            return data
+
     def _recv_child(self, r: int, tag: int) -> bytes:
-        t, data = self._children[r].recv()
+        try:
+            t, data = self._children[r].recv()
+        except WorldAbortedError:
+            raise
+        except (ConnectionError, OSError) as e:
+            raise _abort_error(
+                r, f"control channel to local rank {r} failed: {e}") \
+                from e
+        if t == TAG_ABORT:
+            origin, cause = heartbeat.decode_abort(data)
+            raise _abort_error(origin, cause, resolved=True)
         if t != tag:
             raise ConnectionError(
                 f"expected tag {tag} from local rank {r}, got {t}")
         return data
 
+    def _raise_child_transport(self, e: Exception, what: str):
+        """Turn an anonymous transport error on the leaf tier into a
+        named blame: a probed-dead leaf if there is one, else this
+        rank (mirror of TcpCoordinator._raise_transport)."""
+        dead = _dead_peers(self._children)
+        origin = dead[0] if dead else self.rank
+        raise _abort_error(origin, f"{what} failed: {e}") from e
+
     def _send_children(self, data, tag: int,
                        exclude_rank: Optional[int] = None) -> None:
-        if self._child_fanout is not None:
-            self._child_fanout.send_all(data, tag,
-                                        exclude_rank=exclude_rank)
-            return
-        for r, ch in self._children.items():
-            if r != exclude_rank:
-                ch.send(data, tag)
+        try:
+            if self._child_fanout is not None:
+                self._child_fanout.send_all(data, tag,
+                                            exclude_rank=exclude_rank)
+                return
+            for r, ch in self._children.items():
+                if r != exclude_rank:
+                    ch.send(data, tag)
+        except (ConnectionError, OSError) as e:
+            self._raise_child_transport(e, "relay to local leaves")
+
+    def _send_up(self, payload, tag: int) -> None:
+        try:
+            self._ch.send(payload, tag)
+        except (ConnectionError, OSError) as e:
+            raise _abort_error(
+                self._up_rank,
+                f"control channel to {self._ch.peer} failed: {e}") \
+                from e
 
     def _gather_up(self, payload, tag: int) -> None:
         if self._children:
-            if self._child_fanout is not None:
-                frames = self._child_fanout.gather(tag)
-            else:
-                frames = {r: self._recv_child(r, tag)
-                          for r in self._children}
+            try:
+                if self._child_fanout is not None:
+                    frames = self._child_fanout.gather(tag)
+                else:
+                    frames = {r: self._recv_child(r, tag)
+                              for r in self._children}
+            except WorldAbortedError:
+                raise
+            except (ConnectionError, OSError) as e:
+                self._raise_child_transport(e, "gather from local leaves")
             frames[self.rank] = payload
             payload = pack_frames([frames[r] for r in self._members])
-        self._ch.send(payload, tag)
+        self._send_up(payload, tag)
 
     def gather_requests(self, payload: bytes) -> Optional[List[bytes]]:
         self._gather_up(payload, TAG_REQUESTS)
         return None
 
     def broadcast_responses(self, payload: Optional[bytes]) -> bytes:
-        tag, data = self._ch.recv()
-        if tag != TAG_RESPONSES:
-            raise ConnectionError(f"expected TAG_RESPONSES, got {tag}")
+        data = self._recv_up(TAG_RESPONSES)
         self._send_children(data, TAG_RESPONSES)
         return data
 
@@ -818,7 +1241,7 @@ class TcpWorker(Controller):
             # Root sends up; the coordinator fans out to the other
             # channels only — our own copy is already authoritative,
             # and our local leaves get it straight from us.
-            self._ch.send(payload, TAG_DATA)
+            self._send_up(payload, TAG_DATA)
             self._send_children(payload, TAG_DATA)
             return payload
         if root_rank in self._children:
@@ -826,19 +1249,15 @@ class TcpWorker(Controller):
             # and to its local siblings; the coordinator serves the
             # rest of the world and skips this whole host.
             data = self._recv_child(root_rank, TAG_DATA)
-            self._ch.send(data, TAG_DATA)
+            self._send_up(data, TAG_DATA)
             self._send_children(data, TAG_DATA, exclude_rank=root_rank)
             return data
-        tag, data = self._ch.recv()
-        if tag != TAG_DATA:
-            raise ConnectionError(f"expected TAG_DATA, got {tag}")
+        data = self._recv_up(TAG_DATA)
         self._send_children(data, TAG_DATA)
         return data
 
     def scatter_data(self, payloads: Optional[List[bytes]]) -> bytes:
-        tag, data = self._ch.recv()
-        if tag != TAG_DATA:
-            raise ConnectionError(f"expected TAG_DATA, got {tag}")
+        data = self._recv_up(TAG_DATA)
         if self._children:
             frames = unpack_frames(data)
             mine: Optional[bytes] = None
@@ -848,14 +1267,35 @@ class TcpWorker(Controller):
                     mine = f
                 else:
                     per_child[r] = f
-            if self._child_fanout is not None:
-                self._child_fanout.scatter(per_child, TAG_DATA)
-            else:
-                for r, f in per_child.items():
-                    self._children[r].send(f, TAG_DATA)
+            try:
+                if self._child_fanout is not None:
+                    self._child_fanout.scatter(per_child, TAG_DATA)
+                else:
+                    for r, f in per_child.items():
+                        self._children[r].send(f, TAG_DATA)
+            except (ConnectionError, OSError) as e:
+                self._raise_child_transport(e, "scatter to local leaves")
             assert mine is not None
             return mine
         return data
+
+    def abort(self, origin_rank: int, cause: str) -> None:
+        payload = heartbeat.encode_abort(origin_rank, cause)
+        try:
+            self._ch.send(payload, TAG_ABORT)  # escalate up
+        except Exception:
+            pass
+        self._relay_children_safe(payload, TAG_ABORT)
+
+    def sever_connection(self, target_rank: Optional[int] = None) -> None:
+        if target_rank is not None and target_rank in self._children:
+            self._children[target_rank].close()
+            return
+        self._ch.close()
+
+    def drain_abort_notice(self, grace_s: float = 0.0) -> Optional[tuple]:
+        return _drain_abort({self._up_rank: self._ch, **self._children},
+                            grace_s)
 
     def close(self) -> None:
         for ch in self._children.values():

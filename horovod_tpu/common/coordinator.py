@@ -39,11 +39,15 @@ class MessageTable:
     """Pending negotiations: tensor name → requests received so far
     (reference: global_state.h:120-125, operations.cc:110-117)."""
 
-    def __init__(self):
+    def __init__(self, on_remove=None):
         self._table: Dict[str, _TensorRecord] = {}
         # FIFO of names that became ready this cycle, in readiness order
         # (reference: operations.cc ready_to_reduce, 1069-1079).
         self._ready: List[str] = []
+        # Fired with the tensor name whenever a negotiation completes
+        # (the StallInspector clears its warned-set entry so a
+        # recurring name that stalls AGAIN warns again).
+        self._on_remove = on_remove
 
     def increment_tensor_count(self, msg: Request, size: int,
                                timeline=None) -> bool:
@@ -74,6 +78,8 @@ class MessageTable:
 
     def remove(self, name: str) -> None:
         del self._table[name]
+        if self._on_remove is not None:
+            self._on_remove(name)
 
     def pending(self) -> List[Tuple[str, float, List[int]]]:
         """(name, age_seconds, ranks_reported) for stall reporting."""
@@ -343,6 +349,12 @@ class StallInspector:
         if self.disabled or self.warning_time <= 0:
             return False
         return time.monotonic() - self._last_check >= self.warning_time
+
+    def tensor_completed(self, name: str) -> None:
+        """A stalled tensor finally negotiated: forget that we warned
+        about it, so the SAME recurring name stalling again later in
+        the process lifetime warns again (MessageTable.remove hook)."""
+        self._warned.discard(name)
 
     def check(self, table: MessageTable) -> bool:
         """Log a report of stalled tensors; returns True if the shutdown

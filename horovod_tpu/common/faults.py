@@ -1,0 +1,214 @@
+"""Deterministic fault injection for the fail-fast abort machinery.
+
+Real process death is easy to cause from a test (SIGKILL) but hard to
+*time*: proving that survivors detect a peer that dies mid-collective,
+or one that goes silent without the kernel sending a FIN/RST, needs
+the failure to land at an exact point of the negotiation cycle. This
+module injects those failures from inside the background loop itself.
+
+Faults are armed either through the API::
+
+    from horovod_tpu.common import faults
+    faults.install(action="kill", at_cycle=200)          # SIGKILL self
+    faults.install(action="hang", at_cycle=50, seconds=8)
+
+or through the environment, so launcher-spawned ranks can be faulted
+without code changes::
+
+    HOROVOD_FAULT_SPEC="rank=1:kill:cycle=40;rank=2:delay:op=3:ms=50"
+
+Grammar: directives separated by ``;``; each directive is ``:``-joined
+tokens — one bare action word plus ``key=value`` arguments. ``rank``
+scopes the directive to one global rank (absent = every rank). Exactly
+one trigger is required: ``cycle=K`` fires at the K-th negotiation
+cycle, ``op=K`` fires just before the K-th executed response (i.e.
+after negotiation, squarely mid-collective).
+
+Actions:
+
+- ``kill``  — SIGKILL this process (no cleanup, no FIN from user space;
+  the abrupt-death case).
+- ``exit``  — ``os._exit(code)`` (``code=N``, default 1).
+- ``hang``  — stop the background loop for ``seconds=S`` (default 60):
+  the process stays alive but goes silent, which is the only way to
+  exercise the heartbeat deadline rather than TCP reset detection.
+- ``sever`` — close this rank's control channel(s) (``target=R``
+  selects one peer on the coordinator/local root), simulating link
+  loss.
+- ``delay`` — sleep ``ms=N`` milliseconds once (latency injection).
+
+The module is zero-cost when idle: the runtime's per-cycle/per-op
+ticks return after a single ``_PLAN`` check.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import List, Optional
+
+from horovod_tpu.common import logging as hlog
+
+_ACTIONS = ("kill", "exit", "hang", "sever", "delay")
+
+
+class Fault:
+    """One armed fault directive."""
+
+    __slots__ = ("action", "rank", "at_cycle", "at_op", "seconds", "ms",
+                 "code", "target", "fired")
+
+    def __init__(self, action: str, rank: Optional[int] = None,
+                 at_cycle: Optional[int] = None,
+                 at_op: Optional[int] = None, seconds: float = 60.0,
+                 ms: float = 0.0, code: int = 1,
+                 target: Optional[int] = None):
+        if action not in _ACTIONS:
+            raise ValueError(f"unknown fault action {action!r}; "
+                             f"expected one of {_ACTIONS}")
+        if (at_cycle is None) == (at_op is None):
+            raise ValueError(
+                "a fault needs exactly one trigger: at_cycle= or at_op=")
+        self.action = action
+        self.rank = rank
+        self.at_cycle = at_cycle
+        self.at_op = at_op
+        self.seconds = seconds
+        self.ms = ms
+        self.code = code
+        self.target = target
+        self.fired = False
+
+    def __repr__(self) -> str:
+        trig = (f"cycle={self.at_cycle}" if self.at_cycle is not None
+                else f"op={self.at_op}")
+        scope = "*" if self.rank is None else self.rank
+        return f"Fault({self.action}@{trig}, rank={scope})"
+
+
+_PLAN: Optional[List[Fault]] = None
+_ENV_LOADED = False
+
+
+def parse_spec(spec: str) -> List[Fault]:
+    """Parse a HOROVOD_FAULT_SPEC string; raises ValueError on garbage
+    (a typo'd fault spec silently doing nothing would invalidate the
+    test that relied on it)."""
+    faults: List[Fault] = []
+    for directive in spec.split(";"):
+        directive = directive.strip()
+        if not directive:
+            continue
+        action = None
+        kw = {}
+        for token in directive.split(":"):
+            token = token.strip()
+            if not token:
+                continue
+            if "=" in token:
+                k, v = token.split("=", 1)
+                k = k.strip()
+                if k == "rank":
+                    kw["rank"] = int(v)
+                elif k == "cycle":
+                    kw["at_cycle"] = int(v)
+                elif k == "op":
+                    kw["at_op"] = int(v)
+                elif k == "seconds":
+                    kw["seconds"] = float(v)
+                elif k == "ms":
+                    kw["ms"] = float(v)
+                elif k == "code":
+                    kw["code"] = int(v)
+                elif k == "target":
+                    kw["target"] = int(v)
+                else:
+                    raise ValueError(
+                        f"unknown fault key {k!r} in {directive!r}")
+            else:
+                if action is not None:
+                    raise ValueError(
+                        f"two actions in one directive: {directive!r}")
+                action = token
+        if action is None:
+            raise ValueError(f"fault directive has no action: "
+                             f"{directive!r}")
+        faults.append(Fault(action, **kw))
+    return faults
+
+
+def install(action: str, rank: Optional[int] = None,
+            at_cycle: Optional[int] = None, at_op: Optional[int] = None,
+            **kw) -> Fault:
+    """Arm one fault programmatically (test/API path)."""
+    global _PLAN
+    f = Fault(action, rank=rank, at_cycle=at_cycle, at_op=at_op, **kw)
+    if _PLAN is None:
+        _PLAN = []
+    _PLAN.append(f)
+    return f
+
+
+def clear() -> None:
+    global _PLAN, _ENV_LOADED
+    _PLAN = None
+    _ENV_LOADED = False
+
+
+def load_env() -> None:
+    """Arm faults from HOROVOD_FAULT_SPEC, once per process."""
+    global _PLAN, _ENV_LOADED
+    if _ENV_LOADED:
+        return
+    _ENV_LOADED = True
+    spec = os.environ.get("HOROVOD_FAULT_SPEC", "")
+    if not spec:
+        return
+    parsed = parse_spec(spec)
+    if _PLAN is None:
+        _PLAN = []
+    _PLAN.extend(parsed)
+
+
+def _apply(fault: Fault, runtime) -> None:
+    fault.fired = True
+    rank = runtime.controller.rank
+    hlog.warning(f"fault injection firing on rank {rank}: {fault!r}",
+                 rank=rank)
+    if fault.action == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)
+    elif fault.action == "exit":
+        os._exit(fault.code)
+    elif fault.action == "hang":
+        time.sleep(fault.seconds)
+    elif fault.action == "delay":
+        time.sleep(fault.ms / 1000.0)
+    elif fault.action == "sever":
+        runtime.controller.sever_connection(fault.target)
+
+
+def _tick(runtime, cycle: Optional[int], op: Optional[int]) -> None:
+    rank = runtime.controller.rank
+    for f in _PLAN:  # type: ignore[union-attr]
+        if f.fired or (f.rank is not None and f.rank != rank):
+            continue
+        if cycle is not None and f.at_cycle is not None \
+                and cycle >= f.at_cycle:
+            _apply(f, runtime)
+        elif op is not None and f.at_op is not None and op >= f.at_op:
+            _apply(f, runtime)
+
+
+def tick_cycle(runtime, cycle: int) -> None:
+    """Called by the background loop at the top of every cycle."""
+    if _PLAN is None:
+        return
+    _tick(runtime, cycle, None)
+
+
+def tick_op(runtime, op_index: int) -> None:
+    """Called just before executing each negotiated response."""
+    if _PLAN is None:
+        return
+    _tick(runtime, None, op_index)

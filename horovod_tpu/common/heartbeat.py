@@ -1,0 +1,61 @@
+"""Heartbeat and abort frames for the fail-fast control plane.
+
+The reference has no liveness story at all: a rank that dies uncleanly
+(SIGKILL, OOM, host loss) leaves every peer blocked in a control-plane
+recv forever, and only the external launcher's kill-on-exit unblocks
+them (reference: horovod/run/run.py). This module defines the two tiny
+wire payloads the TPU port uses to do better:
+
+``PING``  — sent DOWN the control tree (coordinator -> owners, local
+root -> leaves) whenever the sender is alive but has nothing else to
+say: its gather is idle-waiting on a straggler. A receiver treats any
+frame — ping or real — as proof of life and resets its recv deadline,
+so a healthy-but-waiting world never false-positives while a silent
+peer is detected within ``HOROVOD_HEARTBEAT_TIMEOUT``.
+
+``ABORT`` — fanned down the relay tree (and escalated up by workers)
+when any rank observes a transport failure, a data-plane exception, or
+the stall-shutdown threshold. Carries the originating global rank and
+a human-readable cause, which every survivor surfaces as a structured
+:class:`~horovod_tpu.common.status.WorldAbortedError`.
+
+Both payloads are fixed little-endian structs (+ UTF-8 cause) so they
+can be produced/parsed by the native core later without a codec
+dependency.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Tuple
+
+_PING = struct.Struct("<iQ")        # sender rank | monotone sequence
+_ABORT_HEAD = struct.Struct("<iI")  # origin rank | cause byte length
+
+
+def encode_ping(rank: int, seq: int) -> bytes:
+    return _PING.pack(rank, seq)
+
+
+def decode_ping(payload: bytes) -> Tuple[int, int]:
+    """-> (sender_rank, sequence). Raises ValueError on a bad frame."""
+    if len(payload) != _PING.size:
+        raise ValueError(
+            f"ping frame must be {_PING.size} bytes, got {len(payload)}")
+    return _PING.unpack(payload)
+
+
+def encode_abort(origin_rank: int, cause: str) -> bytes:
+    body = cause.encode("utf-8")
+    return _ABORT_HEAD.pack(origin_rank, len(body)) + body
+
+
+def decode_abort(payload: bytes) -> Tuple[int, str]:
+    """-> (origin_rank, cause). Tolerates a truncated cause (a dying
+    sender may not flush the whole frame) but rejects a short header."""
+    if len(payload) < _ABORT_HEAD.size:
+        raise ValueError(
+            f"abort frame too short: {len(payload)} bytes")
+    origin, n = _ABORT_HEAD.unpack_from(payload, 0)
+    body = payload[_ABORT_HEAD.size:_ABORT_HEAD.size + n]
+    return origin, body.decode("utf-8", errors="replace")

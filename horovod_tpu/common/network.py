@@ -16,9 +16,11 @@ from __future__ import annotations
 
 import hmac
 import hashlib
+import random
 import socket
 import struct
-from typing import Optional, Tuple
+import time
+from typing import Callable, Iterator, Optional, Tuple
 
 _HDR = struct.Struct("<IB")
 _DIGEST_LEN = 32
@@ -57,40 +59,142 @@ def as_byte_view(payload):
     return mv.cast("B") if mv.nbytes else b""
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray(n)
-    view = memoryview(buf)
+def _recv_exact_into(sock: socket.socket, view: memoryview,
+                     who: str = "peer", hb=None) -> None:
+    """Fill ``view`` from ``sock``. ``who`` names the peer in every
+    transport error. ``hb`` is an optional ``(timeout_s, interval_s,
+    on_idle)`` liveness deadline: the wait is sliced into
+    ``interval_s`` ticks (``on_idle`` fires per idle tick — the
+    coordinator uses it to PING waiting workers) and TOTAL SILENCE for
+    ``timeout_s`` raises — any received byte resets the clock, so a
+    big frame trickling in over a slow link never false-positives."""
     got = 0
-    while got < n:
-        r = sock.recv_into(view[got:])
-        if r == 0:
-            raise ConnectionError("socket closed while reading")
-        got += r
+    n = len(view)
+    if hb is None:
+        while got < n:
+            r = sock.recv_into(view[got:])
+            if r == 0:
+                raise ConnectionError(
+                    f"connection to {who} closed while reading")
+            got += r
+        return
+    timeout_s, interval_s, on_idle = hb
+    idle = 0.0
+    prev = sock.gettimeout()
+    sock.settimeout(interval_s)
+    try:
+        while got < n:
+            try:
+                r = sock.recv_into(view[got:])
+            except socket.timeout:
+                idle += interval_s
+                if on_idle is not None:
+                    on_idle()
+                if idle >= timeout_s:
+                    raise ConnectionError(
+                        f"no data from {who} for {idle:.0f}s — peer "
+                        f"presumed dead (heartbeat timeout "
+                        f"{timeout_s:g}s; raise "
+                        f"HOROVOD_HEARTBEAT_TIMEOUT if peers "
+                        f"legitimately stall longer)")
+                continue
+            if r == 0:
+                raise ConnectionError(
+                    f"connection to {who} closed while reading")
+            got += r
+            idle = 0.0
+    finally:
+        sock.settimeout(prev)
+
+
+def _recv_exact(sock: socket.socket, n: int, who: str = "peer",
+                hb=None) -> bytes:
+    buf = bytearray(n)
+    _recv_exact_into(sock, memoryview(buf), who, hb)
     return bytes(buf)
 
 
-def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
-    got = 0
-    n = len(view)
-    while got < n:
-        r = sock.recv_into(view[got:])
-        if r == 0:
-            raise ConnectionError("socket closed while reading")
-        got += r
-
-
 class Channel:
-    """One framed duplex connection (optionally HMAC-authenticated)."""
+    """One framed duplex connection (optionally HMAC-authenticated).
 
-    def __init__(self, sock: socket.socket, secret: bytes = b""):
+    ``peer`` labels the other end in every transport error ("rank 3
+    (10.0.0.7:4921)" beats "socket closed"); controllers overwrite it
+    with the peer's rank once the handshake reveals it. :meth:`arm`
+    attaches a liveness deadline to all subsequent recvs."""
+
+    def __init__(self, sock: socket.socket, secret: bytes = b"",
+                 peer: Optional[str] = None):
         self.sock = sock
         self.secret = secret
+        if peer is None:
+            try:
+                # AF_UNIX peers (socketpairs in tests) report a bare
+                # string, often empty — no host:port to name.
+                name = sock.getpeername()
+                if isinstance(name, tuple) and len(name) >= 2:
+                    peer = f"{name[0]}:{name[1]}"
+                else:
+                    peer = str(name) or "peer"
+            except OSError:
+                peer = "peer"
+        self.peer = peer
+        self._hb = None
         # Don't batch small frames; collectives are latency-sensitive.
         # (No-op on non-TCP sockets, e.g. AF_UNIX socketpairs in tests.)
         try:
             self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         except OSError:
             pass
+
+    def arm(self, timeout_s: float, interval_s: float,
+            on_idle: Optional[Callable[[], None]] = None) -> None:
+        """Enable the recv liveness deadline: total silence from the
+        peer for ``timeout_s`` fails the recv instead of blocking
+        forever. ``on_idle`` runs once per ``interval_s`` idle tick.
+        ``timeout_s <= 0`` disarms.
+
+        Also sets SO_RCVTIMEO and SO_SNDTIMEO on the raw socket:
+
+        * the native fanout (controller._NativeFanout) reads these
+          same fds with blocking recv(2) once poll() reports
+          readability, and a peer stalling MID-FRAME (header
+          delivered, body never arrives) would otherwise block that
+          read forever with the Python-level deadline unable to run;
+        * a wedged-but-alive peer that stops DRAINING fills the TCP
+          buffers and would block sendall/write_all forever — while
+          stuck in send, this rank can't run its own recv deadline or
+          fan an ABORT, so sends must be bounded too. The per-syscall
+          timeout only fires after ``timeout_s`` with zero buffer
+          progress; a live-but-slow reader keeps every send moving.
+
+        Python's own sliced recv path is unaffected (settimeout
+        switches the fd to non-blocking mode, where SO_RCVTIMEO is
+        inert)."""
+        if timeout_s and timeout_s > 0:
+            # Clamp the slice to HALF the deadline: on_idle is how a
+            # waiting sender beacons proof-of-life to ranks waiting on
+            # *it*, and it must fire at least twice per peer deadline
+            # window or an interval in (timeout/2, timeout] plus cycle
+            # skew false-aborts a healthy world (the PING gate and the
+            # native fanout slice cap enforce the same invariant).
+            half = timeout_s / 2.0
+            interval_s = min(interval_s, half) if interval_s > 0 \
+                else half
+            self._hb = (timeout_s, interval_s, on_idle)
+            self._set_kernel_timeouts(timeout_s)
+        else:
+            self._hb = None
+            self._set_kernel_timeouts(0.0)
+
+    def _set_kernel_timeouts(self, timeout_s: float) -> None:
+        sec = int(timeout_s)
+        usec = int((timeout_s - sec) * 1e6)
+        tv = struct.pack("ll", sec, usec)
+        for opt in (socket.SO_RCVTIMEO, socket.SO_SNDTIMEO):
+            try:
+                self.sock.setsockopt(socket.SOL_SOCKET, opt, tv)
+            except (OSError, struct.error):
+                pass  # exotic socket: Python-level deadline still works
 
     def send(self, payload, tag: int = 0) -> None:
         """``payload`` is any C-contiguous buffer (bytes, bytearray,
@@ -115,38 +219,43 @@ class Channel:
             self.sock.sendall(payload)
 
     def recv(self) -> Tuple[int, bytes]:
-        hdr = _recv_exact(self.sock, _HDR.size)
+        who, hb = self.peer, self._hb
+        hdr = _recv_exact(self.sock, _HDR.size, who, hb)
         n, tag = _HDR.unpack(hdr)
         if self.secret:
-            digest = _recv_exact(self.sock, _DIGEST_LEN)
-            payload = _recv_exact(self.sock, n)
+            digest = _recv_exact(self.sock, _DIGEST_LEN, who, hb)
+            payload = _recv_exact(self.sock, n, who, hb)
             expected = hmac.new(self.secret, bytes([tag]) + payload,
                                 hashlib.sha256).digest()
             if not hmac.compare_digest(digest, expected):
-                raise ConnectionError("HMAC authentication failed")
+                raise ConnectionError(
+                    f"HMAC authentication failed for frame from {who}")
             return tag, payload
-        payload = _recv_exact(self.sock, n)
+        payload = _recv_exact(self.sock, n, who, hb)
         return tag, payload
 
     def recv_into(self, buf) -> Tuple[int, int]:
         """Receive one frame directly into a writable buffer (zero-copy
         data-plane path; ops/ring.py). The frame must fit exactly or be
         smaller. Returns (tag, payload_nbytes)."""
-        hdr = _recv_exact(self.sock, _HDR.size)
+        who, hb = self.peer, self._hb
+        hdr = _recv_exact(self.sock, _HDR.size, who, hb)
         n, tag = _HDR.unpack(hdr)
         view = memoryview(as_byte_view(buf))
         if n > len(view):
             raise ConnectionError(
-                f"frame of {n} bytes overflows {len(view)}-byte buffer")
+                f"frame of {n} bytes from {who} overflows "
+                f"{len(view)}-byte buffer")
         if self.secret:
-            digest = _recv_exact(self.sock, _DIGEST_LEN)
-            _recv_exact_into(self.sock, view[:n])
+            digest = _recv_exact(self.sock, _DIGEST_LEN, who, hb)
+            _recv_exact_into(self.sock, view[:n], who, hb)
             h = hmac.new(self.secret, bytes((tag,)), hashlib.sha256)
             h.update(view[:n])
             if not hmac.compare_digest(digest, h.digest()):
-                raise ConnectionError("HMAC authentication failed")
+                raise ConnectionError(
+                    f"HMAC authentication failed for frame from {who}")
         else:
-            _recv_exact_into(self.sock, view[:n])
+            _recv_exact_into(self.sock, view[:n], who, hb)
         return tag, n
 
     def close(self) -> None:
@@ -157,30 +266,51 @@ class Channel:
         self.sock.close()
 
 
+def backoff_delays(base: float = 0.05, cap: float = 1.0,
+                   factor: float = 2.0, jitter: float = 0.25,
+                   rng: Optional[Callable[[], float]] = None
+                   ) -> Iterator[float]:
+    """Capped exponential backoff with multiplicative jitter: ``base``,
+    ``base*factor``, ... clamped to ``cap``, each scaled by a uniform
+    factor in [1-jitter, 1+jitter] so a herd of ranks retrying against
+    one listener (world startup, ring rendezvous) never stampedes in
+    lockstep. ``rng`` is injectable for deterministic tests."""
+    if rng is None:
+        rng = random.random
+    delay = base
+    while True:
+        yield min(cap, delay) * (1.0 + jitter * (2.0 * rng() - 1.0))
+        delay = min(cap, delay * factor)
+
+
 def connect(addr: str, port: int, secret: bytes = b"",
             timeout: Optional[float] = None,
             retry_deadline: Optional[float] = None) -> Channel:
-    """Connect with retries until ``retry_deadline`` (seconds of budget),
-    mirroring the reference client's probing/retry loop
-    (reference: run/common/util/network.py:152-246)."""
-    import time
+    """Connect with exponential-backoff retries until ``retry_deadline``
+    (seconds of budget), mirroring the reference client's probing/retry
+    loop (reference: run/common/util/network.py:152-246)."""
     deadline = (time.monotonic() + retry_deadline
                 if retry_deadline is not None else None)
     last_err: Optional[Exception] = None
+    delays = backoff_delays()
+    attempts = 0
     while True:
         try:
+            attempts += 1
             sock = socket.create_connection((addr, port), timeout=timeout)
             # The connect timeout must not linger as a recv timeout: the
             # steady-state worker blocks in recv() for a whole cycle, which
             # can legitimately exceed it (slow rank, long XLA compile).
             sock.settimeout(None)
-            return Channel(sock, secret)
+            return Channel(sock, secret, peer=f"{addr}:{port}")
         except OSError as e:
             last_err = e
-            if deadline is None or time.monotonic() >= deadline:
+            now = time.monotonic()
+            if deadline is None or now >= deadline:
                 raise ConnectionError(
-                    f"Could not connect to {addr}:{port}: {last_err}")
-            time.sleep(0.05)
+                    f"Could not connect to {addr}:{port} after "
+                    f"{attempts} attempt(s): {last_err}")
+            time.sleep(min(next(delays), max(0.0, deadline - now)))
 
 
 def listen(port: int = 0, host: str = "") -> socket.socket:

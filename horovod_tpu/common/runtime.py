@@ -24,6 +24,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from horovod_tpu.common import faults
 from horovod_tpu.common import logging as hlog
 from horovod_tpu.common import wire
 from horovod_tpu.common.config import Config
@@ -36,7 +37,8 @@ from horovod_tpu.common.message import (
     ResponseType,
 )
 from horovod_tpu.common.status import (
-    DUPLICATE_NAME_ERROR_FMT, SHUT_DOWN_ERROR, Status,
+    DUPLICATE_NAME_ERROR_FMT, SHUT_DOWN_ERROR, Status, WorldAbortedError,
+    world_abort_message,
 )
 from horovod_tpu.common.tensor_table import (
     HandleManager, TensorTable, TensorTableEntry,
@@ -65,7 +67,6 @@ class Runtime:
             self.timeline = create_timeline(config.timeline_path,
                                             config.timeline_mark_cycles)
         op_manager.attach_timeline(self.timeline)
-        self._message_table = MessageTable() if controller.rank == 0 else None
         self._dtypes: Dict[str, DataType] = {}
         # name -> elements per dim-0 row, for allgather fusion byte
         # accounting (reference: TotalByteSizeOfAllgatherOutput).
@@ -75,6 +76,11 @@ class Runtime:
             warning_time=config.stall_check_time_seconds,
             shutdown_time=config.stall_shutdown_time_seconds,
             disabled=config.stall_check_disable)
+        # A completed negotiation clears its stall-warning record so a
+        # RECURRING tensor name that stalls again warns again.
+        self._message_table = MessageTable(
+            on_remove=self._stall.tensor_completed) \
+            if controller.rank == 0 else None
         # Async completion: backends that return InProgress complete on
         # detached finalizer threads while this loop keeps negotiating
         # (reference: cuda_operations.cc:148-179).
@@ -87,6 +93,14 @@ class Runtime:
         self._done = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[Exception] = None
+        # (origin_rank, cause) once the world has aborted: handles that
+        # were in flight or are enqueued afterwards fail with a
+        # structured WorldAbortedError instead of a generic shutdown.
+        self._abort_info: Optional[tuple] = None
+        # Lifetime count of executed responses (fault-injection op
+        # triggers key off it to land failures squarely mid-collective).
+        self._op_count = 0
+        faults.load_env()
         # Autotune plumbing: bytes reduced this cycle.
         self._cycle_bytes = 0
         # Monotone id for async-nestable timeline batches.
@@ -124,12 +138,21 @@ class Runtime:
         return (self._thread is not None and self._thread.is_alive()
                 and not self._done.is_set())
 
+    def _terminal_status(self) -> Status:
+        """Status for work that can no longer run: a structured abort
+        (naming the failed rank) when the world was torn down by the
+        fail-fast protocol, the plain shutdown error otherwise."""
+        if self._abort_info is not None:
+            origin, cause = self._abort_info
+            return Status.WorldAborted(origin, cause)
+        return Status.Aborted(SHUT_DOWN_ERROR)
+
     # -- enqueue APIs (reference: operations.cc:1430-1549) ---------------
     def enqueue(self, request_type: RequestType, entry: TensorTableEntry,
                 dtype: DataType, shape, prescale: float = 1.0,
                 postscale: float = 1.0) -> Status:
         if self._done.is_set() or self._shutdown_requested.is_set():
-            return Status.Aborted(SHUT_DOWN_ERROR)
+            return self._terminal_status()
         req = Request(request_rank=self.controller.rank,
                       request_type=request_type,
                       tensor_type=dtype,
@@ -149,7 +172,7 @@ class Runtime:
             # shutdown fan-out may have missed this entry — reclaim it so
             # its handle cannot hang forever.
             if self.tensor_table.pop_entry_if_present(entry.tensor_name):
-                return Status.Aborted(SHUT_DOWN_ERROR)
+                return self._terminal_status()
         self._wake.set()  # snap an idle-backed-off loop awake
         return Status.OK()
 
@@ -165,7 +188,7 @@ class Runtime:
         Response under the threshold. ``items`` is a list of
         (entry, dtype, shape)."""
         if self._done.is_set() or self._shutdown_requested.is_set():
-            return Status.Aborted(SHUT_DOWN_ERROR)
+            return self._terminal_status()
         pairs = []
         for entry, dtype, shape in items:
             req = Request(request_rank=self.controller.rank,
@@ -192,16 +215,83 @@ class Runtime:
             for entry, _ in pairs:
                 if self.tensor_table.pop_entry_if_present(
                         entry.tensor_name) and entry.callback:
-                    entry.callback(Status.Aborted(SHUT_DOWN_ERROR))
+                    entry.callback(self._terminal_status())
         self._wake.set()
         return Status.OK()
+
+    def _resolve_abort(self, origin: int, cause: str) -> tuple:
+        """A blame inferred from an anonymous transport error can race
+        the AUTHORITATIVE notice from the rank that actually detected
+        the failure — its teardown closes channels, which peers see as
+        a second, misattributable failure (a ring survivor names its
+        dead neighbor and collapses; this rank only sees the
+        survivor's close). Sweep the control plane for a
+        queued/just-arriving ABORT and defer to it — the whole world
+        then converges on one origin. Failure path only; adds nothing
+        to healthy cycles."""
+        try:
+            notice = self.controller.drain_abort_notice(0.25)
+        except Exception:
+            notice = None
+        return notice if notice is not None else (origin, cause)
+
+    def _data_plane_abort(self, entries, origin: int,
+                          cause: str) -> WorldAbortedError:
+        """Fail a mid-collective batch as a world abort: resolve the
+        origin against the control plane FIRST (the callbacks complete
+        user-visible handles — they must carry the converged origin),
+        fire the callbacks, and return the error for the caller to
+        raise into the loop-level handler."""
+        origin, cause = self._resolve_abort(origin, cause)
+        status = Status.WorldAborted(origin, cause)
+        for en in entries:
+            if en.callback:
+                en.callback(status)
+        err = WorldAbortedError(world_abort_message(origin, cause),
+                                origin_rank=origin, cause=cause)
+        err.resolved = True  # _fail_world: don't re-drain
+        return err
+
+    def _fail_world(self, origin: int, cause: str,
+                    resolved: bool = False) -> None:
+        """Record the world abort and fan the notice to every
+        reachable peer (see _resolve_abort for why an unresolved blame
+        is checked against the control plane before committing)."""
+        if not resolved:
+            origin, cause = self._resolve_abort(origin, cause)
+        self._error = WorldAbortedError(
+            world_abort_message(origin, cause), origin_rank=origin,
+            cause=cause)
+        self._abort_info = (origin, cause)
+        hlog.error(f"horovod_tpu world aborted: {self._error}",
+                   rank=self.controller.rank)
+        try:
+            self.controller.abort(origin, cause)
+        except Exception:
+            pass
 
     # -- the loop --------------------------------------------------------
     def _background_loop(self) -> None:
         try:
             while self._run_loop_once():
                 pass
-        except Exception as e:  # transport failure, backend bug, ...
+        except WorldAbortedError as e:
+            # Either received over the wire (a peer initiated the
+            # abort) or raised locally (we detected the failure). Fan
+            # the notice to every peer we can still reach — relays are
+            # idempotent, so re-fanning a received abort is harmless —
+            # then fail everything in flight with the structured error.
+            # The BARE cause travels/persists, so each hop wraps the
+            # origin banner exactly once.
+            self._fail_world(e.origin_rank, getattr(e, "cause", str(e)),
+                             resolved=getattr(e, "resolved", False))
+        except (ConnectionError, OSError, TimeoutError) as e:
+            # Transport failure nobody upstream could name: this rank
+            # is the origin as far as the rest of the world knows.
+            rank = self.controller.rank
+            self._fail_world(rank,
+                             f"transport failure on rank {rank}: {e}")
+        except Exception as e:  # backend bug, ...
             self._error = e
             hlog.error(f"horovod_tpu background loop failed: {e!r}",
                        rank=self.controller.rank)
@@ -212,9 +302,10 @@ class Runtime:
             # issued (reference: operations.cc:898-913).
             if self.finalizer is not None:
                 self.finalizer.drain()
+            terminal = self._terminal_status()
             for entry in self.tensor_table.pop_all():
                 if entry.callback:
-                    entry.callback(Status.Aborted(SHUT_DOWN_ERROR))
+                    entry.callback(terminal)
             self.timeline.shutdown()
             self.op_manager.close()
             try:
@@ -229,6 +320,7 @@ class Runtime:
         (reference: operations.cc:986-1338)."""
         t0 = time.monotonic()
         self._cycle_count += 1
+        faults.tick_cycle(self, self._cycle_count)
         self.timeline.mark_cycle_start()
 
         requests = self.tensor_table.pop_messages()
@@ -272,9 +364,18 @@ class Runtime:
         sleep_s = cycle_time_ms / 1000.0 - elapsed
         backoff_ms = self.config.idle_backoff_ms
         if backoff_ms > 0 and self._idle_cycles > self._IDLE_GRACE:
+            backoff_s = backoff_ms / 1000.0
+            if self.config.heartbeat_timeout_s > 0:
+                # A sleeping rank sends nothing; its only proof of
+                # life is the next cycle's request frame. Cap the
+                # backoff under the heartbeat deadline or an idle
+                # world's waiting peers would declare the sleeper
+                # dead (the two knobs are set independently).
+                backoff_s = min(backoff_s,
+                                self.config.heartbeat_timeout_s / 2.0)
             ramp = (cycle_time_ms / 1000.0
                     * (self._idle_cycles - self._IDLE_GRACE))
-            sleep_s = max(sleep_s, min(backoff_ms / 1000.0, ramp))
+            sleep_s = max(sleep_s, min(backoff_s, ramp))
         if sleep_s > 0:
             # Wake early on shutdown OR new local work (enqueue sets
             # _wake) so backoff never adds submit latency.
@@ -315,7 +416,33 @@ class Runtime:
 
         if self._stall.should_check():
             if self._stall.check(table):
-                shutdown = True
+                # The stall-shutdown threshold fires the fail-fast
+                # abort so every rank gets a structured error naming
+                # the condition, instead of the silent clean-shutdown
+                # fan-out the reference performs (operations.cc:609).
+                # Blame the stalled rank(s), not the healthy
+                # coordinator observing them: the missing ranks on the
+                # OLDEST pending tensor are the culprits. origin -1
+                # ("unknown rank") only if the table emptied racily.
+                origin, missing_note = -1, ""
+                pending = sorted(table.pending(), key=lambda p: -p[1])
+                if pending:
+                    name, _, reported = pending[0]
+                    missing = [r for r in range(size)
+                               if r not in set(reported)]
+                    if missing:
+                        origin = min(missing)
+                        missing_note = (f" (tensor '{name}' never "
+                                        f"submitted by ranks "
+                                        f"{missing})")
+                cause = ("stall shutdown threshold "
+                         f"({self._stall.shutdown_time:g}s) exceeded: "
+                         "one or more tensors were never submitted by "
+                         "every rank (see coordinator stall warnings "
+                         f"for names and missing ranks){missing_note}")
+                raise WorldAbortedError(world_abort_message(origin,
+                                                           cause),
+                                        origin_rank=origin, cause=cause)
 
         resp_list = ResponseList(fused, shutdown=shutdown)
         if self.parameter_manager is not None:
@@ -372,6 +499,8 @@ class Runtime:
         """Execute each agreed response and fire callbacks
         (reference: operations.cc:450-539 PerformOperation)."""
         for response in resp_list.responses:
+            self._op_count += 1
+            faults.tick_op(self, self._op_count)
             entries: List[TensorTableEntry] = []
             for name in response.tensor_names:
                 entry = self.tensor_table.get_entry(name)
@@ -437,6 +566,28 @@ class Runtime:
                 self.timeline.activity_start_all(names, ACT_COLLECTIVE)
             try:
                 status = self.op_manager.execute(entries, response)
+            except WorldAbortedError as e:
+                # An abort notice surfaced mid-collective (e.g. the
+                # controller channel died during a data-plane
+                # gather): fail this batch with the structured status,
+                # then let the loop-level handler fan the abort. The
+                # origin is resolved against any queued control-plane
+                # notice BEFORE the callbacks fire — these complete
+                # user-visible handles, and a data-plane blame can
+                # misattribute a cascading teardown (see _fail_world).
+                raise self._data_plane_abort(
+                    entries, e.origin_rank,
+                    getattr(e, "cause", str(e))) from e
+            except (ConnectionError, OSError, TimeoutError) as e:
+                # Data-plane transport failure (dead ring neighbor,
+                # severed link): this is a world-level event, not a
+                # per-batch soft error — a lone UnknownError here
+                # would leave every peer blocked mid-collective.
+                rank = self.controller.rank
+                raise self._data_plane_abort(
+                    entries, rank,
+                    f"data-plane failure during {op_name} on "
+                    f"rank {rank}: {e}") from e
             except Exception as e:
                 status = Status.UnknownError(
                     f"collective execution failed: {e!r}")

@@ -9,6 +9,7 @@ through enqueue callbacks and the handle manager.
 from __future__ import annotations
 
 import enum
+from typing import Optional
 
 
 class StatusType(enum.IntEnum):
@@ -21,11 +22,16 @@ class StatusType(enum.IntEnum):
 
 
 class Status:
-    __slots__ = ("type", "reason")
+    __slots__ = ("type", "reason", "aborted_by")
 
-    def __init__(self, type_: StatusType = StatusType.OK, reason: str = ""):
+    def __init__(self, type_: StatusType = StatusType.OK, reason: str = "",
+                 aborted_by: "Optional[int]" = None):
         self.type = type_
         self.reason = reason
+        # Global rank the world abort originated from (None for plain
+        # shutdowns) — lets handle APIs raise WorldAbortedError with
+        # the failed rank attached instead of a generic internal error.
+        self.aborted_by = aborted_by
 
     @staticmethod
     def OK() -> "Status":
@@ -42,6 +48,12 @@ class Status:
     @staticmethod
     def Aborted(msg: str) -> "Status":
         return Status(StatusType.ABORTED, msg)
+
+    @staticmethod
+    def WorldAborted(origin_rank: int, cause: str) -> "Status":
+        return Status(StatusType.ABORTED,
+                      world_abort_message(origin_rank, cause),
+                      aborted_by=origin_rank)
 
     @staticmethod
     def InvalidArgument(msg: str) -> "Status":
@@ -65,6 +77,28 @@ class HorovodInternalError(RuntimeError):
     """Raised to user code when a collective fails (coordinator ERROR
     response or shutdown; reference: message.h Response::ERROR and
     operations.cc:898-913 SHUT_DOWN_ERROR fan-out)."""
+
+
+def world_abort_message(origin_rank: int, cause: str) -> str:
+    origin = (f"rank {origin_rank}" if origin_rank is not None
+              and origin_rank >= 0 else "unknown rank")
+    return f"Horovod world aborted (origin: {origin}): {cause}"
+
+
+class WorldAbortedError(HorovodInternalError):
+    """The world was torn down by the fail-fast abort protocol: some
+    rank died, a transport failed, or the stall-shutdown threshold
+    fired, and the coordinator fanned an ABORT to every survivor.
+    Subclasses HorovodInternalError so existing error handling keeps
+    working; carries the originating rank and bare cause so survivors
+    can log or react to *which* peer failed — and so relaying the
+    abort re-wraps the cause exactly once, not per hop."""
+
+    def __init__(self, message: str, origin_rank: int = -1,
+                 cause: "Optional[str]" = None):
+        super().__init__(message)
+        self.origin_rank = origin_rank
+        self.cause = cause if cause is not None else message
 
 
 SHUT_DOWN_ERROR = (

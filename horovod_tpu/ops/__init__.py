@@ -24,7 +24,9 @@ from horovod_tpu.common import basics
 from horovod_tpu.common.message import (
     RequestType, numpy_dtype_to_datatype,
 )
-from horovod_tpu.common.status import HorovodInternalError, Status
+from horovod_tpu.common.status import (
+    HorovodInternalError, Status, WorldAbortedError,
+)
 from horovod_tpu.common.tensor_table import TensorTableEntry
 
 # Reduction op constants (modern-horovod compatible; the reference's
@@ -102,11 +104,16 @@ def poll(handle: int) -> bool:
 
 def synchronize(handle: int) -> Any:
     """Block until completion; raise on error; return the output tensor
-    (reference: horovod/torch/mpi_ops.py synchronize + WaitAndClear)."""
+    (reference: horovod/torch/mpi_ops.py synchronize + WaitAndClear).
+    A fail-fast world abort surfaces as WorldAbortedError (a
+    HorovodInternalError subclass) carrying the originating rank."""
     rt = basics.runtime()
     status = rt.handle_manager.wait(handle)
     output = rt.handle_manager.release(handle)
     if not status.ok():
+        if status.aborted_by is not None:
+            raise WorldAbortedError(status.reason,
+                                    origin_rank=status.aborted_by)
         raise HorovodInternalError(status.reason)
     return output
 

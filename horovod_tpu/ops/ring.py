@@ -49,6 +49,19 @@ class Ring:
         self._next = next_ch
         self._prev = prev_ch
 
+    def _neighbor_error(self, neighbor: int, e: Exception) -> Exception:
+        """A dead ring link is a world-level failure whose origin is
+        the NEIGHBOR, not this (healthy, detecting) rank — return the
+        structured abort so the runtime fans the right origin_rank
+        instead of defaulting to the detector."""
+        from horovod_tpu.common.status import (
+            WorldAbortedError, world_abort_message,
+        )
+        cause = (f"ring link to rank {neighbor} failed on "
+                 f"rank {self._rank}: {e}")
+        return WorldAbortedError(world_abort_message(neighbor, cause),
+                                 origin_rank=neighbor, cause=cause)
+
     def _exchange_into(self, send_arr: np.ndarray,
                        recv_arr: np.ndarray) -> None:
         """Full-duplex step: ship ``send_arr`` to the next rank while
@@ -66,10 +79,17 @@ class Ring:
         t.start()
         try:
             tag, nbytes = self._prev.recv_into(recv_arr)
+        except (ConnectionError, OSError, TimeoutError) as e:
+            raise self._neighbor_error((self._rank - 1) % self._size,
+                                       e) from e
         finally:
             t.join()
         if err:
-            raise err[0]
+            e = err[0]
+            if isinstance(e, (ConnectionError, OSError, TimeoutError)):
+                raise self._neighbor_error(
+                    (self._rank + 1) % self._size, e) from e
+            raise e
         if tag != _TAG_RING_DATA:
             raise ConnectionError(f"ring: expected data frame, got {tag}")
         if nbytes != recv_arr.nbytes:
@@ -133,10 +153,19 @@ class Ring:
 
 
 def establish(controller, secret: bytes = b"",
-              timeout: float = 30.0) -> Optional[Ring]:
+              timeout: float = 30.0, hb=None) -> Optional[Ring]:
     """One-time ring rendezvous through the control plane. Must be
     called at the same negotiated-response position on every rank.
-    Returns None (on every rank, by agreement) if any rank fails."""
+    Returns None (on every rank, by agreement) if any rank fails.
+
+    ``hb`` is an optional ``(timeout_s, interval_s)`` liveness deadline
+    armed on both ring channels: a neighbor that goes silent mid-
+    exchange (host loss — no FIN/RST ever arrives) fails the transfer
+    within the bound instead of blocking the background loop forever.
+    The deadline resets on every received byte, so a large chunk
+    trickling over a slow link never false-positives, and arming costs
+    one extra poll(2) per chunk recv — noise against the memcpy+wire
+    cost of the data-plane payloads that ride the ring."""
     rank, size = controller.rank, controller.size
 
     # Phase A — advertise my data port. This control-plane exchange
@@ -182,6 +211,7 @@ def establish(controller, secret: bytes = b"",
                 ip = getattr(controller, "coordinator_addr", "127.0.0.1")
             next_ch = network.connect(ip, nport, secret, timeout=timeout,
                                       retry_deadline=timeout)
+            next_ch.peer = f"ring rank {nxt} ({next_ch.peer})"
             next_ch.send(json.dumps({"rank": rank}).encode(),
                          _TAG_RING_HELLO)
             sock, _ = srv.accept()
@@ -195,6 +225,11 @@ def establish(controller, secret: bytes = b"",
                 raise ConnectionError(
                     f"ring neighbor mismatch: expected "
                     f"{(rank - 1) % size}, got {prev_rank}")
+            prev_ch.peer = f"ring rank {prev_rank} ({prev_ch.peer})"
+            if hb is not None:
+                hb_timeout, hb_interval = hb
+                next_ch.arm(hb_timeout, hb_interval)
+                prev_ch.arm(hb_timeout, hb_interval)
             ring = Ring(rank, size, next_ch, prev_ch)
             local_ok = True
         except Exception as e:

@@ -159,11 +159,17 @@ class SocketBackend(CollectiveBackend):
     def __init__(self, controller: Controller, secret: bytes = b"",
                  config=None):
         from horovod_tpu.common.config import Config
+        cfg = config or Config()
         self._ctl = controller
         self._secret = secret
         self._ring = None
         self._ring_tried = False
-        self._ring_threshold = (config or Config()).ring_threshold_bytes
+        self._ring_threshold = cfg.ring_threshold_bytes
+        # Liveness deadline for the worker↔worker ring channels (same
+        # knobs as the control plane; None when detection is disabled).
+        self._ring_hb = ((cfg.heartbeat_timeout_s,
+                          cfg.heartbeat_interval_s)
+                         if cfg.heartbeat_timeout_s > 0 else None)
 
     def enabled(self, entries, response) -> bool:
         return self._ctl.size > 1
@@ -183,7 +189,8 @@ class SocketBackend(CollectiveBackend):
         if not self._ring_tried:
             self._ring_tried = True
             from horovod_tpu.ops import ring as _ring
-            self._ring = _ring.establish(self._ctl, self._secret)
+            self._ring = _ring.establish(self._ctl, self._secret,
+                                         hb=self._ring_hb)
         return self._ring
 
     # -- allreduce -------------------------------------------------------

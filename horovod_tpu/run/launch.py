@@ -161,6 +161,56 @@ def _free_port() -> int:
     return port
 
 
+def abort_grace_seconds() -> float:
+    """Seconds the launcher waits, after a rank dies, for survivors to
+    fail themselves through the coordinator-mediated abort protocol
+    (heartbeats + ABORT fan-out in common/controller.py) before the
+    mpirun-style hard kill. The grace turns "launcher murdered me" into
+    a clean Python-level WorldAbortedError in every surviving rank's
+    training script; the kill stays as the backstop for survivors too
+    wedged to run the protocol."""
+    try:
+        return float(os.environ.get("HOROVOD_TPU_ABORT_GRACE", "5"))
+    except ValueError:
+        return 5.0
+
+
+def reap_with_grace(procs) -> int:
+    """Wait for every child; on the first nonzero exit, give the
+    survivors ``abort_grace_seconds()`` to fail themselves through the
+    in-band ABORT protocol, then SIGTERM the stragglers (mpirun-style
+    kill-on-first-exit, softened). Polls only these children — a bare
+    ``os.wait()`` would reap unrelated subprocesses of an embedding
+    process. Returns the FIRST nonzero returncode, preserving signal
+    deaths (negative values) — never folds them back to success."""
+    exit_code = 0
+    pending = list(procs)
+    grace_deadline = None
+    killed = False
+    while pending:
+        for p in list(pending):
+            rc = p.poll()
+            if rc is None:
+                continue
+            pending.remove(p)
+            if rc != 0:
+                exit_code = exit_code or rc
+                if grace_deadline is None:
+                    grace_deadline = (time.monotonic()
+                                      + abort_grace_seconds())
+        if pending and not killed and grace_deadline is not None \
+                and time.monotonic() >= grace_deadline:
+            killed = True
+            for q in pending:
+                try:
+                    q.terminate()
+                except OSError:
+                    pass
+        if pending:
+            time.sleep(0.05)
+    return exit_code
+
+
 def run_local(np_: int, command: List[str],
               env: Optional[Dict[str, str]] = None,
               start_timeout: float = 30.0) -> int:
@@ -182,25 +232,11 @@ def run_local(np_: int, command: List[str],
 
     exit_code = 0
     try:
-        # Poll our own children only — a bare os.wait() would reap
-        # unrelated subprocesses of the embedding process.
-        pending = list(procs)
-        while pending:
-            still = []
-            for p in pending:
-                rc = p.poll()
-                if rc is None:
-                    still.append(p)
-                elif rc != 0:
-                    exit_code = exit_code or rc
-                    # One rank failing → tear the world down like
-                    # mpirun does (kill-on-first-exit).
-                    for q in still + [x for x in pending
-                                      if x is not p and x.poll() is None]:
-                        q.terminate()
-            pending = [p for p in still if p.poll() is None]
-            if pending:
-                time.sleep(0.05)
+        # One rank failing still tears the world down like mpirun
+        # does, but only after the abort-propagation grace window: the
+        # in-band ABORT protocol usually fails the survivors cleanly
+        # first, so they exit with a structured error, not a SIGTERM.
+        exit_code = reap_with_grace(procs)
     except KeyboardInterrupt:
         for p in procs:
             p.terminate()
@@ -272,7 +308,9 @@ def run_multihost(hosts: List[Tuple[str, int]], command: List[str],
         controller = driver.controller_endpoint()
         driver.launch(assignments, command, forward_env, controller)
         codes = driver.wait_for_exit()
-        return max(codes)
+        # First nonzero wins: max() would fold a signal death
+        # (negative returncode) back to 0 when another host is clean.
+        return next((c for c in codes if c != 0), 0)
     finally:
         driver.shutdown()
         for p in spawned:

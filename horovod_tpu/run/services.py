@@ -192,7 +192,8 @@ class DriverService:
             })
 
     def wait_for_exit(self, timeout: Optional[float] = None) -> List[int]:
-        """Collect per-host exit codes (max over local processes)."""
+        """Collect per-host exit codes (first nonzero local process,
+        signal deaths preserved as negatives)."""
         codes = []
         for i in range(self._num_hosts):
             msg = self._tasks[i].recv()
@@ -297,11 +298,12 @@ class TaskServer:
             # The child owns a duplicate now; drop ours.
             self._reserved.close()
             self._reserved = None
-        code = 0
-        for p in procs:
-            p.wait()
-            code = max(code, p.returncode)
-        return code
+        # Same teardown contract as run_local: a local rank dying
+        # nonzero starts the abort-propagation grace window — the
+        # in-band ABORT usually fails this host's survivors cleanly —
+        # then the remainder is hard-killed as a backstop.
+        from horovod_tpu.run.launch import reap_with_grace
+        return reap_with_grace(procs)
 
 
 def task_main() -> None:

@@ -823,6 +823,167 @@ def scenario_coordinator_death(hvd, rank, size):
     hvd.shutdown()
 
 
+def _await_world_abort(hvd, rank, expect_origin, deadline_s, name):
+    """Drive allreduces until the fail-fast protocol surfaces
+    :class:`WorldAbortedError`; assert it names the failed rank and
+    lands within the detection deadline, then prove that a
+    subsequently-enqueued handle fails the same structured way.
+
+    No external watchdog does the unblocking here: if the in-band
+    heartbeat/abort machinery regresses, the blocked collective trips
+    the harness alarm guard and the test fails with thread stacks."""
+    import time
+    from horovod_tpu.common.status import WorldAbortedError
+
+    t0 = time.monotonic()
+    i = 0
+    while True:
+        try:
+            hvd.allreduce(np.ones(64, np.float32), average=False,
+                          name=f"{name}/{i}")
+        except WorldAbortedError as e:
+            elapsed = time.monotonic() - t0
+            assert e.origin_rank == expect_origin, (
+                f"rank {rank}: abort blamed rank {e.origin_rank}, "
+                f"expected {expect_origin}: {e}")
+            assert f"rank {expect_origin}" in str(e), str(e)
+            assert elapsed < deadline_s, (
+                f"rank {rank}: detection took {elapsed:.1f}s "
+                f"(deadline {deadline_s}s)")
+            break
+        i += 1
+        assert time.monotonic() - t0 < deadline_s, (
+            f"rank {rank}: collectives kept succeeding for "
+            f"{deadline_s}s after the fault")
+    # handles enqueued AFTER the world died must fail structurally
+    # too — never hang, never a bare UnknownError
+    try:
+        hvd.allreduce(np.ones(4, np.float32), average=False,
+                      name=f"{name}/post")
+        raise AssertionError("enqueue after world abort must fail")
+    except WorldAbortedError as e:
+        assert e.origin_rank == expect_origin, str(e)
+    hvd.shutdown()  # stays idempotent after the world collapsed
+
+
+def scenario_abort_sigkill_leaf(hvd, rank, size):
+    """SIGKILL a non-coordinator rank squarely mid-allreduce (fault
+    injection lands it just before that rank executes its 3rd
+    negotiated response, while every peer is already inside the same
+    collective): all survivors — including the coordinator — must
+    raise WorldAbortedError naming the dead rank within the
+    detection deadline. HOROVOD_FAULT_SPEC is set by the pytest
+    wrapper (tests/test_multiprocess.py)."""
+    victim = 1
+    deadline = float(os.environ["HOROVOD_HEARTBEAT_TIMEOUT"]) + 12.0
+    _await_world_abort(hvd, rank, victim, deadline, "sk.leaf")
+
+
+def scenario_abort_sigkill_local_root(hvd, rank, size):
+    """SIGKILL a LOCAL ROOT of the hierarchical control tier
+    mid-collective: its leaves lose their upward relay, the
+    coordinator loses that host's aggregate channel, and the abort
+    must reach every survivor at every tier of the tree."""
+    from horovod_tpu.common import basics as _b
+    topo = _b.runtime().controller.topology
+    assert topo.cross_size > 1, "scenario expects a multihost topology"
+    victim = size // 2  # first rank of the second fake host = its root
+    assert topo.local_roots[1] == victim
+    deadline = float(os.environ["HOROVOD_HEARTBEAT_TIMEOUT"]) + 12.0
+    _await_world_abort(hvd, rank, victim, deadline, "sk.root")
+
+
+def scenario_abort_sigkill_coordinator(hvd, rank, size):
+    """SIGKILL the coordinator (rank 0) mid-collective — the worst
+    case: every worker's control channel dies at once, and there is no
+    coordinator left to fan the ABORT. Workers must each detect the
+    dead upward channel themselves and fail with WorldAbortedError
+    naming rank 0."""
+    deadline = float(os.environ["HOROVOD_HEARTBEAT_TIMEOUT"]) + 12.0
+    _await_world_abort(hvd, rank, 0, deadline, "sk.coord")
+
+
+def scenario_abort_heartbeat_hang(hvd, rank, size):
+    """A rank that goes SILENT without dying (SIGSTOP-like wedge, host
+    network loss: the kernel never sends FIN/RST, so TCP errors never
+    fire) is detectable ONLY by the heartbeat recv deadline. Fault
+    injection wedges rank 1's background loop; survivors must abort
+    within HOROVOD_HEARTBEAT_TIMEOUT + slack, naming rank 1."""
+    import time
+    from horovod_tpu.common.status import HorovodInternalError
+
+    victim = 1
+    hb_timeout = float(os.environ["HOROVOD_HEARTBEAT_TIMEOUT"])
+    if rank == victim:
+        # the wedged rank unblocks when its hang elapses, then finds
+        # the world gone — any structured internal error is acceptable
+        # on the faulty rank itself (it may blame the coordinator,
+        # whose channel it finds dead on wake-up)
+        try:
+            while True:
+                hvd.allreduce(np.ones(64, np.float32), average=False,
+                              name="hb.hang")
+        except HorovodInternalError:
+            pass
+        hvd.shutdown()
+        return
+    t0 = time.monotonic()
+    _await_world_abort(hvd, rank, victim, hb_timeout + 15.0, "hb.hang")
+    # the point of the heartbeat: detection is BOUNDED by the knob,
+    # not by the 8 s wedge ending or TCP keepalive (hours)
+    assert time.monotonic() - t0 < hb_timeout + 15.0
+
+
+def scenario_abort_sigkill_ring(hvd, rank, size):
+    """SIGKILL a rank while the RING data plane is active (threshold
+    lowered so these payloads ride the 2-phase ring): the survivor
+    whose ring link dies must blame the dead NEIGHBOR, not itself,
+    and the abort must fan to everyone."""
+    victim = 1
+    deadline = float(os.environ["HOROVOD_HEARTBEAT_TIMEOUT"]) + 12.0
+    import time
+    from horovod_tpu.common.status import WorldAbortedError
+
+    t0 = time.monotonic()
+    i = 0
+    while True:
+        try:
+            # over HOROVOD_TPU_RING_THRESHOLD (1024) -> ring path
+            hvd.allreduce(np.ones(50_000, np.float64), average=False,
+                          name=f"rk/{i}")
+        except WorldAbortedError as e:
+            assert e.origin_rank == victim, (rank, e.origin_rank, str(e))
+            assert time.monotonic() - t0 < deadline
+            break
+        i += 1
+        assert time.monotonic() - t0 < deadline
+    hvd.shutdown()
+
+
+def scenario_abort_severed_link(hvd, rank, size):
+    """Fault-injected link severance (abrupt close of rank 1's upward
+    control channel, process still alive): both sides of the cut must
+    converge on a world abort — the coordinator names the peer whose
+    channel died; the severed rank finds its own channel closed."""
+    from horovod_tpu.common.status import HorovodInternalError
+
+    victim = 1
+    deadline = float(os.environ["HOROVOD_HEARTBEAT_TIMEOUT"]) + 12.0
+    if rank == victim:
+        # After severing its own upward channel, this rank's next
+        # control exchange fails; it blames its upward peer (rank 0)
+        # since a cut wire is indistinguishable from a dead peer.
+        try:
+            while True:
+                hvd.allreduce(np.ones(64, np.float32), average=False,
+                              name="sever")
+        except HorovodInternalError:
+            pass
+        hvd.shutdown()
+        return
+    _await_world_abort(hvd, rank, victim, deadline, "sever")
+
+
 def scenario_subset_world(hvd, rank, size):
     """hvd.init(comm=[1, 2]) on a 3-process launch: ranks 1 and 2 form
     a 2-rank sub-world (renumbered 0 and 1, rank 1 hosting the
@@ -2152,6 +2313,15 @@ def scenario_xla_hierarchical_allgather(hvd_mod, rank, size):
 def main():
     scenario, rank, size, port = (sys.argv[1], int(sys.argv[2]),
                                   int(sys.argv[3]), int(sys.argv[4]))
+    # Hard in-process deadline (set by run_scenario slightly under its
+    # subprocess timeout): a deadlocked rank dumps every thread's stack
+    # and exits nonzero, so a regression that reintroduces a hang fails
+    # fast WITH a diagnosis instead of eating the tier-1 time budget
+    # and reporting only "timed out".
+    deadline = float(os.environ.get("HOROVOD_TEST_DEADLINE", "0"))
+    if deadline > 0:
+        import faulthandler
+        faulthandler.dump_traceback_later(deadline, exit=True)
     os.environ["HOROVOD_RANK"] = str(rank)
     os.environ["HOROVOD_SIZE"] = str(size)
     os.environ["HOROVOD_CONTROLLER_ADDR"] = "127.0.0.1"

@@ -36,6 +36,43 @@ class TestMessageTable:
             t.increment_tensor_count(_req(r, "a"), 2)
         assert t.pop_ready() == ["b", "a"]
 
+    def test_remove_fires_completion_hook(self):
+        removed = []
+        t = MessageTable(on_remove=removed.append)
+        for r in range(2):
+            t.increment_tensor_count(_req(r, "g"), 2)
+        t.pop_ready()
+        t.remove("g")
+        assert removed == ["g"]
+
+
+class TestStallWarningPruning:
+    def test_recurring_tensor_warns_again_after_completion(self):
+        """A tensor that stalls, completes, then stalls AGAIN must warn
+        again — _warned is pruned on MessageTable.remove(), not kept
+        for the process lifetime."""
+        from horovod_tpu.common.coordinator import StallInspector
+
+        insp = StallInspector(size=2, warning_time=0.0)
+        table = MessageTable(on_remove=insp.tensor_completed)
+
+        def stall_and_check():
+            table.increment_tensor_count(_req(0, "grad"), 2)
+            insp.check(table)  # warns: rank 1 never reported
+            return "grad" in insp._warned
+
+        assert stall_and_check()
+        # second check while still stalled: no duplicate warning state
+        insp.check(table)
+        assert "grad" in insp._warned
+        # rank 1 finally reports; negotiation completes
+        table.increment_tensor_count(_req(1, "grad"), 2)
+        table.pop_ready()
+        table.remove("grad")
+        assert "grad" not in insp._warned
+        # the SAME name stalls later in the process lifetime
+        assert stall_and_check()
+
 
 class TestConstructResponse:
     def _negotiate(self, requests, size):

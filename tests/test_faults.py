@@ -1,0 +1,224 @@
+"""Unit tests for the fail-fast building blocks: heartbeat/abort wire
+frames, the connect() backoff schedule, and the fault-injection spec
+parser (TPU-native extensions; the reference has no liveness layer —
+see docs/fault_tolerance.md)."""
+
+import pytest
+
+from horovod_tpu.common import faults, heartbeat
+from horovod_tpu.common.network import backoff_delays
+from horovod_tpu.common.status import (
+    HorovodInternalError, Status, WorldAbortedError,
+)
+
+
+class TestHeartbeatFrames:
+    def test_ping_roundtrip(self):
+        payload = heartbeat.encode_ping(7, 123456789)
+        assert heartbeat.decode_ping(payload) == (7, 123456789)
+
+    def test_ping_large_sequence(self):
+        # seq is a u64: a long-lived world must never wrap it
+        payload = heartbeat.encode_ping(0, 2 ** 63)
+        assert heartbeat.decode_ping(payload) == (0, 2 ** 63)
+
+    def test_ping_rejects_wrong_length(self):
+        with pytest.raises(ValueError):
+            heartbeat.decode_ping(b"\x00" * 5)
+        with pytest.raises(ValueError):
+            heartbeat.decode_ping(heartbeat.encode_ping(1, 1) + b"x")
+
+    def test_abort_roundtrip(self):
+        payload = heartbeat.encode_abort(3, "rank 3 lost its host")
+        assert heartbeat.decode_abort(payload) == (
+            3, "rank 3 lost its host")
+
+    def test_abort_unicode_cause(self):
+        payload = heartbeat.encode_abort(1, "死 ✂ cause")
+        assert heartbeat.decode_abort(payload) == (1, "死 ✂ cause")
+
+    def test_abort_tolerates_truncated_cause(self):
+        # a dying sender may not flush the whole frame; the origin
+        # rank must still be recoverable from the fixed header
+        payload = heartbeat.encode_abort(5, "some long cause text")
+        origin, cause = heartbeat.decode_abort(payload[:12])
+        assert origin == 5
+        assert cause == "some"
+
+    def test_abort_rejects_short_header(self):
+        with pytest.raises(ValueError):
+            heartbeat.decode_abort(b"\x01\x02")
+
+    def test_unknown_origin_abort_roundtrip(self):
+        # origin -1 = "unknown rank" (ambiguous mid-frame stall)
+        payload = heartbeat.encode_abort(-1, "stalled mid-frame")
+        assert heartbeat.decode_abort(payload) == (
+            -1, "stalled mid-frame")
+
+
+class TestBackoffSchedule:
+    def test_deterministic_schedule_without_jitter(self):
+        delays = backoff_delays(base=0.05, cap=1.0, factor=2.0,
+                                jitter=0.0)
+        got = [next(delays) for _ in range(8)]
+        assert got == [0.05, 0.1, 0.2, 0.4, 0.8, 1.0, 1.0, 1.0]
+
+    def test_cap_is_respected_with_full_jitter(self):
+        delays = backoff_delays(base=0.1, cap=0.5, factor=3.0,
+                                jitter=0.25, rng=lambda: 1.0)
+        got = [next(delays) for _ in range(6)]
+        assert max(got) <= 0.5 * 1.25 + 1e-9
+
+    def test_jitter_bounds(self):
+        lo = backoff_delays(base=0.2, cap=1.0, jitter=0.25,
+                            rng=lambda: 0.0)
+        hi = backoff_delays(base=0.2, cap=1.0, jitter=0.25,
+                            rng=lambda: 1.0)
+        assert next(lo) == pytest.approx(0.2 * 0.75)
+        assert next(hi) == pytest.approx(0.2 * 1.25)
+
+    def test_two_streams_with_distinct_rngs_diverge(self):
+        # the anti-stampede property: two ranks retrying in lockstep
+        # must not sleep identically
+        import random
+        a = backoff_delays(rng=random.Random(1).random)
+        b = backoff_delays(rng=random.Random(2).random)
+        assert [next(a) for _ in range(4)] != [
+            next(b) for _ in range(4)]
+
+
+class TestFaultSpec:
+    def teardown_method(self):
+        faults.clear()
+
+    def test_parse_single_kill(self):
+        (f,) = faults.parse_spec("rank=1:kill:cycle=40")
+        assert (f.action, f.rank, f.at_cycle) == ("kill", 1, 40)
+        assert f.at_op is None and not f.fired
+
+    def test_parse_multi_directive(self):
+        fs = faults.parse_spec(
+            "rank=1:kill:cycle=40; rank=2:delay:op=3:ms=50")
+        assert [f.action for f in fs] == ["kill", "delay"]
+        assert fs[1].at_op == 3 and fs[1].ms == 50.0
+
+    def test_parse_hang_and_sever_args(self):
+        fs = faults.parse_spec(
+            "hang:cycle=5:seconds=2.5;sever:op=1:target=3")
+        assert fs[0].seconds == 2.5 and fs[0].rank is None
+        assert fs[1].target == 3
+
+    def test_unknown_action_rejected(self):
+        with pytest.raises(ValueError):
+            faults.parse_spec("explode:cycle=1")
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(ValueError):
+            faults.parse_spec("kill:cycle=1:when=later")
+
+    def test_missing_trigger_rejected(self):
+        with pytest.raises(ValueError):
+            faults.parse_spec("kill:rank=1")
+
+    def test_double_trigger_rejected(self):
+        with pytest.raises(ValueError):
+            faults.parse_spec("kill:cycle=1:op=2")
+
+    def test_install_arms_plan_and_clear_disarms(self):
+        faults.install("delay", at_op=2, ms=1.0)
+        # module-level plan is live (runtime ticks consult it)
+        assert faults._PLAN and faults._PLAN[0].action == "delay"
+        faults.clear()
+        assert faults._PLAN is None
+
+
+class TestHeartbeatConfig:
+    def test_env_knobs_round_trip(self, monkeypatch):
+        from horovod_tpu.common.config import Config
+
+        monkeypatch.setenv("HOROVOD_HEARTBEAT_INTERVAL", "0.25")
+        monkeypatch.setenv("HOROVOD_HEARTBEAT_TIMEOUT", "7.5")
+        cfg = Config.from_env()
+        assert cfg.heartbeat_interval_s == 0.25
+        assert cfg.heartbeat_timeout_s == 7.5
+
+    def test_defaults_enable_detection(self):
+        from horovod_tpu.common.config import Config
+
+        cfg = Config()
+        assert cfg.heartbeat_timeout_s > cfg.heartbeat_interval_s > 0
+
+
+class TestDrainAbortNotice:
+    """_drain_abort: a rank whose local blame came from an anonymous
+    transport error must defer to an authoritative ABORT notice
+    already queued on (or about to reach) its control channels, so a
+    cascading teardown converges on one origin world-wide."""
+
+    def _pair(self):
+        import socket as _socket
+        from horovod_tpu.common.network import Channel
+
+        a, b = _socket.socketpair()
+        return Channel(a), Channel(b)
+
+    def test_finds_queued_abort(self):
+        from horovod_tpu.common.controller import TAG_ABORT, _drain_abort
+
+        mine, peer = self._pair()
+        peer.send(heartbeat.encode_abort(3, "rank 3 fell over"),
+                  TAG_ABORT)
+        assert _drain_abort({3: mine}, 0.0) == (3, "rank 3 fell over")
+
+    def test_skips_pings_before_abort(self):
+        from horovod_tpu.common.controller import (
+            TAG_ABORT, TAG_PING, _drain_abort,
+        )
+
+        mine, peer = self._pair()
+        peer.send(heartbeat.encode_ping(2, 1), TAG_PING)
+        peer.send(heartbeat.encode_abort(2, "died"), TAG_ABORT)
+        assert _drain_abort({2: mine}, 0.0) == (2, "died")
+
+    def test_empty_and_dead_channels_return_none(self):
+        from horovod_tpu.common.controller import _drain_abort
+
+        mine, peer = self._pair()
+        assert _drain_abort({1: mine}, 0.0) is None
+        peer.close()  # EOF now queued: still no notice, no raise
+        assert _drain_abort({1: mine}, 0.0) is None
+
+    def test_grace_window_catches_late_notice(self):
+        import threading
+        import time as _time
+        from horovod_tpu.common.controller import TAG_ABORT, _drain_abort
+
+        mine, peer = self._pair()
+
+        def late_send():
+            _time.sleep(0.1)
+            peer.send(heartbeat.encode_abort(1, "late"), TAG_ABORT)
+
+        t = threading.Thread(target=late_send)
+        t.start()
+        try:
+            assert _drain_abort({1: mine}, 1.0) == (1, "late")
+        finally:
+            t.join()
+
+
+class TestWorldAbortedStatus:
+    def test_status_carries_origin(self):
+        st = Status.WorldAborted(4, "host fell over")
+        assert not st.ok()
+        assert st.aborted_by == 4
+        assert "rank 4" in st.reason and "host fell over" in st.reason
+
+    def test_error_is_internal_error_subclass(self):
+        # existing `except HorovodInternalError` handlers keep working
+        e = WorldAbortedError("msg", origin_rank=2)
+        assert isinstance(e, HorovodInternalError)
+        assert e.origin_rank == 2
+
+    def test_plain_abort_has_no_origin(self):
+        assert Status.Aborted("clean shutdown").aborted_by is None

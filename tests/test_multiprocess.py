@@ -4,6 +4,7 @@ backend — the TPU build's version of the reference's ``mpirun -np 2
 pytest`` legs (reference: .travis.yml:109-122, test/common.py:25-57)."""
 
 import os
+import signal
 import socket
 import subprocess
 import sys
@@ -35,10 +36,20 @@ def _base_env(extra_env=None):
 
 
 def run_scenario(scenario: str, size: int, timeout: float = 90.0,
-                 extra_env=None, per_rank_env=None):
+                 extra_env=None, per_rank_env=None, expect_rc=None):
+    """``expect_rc`` maps rank -> expected returncode for ranks that
+    are SUPPOSED to die (fault-injection victims: a SIGKILL'd rank
+    exits -9, not 0). Every other rank must exit 0.
+
+    Each rank also gets a hard in-process deadline a bit under
+    ``timeout`` (HOROVOD_TEST_DEADLINE -> faulthandler alarm in
+    mp_scenarios.main): a deadlocked rank self-reports with thread
+    stacks instead of relying on this parent's kill."""
     port = _free_port()
     procs = []
     base = _base_env(extra_env)
+    base.setdefault("HOROVOD_TEST_DEADLINE",
+                    str(max(5.0, timeout - 5.0)))
     for rank in range(size):
         env = dict(base)
         if per_rank_env:
@@ -57,7 +68,8 @@ def run_scenario(scenario: str, size: int, timeout: float = 90.0,
                 q.kill()
             raise AssertionError(
                 f"scenario {scenario} rank {rank} timed out")
-        if p.returncode != 0:
+        want = 0 if expect_rc is None else expect_rc.get(rank, 0)
+        if p.returncode != want:
             failures.append((rank, p.returncode, out.decode()))
     assert not failures, "\n".join(
         f"--- rank {r} exited {rc} ---\n{o}" for r, rc, o in failures)
@@ -484,6 +496,92 @@ def test_rank_death_hier_leaf_fails_survivors_cleanly():
         "rank_death_hier", 4, timeout=90.0,
         per_rank_env=lambda rank: {
             "HOROVOD_HOSTNAME": f"fakehost{rank // 2}"})
+
+
+# -- fail-fast world abort (heartbeats + ABORT fan-out; see -----------
+# docs/fault_tolerance.md). Victims die by fault injection armed via
+# HOROVOD_FAULT_SPEC (horovod_tpu/common/faults.py); survivors must
+# raise WorldAbortedError NAMING the dead rank, purely in-band — the
+# harness timeout/alarm exists only to report a regression, never to
+# unblock a passing run.
+
+_HB_ENV = {
+    "HOROVOD_HEARTBEAT_INTERVAL": "0.3",
+    "HOROVOD_HEARTBEAT_TIMEOUT": "3",
+}
+_SIGKILL_RC = -signal.SIGKILL
+
+
+def test_abort_sigkill_leaf_mid_allreduce():
+    """SIGKILL rank 1 of 3 just before it executes its 3rd collective:
+    both survivors (coordinator included) raise WorldAbortedError
+    naming rank 1 within the detection deadline."""
+    run_scenario(
+        "abort_sigkill_leaf", 3, timeout=60.0,
+        extra_env={**_HB_ENV,
+                   "HOROVOD_FAULT_SPEC": "rank=1:kill:op=3"},
+        expect_rc={1: _SIGKILL_RC})
+
+
+def test_abort_sigkill_local_root_hier():
+    """SIGKILL the second fake host's local root (rank 2 of 4)
+    mid-collective: leaves below it, the coordinator above it, and
+    the unrelated host's ranks all abort with rank 2 named."""
+    run_scenario(
+        "abort_sigkill_local_root", 4, timeout=60.0,
+        extra_env={**_HB_ENV,
+                   "HOROVOD_FAULT_SPEC": "rank=2:kill:op=3"},
+        per_rank_env=lambda rank: {
+            "HOROVOD_HOSTNAME": f"fakehost{rank // 2}"},
+        expect_rc={2: _SIGKILL_RC})
+
+
+def test_abort_sigkill_coordinator():
+    """SIGKILL rank 0 (coordinator + controller socket) mid-
+    collective: with no coordinator left to fan the ABORT, each worker
+    must detect its dead upward channel itself and name rank 0."""
+    run_scenario(
+        "abort_sigkill_coordinator", 3, timeout=60.0,
+        extra_env={**_HB_ENV,
+                   "HOROVOD_FAULT_SPEC": "rank=0:kill:op=3"},
+        expect_rc={0: _SIGKILL_RC})
+
+
+def test_abort_heartbeat_detects_silent_hang():
+    """Wedge rank 1's background loop for 10 s WITHOUT killing it (no
+    FIN/RST ever reaches the peers — the case TCP error detection
+    cannot see): survivors must abort within the 3 s heartbeat
+    deadline plus slack, naming rank 1, proving detection is bounded
+    by HOROVOD_HEARTBEAT_TIMEOUT rather than by the wedge ending."""
+    run_scenario(
+        "abort_heartbeat_hang", 3, timeout=60.0,
+        extra_env={**_HB_ENV,
+                   "HOROVOD_FAULT_SPEC":
+                       "rank=1:hang:cycle=20:seconds=10"})
+
+
+def test_abort_severed_control_link():
+    """Fault-inject an abrupt close of rank 1's upward control channel
+    (process stays alive): both sides of the cut converge on a world
+    abort instead of one side blocking forever."""
+    run_scenario(
+        "abort_severed_link", 3, timeout=60.0,
+        extra_env={**_HB_ENV,
+                   "HOROVOD_FAULT_SPEC": "rank=1:sever:cycle=20"})
+
+
+def test_abort_sigkill_ring_data_plane():
+    """SIGKILL rank 1 while payloads ride the 2-phase RING data plane
+    (threshold lowered so they do): the survivor whose ring link dies
+    must blame the dead NEIGHBOR — not itself, the healthy detecting
+    rank — and the abort must still fan to every survivor."""
+    run_scenario(
+        "abort_sigkill_ring", 3, timeout=60.0,
+        extra_env={**_HB_ENV,
+                   "HOROVOD_TPU_RING_THRESHOLD": "1024",
+                   "HOROVOD_TPU_SHM": "0",
+                   "HOROVOD_FAULT_SPEC": "rank=1:kill:op=3"},
+        expect_rc={1: _SIGKILL_RC})
 
 
 def test_ring_data_plane_with_hier_controller():
