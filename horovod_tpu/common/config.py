@@ -54,6 +54,28 @@ class Config:
     fusion_threshold_bytes: int = 64 * 1024 * 1024
     cycle_time_ms: float = 5.0
 
+    # Steady-state negotiation fast path (reference: the bit-vector
+    # response cache upstream added as its coordinator scalability fix,
+    # HOROVOD_CACHE_CAPACITY): previously negotiated responses are
+    # kept in a world-coherent LRU cache and steady-state cycles
+    # exchange one bit per cache slot instead of serialized Request
+    # lists. Capacity 0 or HOROVOD_CACHE_ENABLED=0 disables (dynamic
+    # graphs that never repeat tensor signatures gain nothing from
+    # it). Both knobs must be identical on every rank.
+    cache_enabled: bool = True
+    cache_capacity: int = 1024
+    # Fused speculative cycle: in bitmask steady state a rank attaches
+    # its pre-packed fused allreduce buffers to the hit-mask gather
+    # frame; the coordinator reduces inline and broadcasts grant +
+    # result in one response frame — negotiation and the data plane
+    # collapse into ONE world round-trip per step. Opportunistic and
+    # per-cycle: any deviation (new tensor, shape change, a rank with
+    # this knob off) falls back to the classic two-round cached path
+    # for that cycle, so ranks may disagree on this knob safely.
+    # Applies only when the star socket data plane would carry the
+    # batch anyway (shm/ring/XLA-bound batches keep their plane).
+    cache_speculative: bool = True
+
     # Ring data plane for the socket backend (TPU-native extension): host
     # payloads at or above this size ride the bandwidth-optimal 2-phase
     # ring (ops/ring.py) instead of the star through rank 0 — the TCP
@@ -166,6 +188,12 @@ class Config:
         c.fusion_threshold_bytes = _env_int(
             "HOROVOD_FUSION_THRESHOLD", c.fusion_threshold_bytes)
         c.cycle_time_ms = _env_float("HOROVOD_CYCLE_TIME", c.cycle_time_ms)
+        c.cache_enabled = _env_bool("HOROVOD_CACHE_ENABLED",
+                                    c.cache_enabled)
+        c.cache_capacity = _env_int("HOROVOD_CACHE_CAPACITY",
+                                    c.cache_capacity)
+        c.cache_speculative = _env_bool("HOROVOD_CACHE_SPECULATIVE",
+                                        c.cache_speculative)
         c.ring_threshold_bytes = _env_int(
             "HOROVOD_TPU_RING_THRESHOLD", c.ring_threshold_bytes)
         c.shm_enabled = _env_bool("HOROVOD_TPU_SHM", c.shm_enabled)

@@ -29,6 +29,7 @@ from typing import Dict, List, Optional
 from horovod_tpu.common import heartbeat
 from horovod_tpu.common import logging as hlog
 from horovod_tpu.common import network
+from horovod_tpu.common import wire
 from horovod_tpu.common.status import WorldAbortedError, world_abort_message
 
 def _my_hostname() -> str:
@@ -801,15 +802,38 @@ class TcpCoordinator(Controller):
         finally:
             ch.sock.settimeout(None)
 
-    def _expand(self, out: List[bytes]) -> List[bytes]:
+    def _expand(self, out: List[bytes],
+                allow_combined: bool = False) -> List[bytes]:
         """Unpack aggregate frames from local roots into per-rank
-        slots (gather direction)."""
+        slots (gather direction). ``allow_combined`` (control-plane
+        request gathers only): a local root that AND-reduced its whole
+        host's cache bitmasks forwards ONE CACHED_AGG cycle frame
+        instead of a per-rank pack — it stays in the owner's slot and
+        the members' slots are left empty, since the fold already
+        accounts for every rank behind it. Request-tag packs that
+        could NOT be folded arrive under an explicit PACKED envelope
+        byte (a raw pack's leading u32 count is ambiguous: 2 ranks
+        pack to a leading 0x02 — the CACHED_AGG kind byte)."""
         if not self._has_aggregates:
             return out
         for owner, members in self._members.items():
             if len(members) == 1:
                 continue
-            frames = unpack_frames(out[owner])
+            blob = out[owner]
+            if allow_combined:
+                if blob[:1] == wire.CACHED_AGG_PREFIX:
+                    for m in members:
+                        if m != owner:
+                            out[m] = b""
+                    continue
+                if blob[:1] != wire.PACKED_PREFIX:
+                    raise ConnectionError(
+                        f"request aggregate from rank {owner} has "
+                        f"kind {blob[0] if blob else None}; expected "
+                        f"a folded CACHED_AGG frame or a PACKED "
+                        f"envelope")
+                blob = blob[1:]
+            frames = unpack_frames(blob)
             if len(frames) != len(members):
                 raise ConnectionError(
                     f"aggregate from rank {owner} carried "
@@ -865,7 +889,9 @@ class TcpCoordinator(Controller):
     def _gather_frames(self, payload, expect_tag: int) -> List[bytes]:
         """One frame per channel (native poll loop when available),
         rank-indexed with this rank's own payload at 0, aggregate
-        frames expanded to their member ranks."""
+        frames expanded to their member ranks. Combined (AND-reduced)
+        cache bitmask aggregates are only meaningful on the request
+        tag — a data-plane payload may begin with any byte."""
         out: List[bytes] = [b""] * self._size
         out[0] = payload
         try:
@@ -879,7 +905,8 @@ class TcpCoordinator(Controller):
             raise
         except (ConnectionError, OSError) as e:
             self._raise_transport(e)
-        return self._expand(out)
+        return self._expand(out,
+                            allow_combined=(expect_tag == TAG_REQUESTS))
 
     def gather_requests(self, payload: bytes) -> Optional[List[bytes]]:
         return self._gather_frames(payload, TAG_REQUESTS)
@@ -1218,7 +1245,23 @@ class TcpWorker(Controller):
             except (ConnectionError, OSError) as e:
                 self._raise_child_transport(e, "gather from local leaves")
             frames[self.rank] = payload
-            payload = pack_frames([frames[r] for r in self._members])
+            ordered = [frames[r] for r in self._members]
+            payload = None
+            if tag == TAG_REQUESTS:
+                # Steady-state fast path: when the whole host sent
+                # cache bitmask frames, AND/OR-fold them here and
+                # forward ONE mask for the host — the coordinator's
+                # per-cycle bytes then scale with n_hosts, not ranks.
+                # Unfoldable mixes get an explicit PACKED envelope so
+                # the coordinator can tell a per-rank pack from a
+                # folded frame without sniffing ambiguous bytes (a
+                # raw pack_frames blob starts with its u32 count —
+                # 2 for a 2-rank host, which IS the CACHED_AGG kind).
+                payload = wire.combine_cycle_requests(ordered)
+                if payload is None:
+                    payload = wire.PACKED_PREFIX + pack_frames(ordered)
+            if payload is None:
+                payload = pack_frames(ordered)
         self._send_up(payload, tag)
 
     def gather_requests(self, payload: bytes) -> Optional[List[bytes]]:

@@ -16,9 +16,10 @@ provides by construction.
 
 from __future__ import annotations
 
+import heapq
 import time
-from collections import deque
-from typing import Dict, List, Tuple
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Tuple
 
 from horovod_tpu.common import logging as hlog
 from horovod_tpu.common.message import (
@@ -313,22 +314,231 @@ def fuse_responses(responses: List[Response],
                 and dtypes[cand.tensor_names[0]] == dtype
                 and cand.devices == resp.devices
                 and cand.prescale_factor == resp.prescale_factor
-                and cand.postscale_factor == resp.postscale_factor
-                and tensor_bytes + _response_bytes(cand, dtype,
-                                                   slice_numels)
-                    <= fusion_threshold_bytes)
+                and cand.postscale_factor == resp.postscale_factor)
+            if joinable:
+                # Byte accounting once per candidate, after the cheap
+                # compatibility checks pass (and only then — computing
+                # it first would price every incompatible candidate
+                # too, and the allgather branch does real arithmetic).
+                cand_bytes = _response_bytes(cand, dtype, slice_numels)
+                joinable = (tensor_bytes + cand_bytes
+                            <= fusion_threshold_bytes)
             if joinable:
                 for n in cand.tensor_names:
                     resp.add_tensor_name(n)
                 for s in cand.tensor_sizes:
                     resp.add_tensor_size(s)
-                tensor_bytes += _response_bytes(cand, dtype,
-                                                slice_numels)
+                tensor_bytes += cand_bytes
             else:
                 skipped.append(cand)
         queue = skipped
         fused.append(resp)
     return fused
+
+
+# Response types whose negotiated verdicts are worth replaying: the
+# signature (op, dtype, shape, root, device, scales) fully determines
+# the Response, so a steady-state training loop resubmitting the same
+# tensors can skip ConstructResponse entirely. BARRIER is pure
+# negotiation (nothing to replay) and JOIN/ERROR are one-shot.
+CACHEABLE_REQUESTS = frozenset((
+    RequestType.ALLREDUCE, RequestType.ALLGATHER, RequestType.BROADCAST,
+    RequestType.ALLTOALL, RequestType.REDUCESCATTER,
+))
+CACHEABLE_RESPONSES = frozenset((
+    ResponseType.ALLREDUCE, ResponseType.ALLGATHER,
+    ResponseType.BROADCAST, ResponseType.ALLTOALL,
+    ResponseType.REDUCESCATTER,
+))
+
+
+def iter_set_bits(mask: int):
+    """Set bit positions of ``mask``, ascending — THE canonical order
+    every mask-driven cache mutation and replay uses. One shared
+    implementation on purpose: eviction, LRU touch, and replay must
+    iterate bit-identically on every rank or the caches diverge."""
+    while mask:
+        low = mask & -mask
+        mask ^= low
+        yield low.bit_length() - 1
+
+
+class _CacheEntry:
+    __slots__ = ("name", "signature", "response", "dtype", "slice_numel",
+                 "slot")
+
+    def __init__(self, name: str, signature: tuple, response: Response,
+                 dtype: DataType, slice_numel: int, slot: int):
+        self.name = name
+        self.signature = signature
+        self.response = response
+        self.dtype = dtype
+        self.slice_numel = slice_numel
+        self.slot = slot
+
+    def clone_response(self) -> Response:
+        """Fresh Response for fusion: fuse_responses mutates the batch
+        head's name/size lists, which must never reach the cached copy."""
+        r = self.response
+        return Response(response_type=r.response_type,
+                        tensor_names=list(r.tensor_names),
+                        error_message=r.error_message,
+                        devices=list(r.devices),
+                        tensor_sizes=list(r.tensor_sizes),
+                        prescale_factor=r.prescale_factor,
+                        postscale_factor=r.postscale_factor)
+
+
+class ResponseCache:
+    """World-coherent LRU cache of negotiated per-tensor Responses —
+    the steady-state negotiation fast path (upstream analog: the
+    bit-vector response cache behind ``HOROVOD_CACHE_CAPACITY``, the
+    coordinator-scalability fix that followed the original design;
+    conceptually the same move as PyTorch DDP's pre-built gradient
+    buckets).
+
+    Coherence contract: every structural mutation (put, eviction,
+    LRU touch) is driven ONLY by world-identical inputs — the broadcast
+    response stream for puts, the coordinator's broadcast grant and
+    invalidate masks for touches/evictions — applied in one canonical
+    order (ascending slot order for mask-driven events, stream order
+    for puts). Signatures are rank-LOCAL (an allgather's dim-0 and the
+    device id differ per rank); everything else (slot assignment, LRU
+    order, eviction choice, epoch) is bit-identical across the world,
+    which is what lets a rank's slot bit stand in for its serialized
+    Request. ``epoch`` counts structural events and rides every
+    bitmask frame so real divergence fails fast instead of silently
+    executing mismatched collectives."""
+
+    MISS, HIT, INVALID = range(3)
+
+    def __init__(self, capacity: int):
+        if capacity <= 0:
+            raise ValueError("ResponseCache capacity must be positive")
+        self.capacity = capacity
+        self.epoch = 0
+        # name -> entry, maintained in LRU order (first = oldest)
+        self._lru: "OrderedDict[str, _CacheEntry]" = OrderedDict()
+        self._slots: List[Optional[_CacheEntry]] = []
+        self._free: List[int] = []  # min-heap of freed slot indices
+        # local observability (not part of the coherent state)
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    @property
+    def nslots(self) -> int:
+        return len(self._slots)
+
+    @staticmethod
+    def signature(req: Request) -> tuple:
+        """Everything that determines a Request's negotiated verdict
+        (rank-local: shape and device legitimately differ per rank)."""
+        return (int(req.request_type), int(req.tensor_type),
+                req.tensor_shape, req.root_rank, req.device,
+                req.prescale_factor, req.postscale_factor)
+
+    def lookup(self, req: Request) -> Tuple[int, int]:
+        """(state, slot): HIT — the queued request matches the cached
+        signature bit-for-bit; INVALID — same name, different signature
+        (shape/dtype/... changed: the slot must be evicted world-wide);
+        MISS — not cached. Never mutates LRU order (a local lookup is
+        not a world-identical event)."""
+        e = self._lru.get(req.tensor_name)
+        if e is None:
+            self.misses += 1
+            return self.MISS, -1
+        # Field-wise compare against the stored signature rather than
+        # building a fresh signature tuple per lookup — this runs once
+        # per queued request per cycle, the steady state's hottest
+        # rank-local loop. Indices mirror signature().
+        s = e.signature
+        if (s[0] == req.request_type and s[1] == req.tensor_type
+                and s[2] == req.tensor_shape and s[3] == req.root_rank
+                and s[4] == req.device
+                and s[5] == req.prescale_factor
+                and s[6] == req.postscale_factor):
+            self.hits += 1
+            return self.HIT, e.slot
+        self.misses += 1
+        return self.INVALID, e.slot
+
+    def put(self, name: str, signature: tuple, response: Response,
+            dtype: DataType, slice_numel: int) -> None:
+        """Insert/refresh from the negotiated response stream. Callers
+        MUST invoke this in broadcast-stream order on every rank — the
+        LRU order and capacity evictions derive from the call order."""
+        e = self._lru.get(name)
+        if e is not None:
+            e.signature = signature
+            e.response = response
+            e.dtype = dtype
+            e.slice_numel = slice_numel
+            self._lru.move_to_end(name)
+            self.epoch += 1
+            return
+        if len(self._lru) >= self.capacity:
+            _, victim = self._lru.popitem(last=False)
+            self._slots[victim.slot] = None
+            heapq.heappush(self._free, victim.slot)
+            self.epoch += 1
+        if self._free:
+            slot = heapq.heappop(self._free)
+        else:
+            slot = len(self._slots)
+            self._slots.append(None)
+        entry = _CacheEntry(name, signature, response, dtype,
+                            slice_numel, slot)
+        self._slots[slot] = entry
+        self._lru[name] = entry
+        self.epoch += 1
+
+    def evict_slots(self, mask: int) -> None:
+        """Evict every slot set in ``mask`` (the coordinator's OR'ed
+        invalidate mask), ascending slot order."""
+        for slot in iter_set_bits(mask):
+            self._evict(slot)
+
+    def evict_name(self, name: str) -> None:
+        e = self._lru.get(name)
+        if e is not None:
+            self._evict(e.slot)
+
+    def _evict(self, slot: int) -> None:
+        e = self._slots[slot]
+        if e is None:
+            return
+        self._slots[slot] = None
+        del self._lru[e.name]
+        heapq.heappush(self._free, slot)
+        self.epoch += 1
+
+    def touch_mask(self, mask: int) -> None:
+        """Mark granted slots most-recently-used, ascending slot order
+        (grants are world-identical, so LRU order stays coherent).
+        Does not bump the epoch: no slot<->name binding changes, and
+        steady-state replay plans stay valid across hit cycles."""
+        for slot in iter_set_bits(mask):
+            e = self._slots[slot]
+            if e is not None:
+                self._lru.move_to_end(e.name)
+
+    def entry(self, slot: int) -> _CacheEntry:
+        e = self._slots[slot]
+        if e is None:
+            raise KeyError(f"response cache slot {slot} is empty")
+        return e
+
+    def state_fingerprint(self) -> tuple:
+        """(epoch, ((slot, name) ascending), LRU name order) — the
+        coherent (rank-invariant) part of the state, for tests that
+        assert two ranks' caches marched in lockstep."""
+        return (self.epoch,
+                tuple((e.slot, e.name) for e in self._slots
+                      if e is not None),
+                tuple(self._lru))
 
 
 class StallInspector:
@@ -356,10 +566,16 @@ class StallInspector:
         the process lifetime warns again (MessageTable.remove hook)."""
         self._warned.discard(name)
 
-    def check(self, table: MessageTable) -> bool:
+    def check(self, table: MessageTable, cache_stats: str = "") -> bool:
         """Log a report of stalled tensors; returns True if the shutdown
-        threshold was exceeded (caller must initiate shutdown)."""
+        threshold was exceeded (caller must initiate shutdown).
+        ``cache_stats`` — a one-line negotiation-cache summary (hits /
+        misses / cached cycles) surfaced with the periodic report so a
+        timeline reader can tell whether negotiation time went to full
+        rounds or to the bitmask fast path."""
         self._last_check = time.monotonic()
+        if cache_stats:
+            hlog.info(f"negotiation {cache_stats}")
         must_shutdown = False
         for name, age, ranks_reported in table.pending():
             if age < self.warning_time:

@@ -59,14 +59,20 @@ _DT_SIZE = {
 
 
 def numpy_dtype_to_datatype(dtype) -> DataType:
+    # Hot path: one submission per tensor per step lands here. The
+    # common dtypes hit the dict directly; computing ``dtype.name``
+    # (a string-building numpy property) is deferred to the miss path,
+    # where ml_dtypes bfloat16 — a numpy extension dtype — is resolved
+    # and then memoized so it too becomes a dict hit.
     dtype = np.dtype(dtype) if not isinstance(dtype, np.dtype) else dtype
-    # ml_dtypes bfloat16 registers as a numpy extension dtype.
-    if dtype.name == "bfloat16":
-        return DataType.BFLOAT16
     try:
         return _NP_TO_DT[dtype]
     except KeyError:
-        raise ValueError(f"Unsupported dtype for horovod_tpu: {dtype}")
+        pass
+    if dtype.name == "bfloat16":
+        _NP_TO_DT[dtype] = DataType.BFLOAT16
+        return DataType.BFLOAT16
+    raise ValueError(f"Unsupported dtype for horovod_tpu: {dtype}")
 
 
 def datatype_to_numpy_dtype(dt: DataType):
@@ -129,8 +135,14 @@ class Request:
                  prescale_factor: float = 1.0,
                  postscale_factor: float = 1.0):
         self.request_rank = request_rank
-        self.request_type = RequestType(request_type)
-        self.tensor_type = DataType(tensor_type)
+        # Enum() calls dominate a hot enqueue burst's Request inits;
+        # skip the re-wrap when the caller already passed the enum.
+        self.request_type = request_type \
+            if type(request_type) is RequestType \
+            else RequestType(request_type)
+        self.tensor_type = tensor_type \
+            if type(tensor_type) is DataType \
+            else DataType(tensor_type)
         self.tensor_name = tensor_name
         self.root_rank = root_rank
         self.device = device
@@ -208,6 +220,93 @@ class Response:
         return (f"Response({self.response_type.name},"
                 f" names={self.tensor_names},"
                 f" err={self.error_message!r})")
+
+
+class CacheCycleRequest:
+    """One rank's cycle frame on the steady-state fast path (cache
+    coherence wire message; upstream analog: the bit-vector +
+    uncached-request message the response cache rides). ``hit_mask``
+    has one bit per response-cache slot this rank queued this cycle
+    with an unchanged signature; ``invalid_mask`` marks slots whose
+    name was re-queued with a CHANGED signature (shape/dtype/...) and
+    must be evicted world-wide; ``requests`` carries the uncached
+    remainder as plain Requests. ``epoch`` is the sender's cache
+    event-counter — the coordinator fails fast on any mismatch rather
+    than let diverged caches grant mismatched collectives."""
+
+    __slots__ = ("epoch", "nslots", "hit_mask", "invalid_mask",
+                 "requests", "shutdown", "spec_payload")
+
+    def __init__(self, epoch: int = 0, nslots: int = 0,
+                 hit_mask: int = 0, invalid_mask: int = 0,
+                 requests: List[Request] | None = None,
+                 shutdown: bool = False,
+                 spec_payload=None):
+        self.epoch = epoch
+        self.nslots = nslots
+        self.hit_mask = hit_mask
+        self.invalid_mask = invalid_mask
+        self.requests = requests if requests is not None else []
+        self.shutdown = shutdown
+        # Fused speculative cycle (steady-state single-round fast
+        # path): [(DataType, buffer), ...] — one pre-packed fused
+        # allreduce buffer per replay-plan batch, in plan order. None
+        # on a plain bitmask frame.
+        self.spec_payload = spec_payload
+
+    def __eq__(self, other):
+        return (isinstance(other, CacheCycleRequest) and
+                all(getattr(self, s) == getattr(other, s)
+                    for s in ("epoch", "nslots", "hit_mask",
+                              "invalid_mask", "requests", "shutdown"))
+                and _payloads_equal(self.spec_payload,
+                                    other.spec_payload))
+
+
+class CacheCycleResponse:
+    """The coordinator's cycle verdict on the fast path: ``grant_mask``
+    = AND of every rank's hit bits (minus invalidated slots) — the
+    tensors the whole world queued this cycle, replayed locally from
+    the cache in ascending slot order; ``invalid_mask`` = OR of every
+    rank's invalidate bits, evicted on every rank this cycle;
+    ``response_list`` carries whatever negotiated the slow way
+    (possibly empty — a pure hit cycle moves only the two masks)."""
+
+    __slots__ = ("epoch", "nslots", "grant_mask", "invalid_mask",
+                 "response_list", "spec_payload")
+
+    def __init__(self, epoch: int = 0, nslots: int = 0,
+                 grant_mask: int = 0, invalid_mask: int = 0,
+                 response_list: "ResponseList | None" = None,
+                 spec_payload=None):
+        self.epoch = epoch
+        self.nslots = nslots
+        self.grant_mask = grant_mask
+        self.invalid_mask = invalid_mask
+        self.response_list = response_list if response_list is not None \
+            else ResponseList()
+        # Fused speculative cycle verdict: the world-reduced fused
+        # buffers, [(DataType, buffer), ...] in replay-plan order.
+        # None on a classic (two-round) cycle response.
+        self.spec_payload = spec_payload
+
+    def __eq__(self, other):
+        return (isinstance(other, CacheCycleResponse) and
+                all(getattr(self, s) == getattr(other, s)
+                    for s in ("epoch", "nslots", "grant_mask",
+                              "invalid_mask", "response_list"))
+                and _payloads_equal(self.spec_payload,
+                                    other.spec_payload))
+
+
+def _payloads_equal(a, b) -> bool:
+    if (a is None) != (b is None):
+        return False
+    if a is None:
+        return True
+    return (len(a) == len(b)
+            and all(da == db and bytes(ba) == bytes(bb)
+                    for (da, ba), (db, bb) in zip(a, b)))
 
 
 class ResponseList:

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional
 
 from horovod_tpu.common import faults
@@ -30,11 +31,13 @@ from horovod_tpu.common import wire
 from horovod_tpu.common.config import Config
 from horovod_tpu.common.controller import Controller
 from horovod_tpu.common.coordinator import (
-    MessageTable, StallInspector, construct_response, fuse_responses,
+    CACHEABLE_REQUESTS, CACHEABLE_RESPONSES, MessageTable, ResponseCache,
+    StallInspector, construct_response, fuse_responses, iter_set_bits,
 )
 from horovod_tpu.common.message import (
-    DataType, Request, RequestList, RequestType, Response, ResponseList,
-    ResponseType,
+    CacheCycleRequest, CacheCycleResponse, DataType, Request, RequestList,
+    RequestType, Response, ResponseList, ResponseType,
+    datatype_to_numpy_dtype, numpy_dtype_to_datatype,
 )
 from horovod_tpu.common.status import (
     DUPLICATE_NAME_ERROR_FMT, SHUT_DOWN_ERROR, Status, WorldAbortedError,
@@ -117,6 +120,84 @@ class Runtime:
         self._idle_cycles = 0
         self._cycle_count = 0  # lifetime cycles (observability/tests)
         self._wake = threading.Event()
+        # Steady-state negotiation fast path: a world-coherent LRU of
+        # negotiated responses; hit cycles exchange one bit per cache
+        # slot instead of serialized Request lists (HOROVOD_CACHE_*,
+        # docs/performance.md). All knobs must match across ranks —
+        # the frame kinds and epochs fail fast on divergence.
+        self._cache: Optional[ResponseCache] = None
+        if config.cache_enabled and config.cache_capacity > 0:
+            self._cache = ResponseCache(config.cache_capacity)
+        # name -> (signature, dtype, slice_numel) recorded when a
+        # cacheable request is sent the FULL way; consumed when its
+        # negotiated response comes back and populates the cache.
+        self._pending_sigs: Dict[str, tuple] = {}
+        # (grant_mask, threshold) -> fused replay plan, valid for one
+        # cache epoch: the steady state replays the SAME grant every
+        # cycle, so the per-cycle fuse pass collapses to a dict hit.
+        self._replay_plans: Dict[tuple, List[Response]] = {}
+        self._replay_epoch = -1
+        # (epoch, hit_mask) -> serialized cycle frame: steady-state
+        # cycles send the SAME all-hit frame every time — skip
+        # re-serializing it (epoch in the key invalidates on any
+        # structural cache event).
+        self._frame_memo: Dict[tuple, bytes] = {}
+        # name -> monotonic time its cache hit first went un-granted;
+        # after _BIT_DEMOTE_S the request falls back to the full path
+        # so the coordinator's stall machinery (warnings, shutdown
+        # blame) sees it exactly as it would without the cache.
+        self._bit_pending_since: Dict[str, float] = {}
+        self._cached_cycles = 0  # cycles negotiated purely via bitmask
+        # Fused speculative cycle (HOROVOD_CACHE_SPECULATIVE): once a
+        # pure-hit cycle is FULLY granted, its mask becomes a steady
+        # prediction — the next identical cycle sends its pre-packed
+        # fused allreduce buffers WITH the bitmask, and the coordinator
+        # reduces inline and broadcasts grant + result in one frame:
+        # negotiation + data plane in a single world round-trip. Any
+        # deviation on any rank degrades that cycle to the classic
+        # two-round cached path (the payload is simply ignored).
+        # Autotune steers fusion/cycle parameters mid-run through full
+        # responses, which speculation would starve — mutually
+        # exclusive by construction.
+        self._spec_enabled = (self._cache is not None
+                              and config.cache_speculative
+                              and parameter_manager is None)
+        # Recently fully-granted pure-hit masks -> their name sets
+        # (insertion-ordered, capped): the steady-state predictions,
+        # doubling as the burst-hold's (_absorb_burst) reference sets.
+        # More than one set stays steady in real loops — double-
+        # buffered training alternates two gradient buckets, periodic
+        # metrics add an every-N-steps set — and each deserves the
+        # fused round. Slot-based, so any structural cache event
+        # (epoch move) invalidates them all.
+        self._steady: "OrderedDict[int, frozenset]" = OrderedDict()
+        self._steady_epoch = -1
+        # The coordinator's effective fusion threshold, broadcast on
+        # cached-cycle responses: replay and speculative packing must
+        # fuse with the WORLD's value, not this rank's local config
+        # (a divergent HOROVOD_FUSION_THRESHOLD would otherwise build
+        # mismatched batches from the same grant).
+        self._world_fusion_threshold = config.fusion_threshold_bytes
+        # mask -> consecutive speculative bids the world answered with
+        # a CLASSIC full grant: everything was granted, yet the fused
+        # round was refused — the signature of a peer that will never
+        # speculate (HOROVOD_CACHE_SPECULATIVE off, or a plane
+        # mismatch). After _SPEC_DENY_LIMIT denials the mask stops
+        # speculating, so a blessed heterogeneous-knob world does not
+        # ship (and discard) the full fused payload every step
+        # forever. A transient dead round (grant 0) does not count,
+        # and a completed fused cycle resets the mask's slate.
+        self._spec_denied: Dict[int, int] = {}
+        # [(fused Response, entries, arrays)] per payload segment of
+        # the spec frame in flight this cycle (build->apply, bg thread
+        # only); None when the current cycle is not speculative.
+        self._spec_inflight = None
+        self._spec_cycles = 0  # cycles completed via the fused round
+        self._spec_bids = 0    # speculative frames sent (observability)
+        # Hits the last cycle bid but the world did not grant, now
+        # requeued: their peers were already granted and will not be
+        # re-enqueued, so they must never trigger a burst hold.
+        self._requeued_names: frozenset = frozenset()
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:
@@ -173,7 +254,8 @@ class Runtime:
             # its handle cannot hang forever.
             if self.tensor_table.pop_entry_if_present(entry.tensor_name):
                 return self._terminal_status()
-        self._wake.set()  # snap an idle-backed-off loop awake
+        if not self._wake.is_set():
+            self._wake.set()  # snap an idle-backed-off loop awake
         return Status.OK()
 
     def enqueue_group(self, request_type: RequestType, items,
@@ -216,7 +298,8 @@ class Runtime:
                 if self.tensor_table.pop_entry_if_present(
                         entry.tensor_name) and entry.callback:
                     entry.callback(self._terminal_status())
-        self._wake.set()
+        if not self._wake.is_set():
+            self._wake.set()
         return Status.OK()
 
     def _resolve_abort(self, origin: int, cause: str) -> tuple:
@@ -315,27 +398,244 @@ class Runtime:
 
     _IDLE_GRACE = 16  # empty cycles before the backoff ramp starts
 
+    # How long a cache hit may stay un-granted (some rank has not
+    # queued that tensor yet) before it falls back to the full
+    # negotiation path. Bit-queued requests never enter the
+    # coordinator's MessageTable, so without this demotion a tensor a
+    # rank stops submitting would stall silently — invisible to the
+    # stall inspector's warnings and shutdown blame. Healthy
+    # steady-state hits are granted within a cycle or two; 5 s is
+    # unreachable there and negligible next to the stall thresholds.
+    _BIT_DEMOTE_S = 5.0
+
+    # Consecutive classic-full-grant answers to speculative bids of
+    # one mask before that mask stops speculating (see _spec_denied).
+    _SPEC_DENY_LIMIT = 3
+
+    # Empty-queue hold while steady state is established: how long an
+    # idle rank waits for its producer before initiating an empty
+    # (grant-nothing) round. Capped by heartbeat_timeout/4 so a
+    # silently-holding rank can never be mistaken for a dead one.
+    _STEADY_IDLE_S = 0.25
+
+    # Floor for the burst hold's total budget (_absorb_burst): the
+    # hold waits at most max(2 x cycle_time, this) for the rest of the
+    # step's enqueue burst, woken by each enqueue rather than by
+    # polling. Generous on purpose: while a rank holds, the world is
+    # blocked in the request gather waiting for its frame anyway, so
+    # the hold adds latency ONLY when the steady set genuinely shrank
+    # — which pays this once and then re-learns the smaller set from
+    # its next grant. A fragment negotiated instead would cost far
+    # more: a mispredicted speculative cycle plus an extra
+    # negotiation + data round for the remainder.
+    _BURST_HOLD_S = 0.02
+
+    def _build_request_frame(self, requests: List[Request],
+                             shutting_down: bool):
+        """Partition this cycle's requests into cache-bitmask bits and
+        full Requests; returns (payload, bit_requests) where
+        ``bit_requests`` is [(slot, request)] for the hits the grant
+        mask will adjudicate."""
+        cache = self._cache
+        self._spec_inflight = None
+        if cache is None:
+            return wire.serialize_cycle_request(
+                RequestList(requests, shutdown=shutting_down)), []
+        now = time.monotonic()
+        hit_mask = 0
+        invalid_mask = 0
+        uncached: List[Request] = []
+        bit_requests: List[tuple] = []
+        for req in requests:
+            state, slot = cache.lookup(req)
+            if state == ResponseCache.HIT:
+                pending = self._bit_pending_since.get(req.tensor_name)
+                if pending is None or \
+                        now - pending < self._BIT_DEMOTE_S:
+                    hit_mask |= 1 << slot
+                    bit_requests.append((slot, req))
+                    continue
+                # Un-granted for too long: demote to the full path so
+                # the coordinator's stall machinery sees it.
+                self._bit_pending_since.pop(req.tensor_name, None)
+                hlog.warning(
+                    f"tensor {req.tensor_name} waited "
+                    f"{now - pending:.1f}s as a cached hit without "
+                    f"world agreement; falling back to full "
+                    f"negotiation", rank=self.controller.rank)
+            elif state == ResponseCache.INVALID:
+                invalid_mask |= 1 << slot
+            self._record_signature(req)
+            uncached.append(req)
+        if not uncached and not invalid_mask and not shutting_down:
+            if hit_mask and self._spec_enabled \
+                    and self._steady_epoch == cache.epoch \
+                    and hit_mask in self._steady \
+                    and self._spec_denied.get(hit_mask, 0) \
+                    < self._SPEC_DENY_LIMIT:
+                payload = self._build_spec_frame(hit_mask)
+                if payload is not None:
+                    return payload, bit_requests
+            # Pure-hit (or empty) frame: bit-identical every
+            # steady-state cycle — serialize once per (epoch, mask).
+            key = (cache.epoch, hit_mask)
+            payload = self._frame_memo.get(key)
+            if payload is None:
+                payload = wire.serialize_cycle_request(
+                    CacheCycleRequest(
+                        epoch=cache.epoch, nslots=cache.nslots,
+                        hit_mask=hit_mask))
+                if len(self._frame_memo) >= 64:
+                    self._frame_memo.clear()
+                self._frame_memo[key] = payload
+            return payload, bit_requests
+        payload = wire.serialize_cycle_request(CacheCycleRequest(
+            epoch=cache.epoch, nslots=cache.nslots, hit_mask=hit_mask,
+            invalid_mask=invalid_mask, requests=uncached,
+            shutdown=shutting_down))
+        return payload, bit_requests
+
+    def _absorb_burst(self, requests: List[Request]) -> List[Request]:
+        """Hold a cycle that caught the FRONT of an enqueue burst: a
+        training step submits the steady-state set back-to-back, and a
+        loop that negotiates the first fraction gets a fragment grant —
+        the step's one fused batch splits into several data-plane
+        rounds, every cycle re-bids the remainder, and each fragment
+        pays full round-trip cost. While the popped names are all
+        cache hits forming a strict subset of the last granted cycle's
+        set, wait (bounded by one cycle period) for the rest of the
+        burst; any non-steady name or the deadline ends the hold — a
+        transition cycle pays at most one cycle_time_ms of extra
+        latency, the bound pacing already imposes."""
+        steady_sets = self._steady.values()
+        if not steady_sets:
+            return requests
+        seen = {r.tensor_name for r in requests}
+
+        def fragment() -> bool:
+            # A strict subset of SOME steady set — and not exactly any
+            # of them (a complete bucket must negotiate now, even if
+            # it happens to sit inside a larger steady set).
+            return (not any(seen == s for s in steady_sets)
+                    and any(seen < s for s in steady_sets))
+
+        if not fragment() or seen <= self._requeued_names:
+            return requests
+        hold = max(2 * self.config.cycle_time_ms / 1000.0,
+                   self._BURST_HOLD_S)
+        hb = self.config.heartbeat_timeout_s
+        if hb > 0:
+            # A holding rank sends no frames; like the idle hold, stay
+            # far under the heartbeat deadline or a huge cycle_time
+            # could make a healthy holder look dead to its peers.
+            hold = min(hold, hb / 4.0)
+        deadline = time.monotonic() + hold
+        while True:
+            # Event-driven, not polled: clear BEFORE draining so an
+            # enqueue that lands between the drain and the wait still
+            # sets the event (no missed wake, no busy spin — an
+            # earlier 0.5 ms polling variant of this hold cost more
+            # GIL contention than the fragmentation it prevented).
+            self._wake.clear()
+            more = self.tensor_table.pop_messages()
+            if more:
+                requests.extend(more)
+                seen.update(r.tensor_name for r in more)
+                if not fragment():
+                    return requests
+                continue
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or self._shutdown_requested.is_set():
+                return requests
+            self._wake.wait(remaining)
+
+    def _build_spec_frame(self, hit_mask: int):
+        """Serialize a fused speculative cycle frame: the pure-hit
+        bitmask PLUS this rank's pre-packed fused allreduce buffers in
+        replay-plan order, or None when the batch is not speculation-
+        eligible (non-allreduce entries in the steady set, a data
+        plane of its own — shm/ring/XLA — would carry it, or an entry
+        vanished). Entries are only PEEKED: the world may still deny
+        the grant, in which case the classic path pops them later."""
+        from horovod_tpu.ops.socket_ops import _pack_fused, _to_numpy
+        cache = self._cache
+        plan = self._replay_plan(hit_mask, self._world_fusion_threshold)
+        segments = []
+        inflight = []
+        for resp in plan:
+            if resp.response_type != ResponseType.ALLREDUCE:
+                return None
+            entries = self.tensor_table.peek_entries(resp.tensor_names)
+            if entries is None:
+                return None
+            arrays = [_to_numpy(e.tensor) for e in entries]
+            try:
+                backend = self.op_manager.pick(entries, resp)
+            except RuntimeError:
+                return None
+            if not backend.fused_cycle_reducible(
+                    sum(a.nbytes for a in arrays)):
+                return None
+            fused, _ = _pack_fused(arrays, resp)  # applies prescale
+            segments.append((numpy_dtype_to_datatype(fused.dtype),
+                             fused))
+            inflight.append((resp, entries, arrays))
+        self._spec_inflight = inflight
+        self._spec_bids += 1
+        return wire.serialize_cycle_request(CacheCycleRequest(
+            epoch=cache.epoch, nslots=cache.nslots, hit_mask=hit_mask,
+            spec_payload=segments))
+
+    def _record_signature(self, req: Request) -> None:
+        if req.request_type not in CACHEABLE_REQUESTS:
+            return
+        numel = 1
+        for d in req.tensor_shape[1:]:
+            numel *= d
+        self._pending_sigs[req.tensor_name] = (
+            ResponseCache.signature(req), req.tensor_type, numel)
+
     def _run_loop_once(self) -> bool:
         """One negotiation cycle; returns False to exit
-        (reference: operations.cc:986-1338)."""
+        (reference: operations.cc:986-1338). With the response cache
+        enabled, steady-state cycles ride the bitmask fast path: each
+        rank's frame is one bit per cache slot (AND-reduced up the
+        gather tree), the coordinator broadcasts the world-granted
+        mask, and every rank locally replays the cached responses in
+        ascending slot order — no per-tensor serialization, no
+        ConstructResponse, no fusion pass. Any miss, signature change,
+        eviction, or non-cacheable op rides the full path alongside
+        the masks and repopulates the cache coherently everywhere."""
         t0 = time.monotonic()
         self._cycle_count += 1
         faults.tick_cycle(self, self._cycle_count)
         self.timeline.mark_cycle_start()
 
         requests = self.tensor_table.pop_messages()
+        if requests and self._cache is not None:
+            requests = self._absorb_burst(requests)
         shutting_down = self._shutdown_requested.is_set()
-        req_list = RequestList(requests, shutdown=shutting_down)
-        payload = wire.serialize_request_list(req_list)
+        payload, bit_requests = self._build_request_frame(
+            requests, shutting_down)
 
         gathered = self.controller.gather_requests(payload)
         if self.controller.is_coordinator:
-            resp_list = self._coordinate(gathered)
-            self.controller.broadcast_responses(
-                wire.serialize_response_list(resp_list))
+            reply, meta = self._coordinate_cycle(gathered)
+            self.controller.broadcast_responses(reply)
         else:
             data = self.controller.broadcast_responses(None)
-            resp_list = wire.parse_response_list(data)
+            meta = wire.parse_cycle_response(data)
+
+        if isinstance(meta, CacheCycleResponse):
+            resp_list = self._apply_cached_cycle(meta, bit_requests)
+        else:
+            if self._cache is not None:
+                raise ConnectionError(
+                    "coordinator negotiated without the response cache "
+                    "while this rank has it enabled — HOROVOD_CACHE_"
+                    "ENABLED/HOROVOD_CACHE_CAPACITY must be identical "
+                    "on every rank")
+            resp_list = meta
 
         self._perform_operations(resp_list)
 
@@ -362,6 +662,48 @@ class Runtime:
             self._idle_cycles += 1
         elapsed = time.monotonic() - t0
         sleep_s = cycle_time_ms / 1000.0 - elapsed
+        if not self.tensor_table.queue_pending():
+            if sleep_s <= 0:
+                # The cycle overran the pace budget (normal on a
+                # loaded host) and drained everything local. Starting
+                # the next world-synchronized round right now loses a
+                # race with the completion callbacks' re-enqueue —
+                # every steady-state step would pay one DEAD
+                # gather+broadcast round of empty frames. Pace from
+                # cycle END instead: wait out one cycle period on
+                # _wake, which new local work snaps open immediately,
+                # so a training loop's next step starts its round with
+                # the queue populated. A rank waiting here delays a
+                # remote-only negotiation by at most cycle_time_ms —
+                # the same bound the reference's start-measured pacing
+                # imposes (operations.cc:987-995).
+                sleep_s = cycle_time_ms / 1000.0
+            if self._steady:
+                # Established steady state sharpens that reasoning —
+                # and applies even when the cycle FINISHED under
+                # budget (fast fused cycles on a quiet host): the
+                # world's next round cannot grant ANYTHING until this
+                # rank's training thread re-submits a steady set
+                # (every collective requires every rank's request), so
+                # initiating an empty round early buys nothing and
+                # costs everyone a dead gather+broadcast (its
+                # AND-grant is zero). Hold until work arrives or a
+                # generous deadline passes — the next enqueue and
+                # request_shutdown both snap _wake open instantly, and
+                # the hold stays far under the heartbeat deadline, so
+                # the only cost is bounded frame latency on a world
+                # where OTHER ranks are active while this one idles —
+                # and their grants were blocked on this rank anyway.
+                hold = max(8 * cycle_time_ms / 1000.0,
+                           self._STEADY_IDLE_S)
+                hb = self.config.heartbeat_timeout_s
+                if hb > 0:
+                    # the cap bounds the WHOLE hold, including the
+                    # cycle-time-derived term, or a large
+                    # HOROVOD_CYCLE_TIME could silently eat the
+                    # heartbeat deadline
+                    hold = min(hold, hb / 4.0)
+                sleep_s = max(sleep_s, hold)
         backoff_ms = self.config.idle_backoff_ms
         if backoff_ms > 0 and self._idle_cycles > self._IDLE_GRACE:
             backoff_s = backoff_ms / 1000.0
@@ -383,14 +725,437 @@ class Runtime:
         self._wake.clear()
         return True
 
-    def _coordinate(self, gathered: List[bytes]) -> ResponseList:
+    def _coordinate_cycle(self, gathered: List[bytes]):
+        """Parse every rank's cycle frame and produce this cycle's
+        broadcast payload. Returns (payload, meta) where ``meta`` is
+        the ResponseList (cache disabled) or CacheCycleResponse that
+        every rank — this one included — applies identically."""
+        cache = self._cache
+        if cache is None:
+            req_lists = [wire.parse_cycle_request(f)
+                         for f in gathered if f]
+            for rl in req_lists:
+                if not isinstance(rl, RequestList):
+                    raise ConnectionError(
+                        "a rank negotiated with the response cache "
+                        "while the coordinator has it disabled — "
+                        "HOROVOD_CACHE_ENABLED/HOROVOD_CACHE_CAPACITY "
+                        "must be identical on every rank")
+            resp_list = self._coordinate(req_lists)
+            return wire.serialize_cycle_response(resp_list), resp_list
+        epoch = cache.epoch
+        and_hits = -1  # all-ones identity; every rank ANDs one mask in
+        or_invalid = 0
+        shutdown = False
+        req_lists: List[RequestList] = []
+        spec_frames: List[CacheCycleRequest] = []
+        n_frames = 0
+        for f in gathered:
+            if not f:
+                # member slot folded into its host's CACHED_AGG frame
+                continue
+            n_frames += 1
+            cf = wire.parse_cycle_request(f)
+            if not isinstance(cf, CacheCycleRequest):
+                raise ConnectionError(
+                    "a rank negotiated without the response cache "
+                    "while the coordinator has it enabled — "
+                    "HOROVOD_CACHE_ENABLED/HOROVOD_CACHE_CAPACITY "
+                    "must be identical on every rank")
+            if cf.epoch != epoch or cf.nslots != cache.nslots:
+                raise ConnectionError(
+                    f"response-cache state diverged: a rank reported "
+                    f"epoch {cf.epoch}/{cf.nslots} slots vs the "
+                    f"coordinator's {epoch}/{cache.nslots} — "
+                    f"negotiation cannot continue safely")
+            and_hits &= cf.hit_mask
+            or_invalid |= cf.invalid_mask
+            shutdown = shutdown or cf.shutdown
+            if cf.spec_payload is not None:
+                spec_frames.append(cf)
+            if cf.requests:
+                req_lists.append(RequestList(cf.requests, cf.shutdown))
+        if (spec_frames and len(spec_frames) == n_frames
+                and not shutdown and not or_invalid
+                and all(cf.hit_mask == and_hits
+                        for cf in spec_frames)):
+            # Fused speculative cycle: every rank bid the SAME pure-hit
+            # mask with its fused buffers attached — reduce inline and
+            # broadcast grant + result in this very response. One
+            # world round-trip total: no separate data-plane round, no
+            # ConstructResponse, no fusion pass.
+            reduced = self._reduce_spec(spec_frames)
+            self.timeline.negotiate_cached(fused=True)
+            # Stall detection must not go blind while the world hums
+            # in fused steady state: a full-path tensor some rank
+            # submitted earlier may still be aging in the table.
+            self._check_stall(self._message_table,
+                              self.controller.size)
+            meta = CacheCycleResponse(epoch=epoch,
+                                      nslots=cache.nslots,
+                                      grant_mask=and_hits,
+                                      spec_payload=reduced)
+            return wire.serialize_cycle_response(meta), meta
+        grant = and_hits & ~or_invalid
+        resp_list = self._coordinate(req_lists,
+                                     extra_shutdown=shutdown)
+        if grant and not resp_list.responses:
+            self.timeline.negotiate_cached()
+        meta = CacheCycleResponse(epoch=epoch, nslots=cache.nslots,
+                                  grant_mask=grant,
+                                  invalid_mask=or_invalid,
+                                  response_list=resp_list)
+        return wire.serialize_cycle_response(meta), meta
+
+    # Canonical ascending-bit iteration, shared with the cache's own
+    # mask-driven mutations (coordinator.iter_set_bits) so replay and
+    # eviction can never drift apart.
+    _iter_slots = staticmethod(iter_set_bits)
+
+    def _apply_cached_cycle(self, meta: CacheCycleResponse,
+                            bit_requests: List[tuple]) -> ResponseList:
+        """Apply the coordinator's cycle verdict to the local cache —
+        identically on every rank: evict the OR'ed invalid slots
+        (ascending), replay the granted slots (ascending, fused with
+        the threshold this very frame carries), repopulate from the
+        freshly negotiated responses (stream order), and requeue hits
+        the world did not grant."""
+        cache = self._cache
+        if cache is None or meta.epoch != cache.epoch \
+                or meta.nslots != cache.nslots:
+            local = ("disabled" if cache is None
+                     else f"epoch {cache.epoch}/{cache.nslots} slots")
+            raise ConnectionError(
+                f"response-cache state diverged from the coordinator "
+                f"(local {local}, coordinator epoch "
+                f"{meta.epoch}/{meta.nslots} slots) — negotiation "
+                f"cannot continue safely")
+        if meta.spec_payload is not None:
+            return self._complete_spec_cycle(meta, bit_requests)
+        inner = meta.response_list
+        if meta.invalid_mask:
+            cache.evict_slots(meta.invalid_mask)
+        if inner.tuned_fusion_threshold_bytes:
+            # The coordinator's effective threshold — the WORLD value
+            # every rank must replay and speculate with.
+            self._world_fusion_threshold = \
+                inner.tuned_fusion_threshold_bytes
+        replayed: List[Response] = []
+        if meta.grant_mask:
+            replayed = self._replay_grants(meta.grant_mask,
+                                           self._world_fusion_threshold)
+            if not inner.responses:
+                self._cached_cycles += 1
+        if inner.responses:
+            self._populate_cache(inner)
+        if bit_requests and not inner.shutdown:
+            now = time.monotonic()
+            missed = []
+            for slot, req in bit_requests:
+                if (meta.grant_mask >> slot) & 1:
+                    self._bit_pending_since.pop(req.tensor_name, None)
+                else:
+                    self._bit_pending_since.setdefault(
+                        req.tensor_name, now)
+                    missed.append(req)
+            self._requeued_names = frozenset(
+                r.tensor_name for r in missed)
+            if missed:
+                self.tensor_table.requeue(missed)
+            # A fully granted pure-hit cycle makes its mask (and name
+            # set) a steady-state prediction: _absorb_burst holds for
+            # its enqueue bursts, and the next identical cycle may
+            # speculate its fused payload onto the bitmask round.
+            if self._steady_epoch != cache.epoch:
+                # slot<->name bindings moved; every mask is stale
+                self._steady.clear()
+                self._spec_denied.clear()
+                self._steady_epoch = cache.epoch
+            if self._spec_inflight is not None and not missed:
+                # We bid speculatively; the world granted everything
+                # yet answered classically — some peer will not (or
+                # cannot) speculate. Count it so repeat bids stop
+                # wasting a full fused payload per cycle.
+                bid = 0
+                for slot, _req in bit_requests:
+                    bid |= 1 << slot
+                self._spec_denied[bid] = \
+                    self._spec_denied.get(bid, 0) + 1
+                self._spec_inflight = None
+            if not missed and not inner.responses \
+                    and not meta.invalid_mask:
+                self._steady[meta.grant_mask] = frozenset(
+                    cache.entry(s).name
+                    for s in self._iter_slots(meta.grant_mask))
+                self._steady.move_to_end(meta.grant_mask)
+                if len(self._steady) > 8:
+                    self._steady.popitem(last=False)
+            elif meta.grant_mask or inner.responses \
+                    or meta.invalid_mask:
+                # a PARTIAL verdict for this bid: whatever mask was
+                # bid is not unanimously steady — drop it so repeat
+                # bids stop wasting speculative payloads. A fully
+                # DENIED bid (dead round: some rank simply had
+                # nothing queued yet, a scheduling race) keeps its
+                # prediction and re-speculates on the re-bid.
+                bid_mask = 0
+                for slot, _req in bit_requests:
+                    bid_mask |= 1 << slot
+                self._steady.pop(bid_mask, None)
+        if not replayed:
+            return inner
+        return ResponseList(
+            replayed + inner.responses, shutdown=inner.shutdown,
+            tuned_cycle_time_ms=inner.tuned_cycle_time_ms,
+            tuned_fusion_threshold_bytes=(
+                inner.tuned_fusion_threshold_bytes))
+
+    def _replay_plan(self, grant_mask: int,
+                     threshold: int) -> List[Response]:
+        """The fused execution list for a granted mask: clone the
+        granted entries in ascending slot order and fuse them exactly
+        as the coordinator would have. Memoized per (grant, threshold)
+        for the current cache epoch — a steady-state training loop
+        grants the same mask every cycle, so this collapses to a dict
+        hit. Pure: never touches the LRU (the speculative frame
+        builder calls it before any grant exists)."""
+        cache = self._cache
+        if self._replay_epoch != cache.epoch:
+            self._replay_plans.clear()
+            self._replay_epoch = cache.epoch
+        key = (grant_mask, threshold)
+        plan = self._replay_plans.get(key)
+        if plan is None:
+            responses: List[Response] = []
+            dtypes: Dict[str, DataType] = {}
+            slices: Dict[str, int] = {}
+            for slot in self._iter_slots(grant_mask):
+                e = cache.entry(slot)
+                responses.append(e.clone_response())
+                dtypes[e.name] = e.dtype
+                slices[e.name] = e.slice_numel
+            plan = fuse_responses(responses, dtypes, threshold, slices)
+            if len(self._replay_plans) >= 64:
+                self._replay_plans.clear()
+            self._replay_plans[key] = plan
+        return plan
+
+    def _replay_grants(self, grant_mask: int,
+                       threshold: int) -> List[Response]:
+        plan = self._replay_plan(grant_mask, threshold)
+        self._cache.touch_mask(grant_mask)
+        return plan
+
+    @staticmethod
+    def _reduce_spec(spec_frames: List[CacheCycleRequest]):
+        """Coordinator half of the fused speculative cycle: sum every
+        rank's pre-packed fused buffers segment-by-segment (ascending
+        rank order, mirroring the star data plane). Frames already
+        passed the epoch/mask equality gate, so a layout mismatch here
+        means the caches diverged structurally — fail fast."""
+        import numpy as np
+
+        from horovod_tpu import native as _native
+        first = spec_frames[0].spec_payload
+        if any(len(sf.spec_payload) != len(first)
+               for sf in spec_frames[1:]):
+            raise ConnectionError(
+                "speculative fused payloads disagree on layout "
+                "across ranks — response-cache state diverged")
+        out = []
+        for i, (dt, buf0) in enumerate(first):
+            np_dt = datatype_to_numpy_dtype(dt)
+            acc = np.frombuffer(buf0, dtype=np_dt).copy()
+            for sf in spec_frames[1:]:
+                d2, b2 = sf.spec_payload[i]
+                if d2 != dt or b2.nbytes != buf0.nbytes:
+                    raise ConnectionError(
+                        "speculative fused payloads disagree on "
+                        "layout across ranks — response-cache state "
+                        "diverged")
+                src = np.frombuffer(b2, dtype=np_dt)
+                if not _native.sum_into(acc, src):
+                    acc += src
+            out.append((dt, acc))
+        return out
+
+    def _complete_spec_cycle(self, meta: CacheCycleResponse,
+                             bit_requests: List[tuple]) -> ResponseList:
+        """Worker half of the fused speculative cycle: the grant is by
+        construction exactly what this rank bid, and the payload is
+        the world-reduced result of the buffers it packed at frame
+        build — unpack into the (still-tabled) entries, fire their
+        callbacks, and keep every counter/LRU effect identical to a
+        classic hit cycle so cache coherence is unaffected."""
+        from horovod_tpu.ops.socket_ops import _unpack_fused
+        import numpy as np
+        inflight = self._spec_inflight
+        self._spec_inflight = None
+        if inflight is None or meta.spec_payload is None \
+                or len(meta.spec_payload) != len(inflight):
+            raise ConnectionError(
+                "fused speculative response does not match the frame "
+                "this rank sent — control plane corrupted")
+        timeline_on = self.timeline.enabled
+        ok = Status.OK()
+        for (resp, entries, arrays), (dt, buf) in zip(
+                inflight, meta.spec_payload):
+            self._op_count += 1
+            faults.tick_op(self, self._op_count)
+            names = resp.tensor_names
+            popped = self.tensor_table.pop_entries(names)
+            # bytearray: callers receive writable tensors, never views
+            # over the recv buffer (same contract as the star plane).
+            result = np.frombuffer(bytearray(buf),
+                                   dtype=datatype_to_numpy_dtype(dt))
+            op_name = resp.response_type.name
+            if timeline_on:
+                for n in names:
+                    self.timeline.start(n, op_name)
+            _unpack_fused(entries, arrays, result, resp)
+            if timeline_on:
+                for n in names:
+                    self.timeline.end(n)
+            for e in popped:
+                if e.callback:
+                    e.callback(ok)
+        self._cached_cycles += 1
+        self._spec_cycles += 1
+        self._spec_denied.pop(meta.grant_mask, None)
+        self._cache.touch_mask(meta.grant_mask)
+        for _slot, req in bit_requests:
+            self._bit_pending_since.pop(req.tensor_name, None)
+        self._requeued_names = frozenset()
+        return ResponseList([])
+
+    @staticmethod
+    def _unfuse(resp: Response, i: int, world_size: int) -> Response:
+        """Entry ``i`` of a (possibly fused) response as a standalone
+        single-tensor Response — the unit the cache stores, so a later
+        hit cycle can re-fuse under whatever threshold is then in
+        effect. ALLGATHER tensor_sizes are entry-major
+        (sizes[ec * world_size + rc]); ALLREDUCE sizes are per-entry
+        numels; every other cacheable type never fuses."""
+        if resp.response_type == ResponseType.ALLGATHER:
+            sizes = list(resp.tensor_sizes[i * world_size:
+                                           (i + 1) * world_size])
+        elif resp.tensor_sizes:
+            sizes = [resp.tensor_sizes[i]]
+        else:
+            sizes = []
+        return Response(response_type=resp.response_type,
+                        tensor_names=[resp.tensor_names[i]],
+                        devices=list(resp.devices),
+                        tensor_sizes=sizes,
+                        prescale_factor=resp.prescale_factor,
+                        postscale_factor=resp.postscale_factor)
+
+    def _populate_cache(self, resp_list: ResponseList) -> None:
+        """Refresh the cache from freshly negotiated responses — in
+        broadcast-stream order, the world-identical order every rank
+        sees, which is what keeps slot assignment and LRU eviction
+        bit-identical everywhere. ERROR verdicts evict any stale entry
+        under the same names."""
+        cache = self._cache
+        world_size = self.controller.size
+        for resp in resp_list.responses:
+            rt = resp.response_type
+            if rt == ResponseType.ERROR:
+                for name in resp.tensor_names:
+                    cache.evict_name(name)
+                    self._pending_sigs.pop(name, None)
+                continue
+            if rt not in CACHEABLE_RESPONSES:
+                for name in resp.tensor_names:
+                    self._pending_sigs.pop(name, None)
+                continue
+            for i, name in enumerate(resp.tensor_names):
+                info = self._pending_sigs.pop(name, None)
+                if info is None:
+                    # A response for a tensor this rank never submitted
+                    # through the full path: the negotiation streams
+                    # have diverged; continuing would silently diverge
+                    # the cache next.
+                    raise ConnectionError(
+                        f"negotiated response for tensor {name!r} "
+                        f"without a matching local request — control "
+                        f"plane corrupted")
+                sig, dtype, slice_numel = info
+                cache.put(name, sig, self._unfuse(resp, i, world_size),
+                          dtype, slice_numel)
+
+    def negotiation_cache_stats(self) -> Dict:
+        """Local observability for benchmarks, tests and the stall
+        report: lookup hit/miss counters, cached-cycle count, and the
+        coherent-state epoch."""
+        c = self._cache
+        if c is None:
+            return {"enabled": False}
+        total = c.hits + c.misses
+        return {"enabled": True, "capacity": c.capacity,
+                "entries": len(c), "hits": c.hits, "misses": c.misses,
+                "hit_rate": (c.hits / total) if total else 0.0,
+                "cached_cycles": self._cached_cycles,
+                "spec_cycles": self._spec_cycles,
+                "spec_bids": self._spec_bids,
+                "epoch": c.epoch}
+
+    def _cache_stats_line(self) -> str:
+        s = self.negotiation_cache_stats()
+        if not s.get("enabled"):
+            return ""
+        return (f"cache: {s['hits']} hits / {s['misses']} misses "
+                f"({s['hit_rate']:.1%} hit rate), "
+                f"{s['cached_cycles']} fully cached cycles "
+                f"({s['spec_cycles']} fused single-round), "
+                f"{s['entries']}/{s['capacity']} slots")
+
+    def _check_stall(self, table: MessageTable, size: int) -> None:
+        """Periodic coordinator-side stall scan — runs on EVERY cycle
+        shape, including fused speculative ones (a tensor one rank
+        submitted the full way can sit in the MessageTable while the
+        rest of the world hums along in fused steady state; the PR 2
+        stall warnings and fail-fast shutdown must still see it)."""
+        if not self._stall.should_check():
+            return
+        if self._stall.check(table,
+                             cache_stats=self._cache_stats_line()):
+            # The stall-shutdown threshold fires the fail-fast
+            # abort so every rank gets a structured error naming
+            # the condition, instead of the silent clean-shutdown
+            # fan-out the reference performs (operations.cc:609).
+            # Blame the stalled rank(s), not the healthy
+            # coordinator observing them: the missing ranks on the
+            # OLDEST pending tensor are the culprits. origin -1
+            # ("unknown rank") only if the table emptied racily.
+            origin, missing_note = -1, ""
+            pending = sorted(table.pending(), key=lambda p: -p[1])
+            if pending:
+                name, _, reported = pending[0]
+                missing = [r for r in range(size)
+                           if r not in set(reported)]
+                if missing:
+                    origin = min(missing)
+                    missing_note = (f" (tensor '{name}' never "
+                                    f"submitted by ranks "
+                                    f"{missing})")
+            cause = ("stall shutdown threshold "
+                     f"({self._stall.shutdown_time:g}s) exceeded: "
+                     "one or more tensors were never submitted by "
+                     "every rank (see coordinator stall warnings "
+                     f"for names and missing ranks){missing_note}")
+            raise WorldAbortedError(world_abort_message(origin,
+                                                        cause),
+                                    origin_rank=origin, cause=cause)
+
+    def _coordinate(self, req_lists: List[RequestList],
+                    extra_shutdown: bool = False) -> ResponseList:
         """Coordinator half of the cycle
         (reference: operations.cc:1018-1258)."""
         table = self._message_table
         size = self.controller.size
-        shutdown = False
-        for data in gathered:
-            rl = wire.parse_request_list(data)
+        shutdown = extra_shutdown
+        for rl in req_lists:
             shutdown = shutdown or rl.shutdown
             for req in rl.requests:
                 self._dtypes[req.tensor_name] = req.tensor_type
@@ -414,35 +1179,7 @@ class Runtime:
                 self._dtypes.pop(n, None)
                 self._slice_numels.pop(n, None)
 
-        if self._stall.should_check():
-            if self._stall.check(table):
-                # The stall-shutdown threshold fires the fail-fast
-                # abort so every rank gets a structured error naming
-                # the condition, instead of the silent clean-shutdown
-                # fan-out the reference performs (operations.cc:609).
-                # Blame the stalled rank(s), not the healthy
-                # coordinator observing them: the missing ranks on the
-                # OLDEST pending tensor are the culprits. origin -1
-                # ("unknown rank") only if the table emptied racily.
-                origin, missing_note = -1, ""
-                pending = sorted(table.pending(), key=lambda p: -p[1])
-                if pending:
-                    name, _, reported = pending[0]
-                    missing = [r for r in range(size)
-                               if r not in set(reported)]
-                    if missing:
-                        origin = min(missing)
-                        missing_note = (f" (tensor '{name}' never "
-                                        f"submitted by ranks "
-                                        f"{missing})")
-                cause = ("stall shutdown threshold "
-                         f"({self._stall.shutdown_time:g}s) exceeded: "
-                         "one or more tensors were never submitted by "
-                         "every rank (see coordinator stall warnings "
-                         f"for names and missing ranks){missing_note}")
-                raise WorldAbortedError(world_abort_message(origin,
-                                                           cause),
-                                        origin_rank=origin, cause=cause)
+        self._check_stall(table, size)
 
         resp_list = ResponseList(fused, shutdown=shutdown)
         if self.parameter_manager is not None:
@@ -450,6 +1187,14 @@ class Runtime:
                 self.parameter_manager.cycle_time_ms()
             resp_list.tuned_fusion_threshold_bytes = \
                 self.parameter_manager.fusion_threshold_bytes()
+        elif self._cache is not None:
+            # Cached-cycle replay re-fuses granted slots on every rank
+            # with this threshold; broadcast the coordinator's value
+            # so a rank launched with a divergent
+            # HOROVOD_FUSION_THRESHOLD converges instead of building
+            # mismatched fused batches from the same grant.
+            resp_list.tuned_fusion_threshold_bytes = \
+                self.config.fusion_threshold_bytes
         return resp_list
 
     class _SpanCloser:
@@ -501,11 +1246,8 @@ class Runtime:
         for response in resp_list.responses:
             self._op_count += 1
             faults.tick_op(self, self._op_count)
-            entries: List[TensorTableEntry] = []
-            for name in response.tensor_names:
-                entry = self.tensor_table.get_entry(name)
-                if entry is not None:
-                    entries.append(self.tensor_table.pop_entry(name))
+            entries = self.tensor_table.pop_entries(
+                response.tensor_names)
             if response.response_type == ResponseType.ERROR:
                 for e in entries:
                     if e.callback:
@@ -530,7 +1272,7 @@ class Runtime:
                 for n in names:
                     self.timeline.async_start(n, op_name,
                                               self._batch_seq)
-            else:
+            elif self.timeline.enabled:
                 for e in entries:
                     self.timeline.start(e.tensor_name, op_name)
             # Input readiness: the reference polls CUDA ReadyEvents here
@@ -591,7 +1333,7 @@ class Runtime:
             except Exception as e:
                 status = Status.UnknownError(
                     f"collective execution failed: {e!r}")
-            if closer is None:
+            if closer is None and self.timeline.enabled:
                 self.timeline.activity_end_all(names)
                 for e in entries:
                     self.timeline.end(e.tensor_name)

@@ -83,9 +83,49 @@ class TensorTable:
             self._message_queue = []
             return msgs
 
+    def requeue(self, requests: List[Request]) -> None:
+        """Return popped requests to the FRONT of the message queue, in
+        order (negotiation fast path: a cache hit the world did not
+        grant this cycle stays pending and rides the next cycle's
+        bitmask). Requests whose entry vanished meanwhile (shutdown
+        fan-out reclaimed it) are dropped — resurrecting them would
+        complete a handle twice."""
+        with self._lock:
+            live = [r for r in requests if r.tensor_name in self._table]
+            if live:
+                self._message_queue[:0] = live
+
+    def queue_pending(self) -> bool:
+        """True if any request is waiting for the next cycle (new
+        submissions or fast-path requeues) — the cycle loop's signal
+        that it must start another negotiation round immediately."""
+        with self._lock:
+            return bool(self._message_queue)
+
     def pop_entry(self, name: str) -> TensorTableEntry:
         with self._lock:
             return self._table.pop(name)
+
+    def peek_entries(self, names):
+        """The entries for ``names`` WITHOUT removing them, or None if
+        any is absent — the speculative fused cycle packs its payload
+        from live entries but must not consume them until the world
+        confirms the grant (a mispredicted cycle falls back to the
+        classic path, which pops them itself)."""
+        with self._lock:
+            table = self._table
+            try:
+                return [table[n] for n in names]
+            except KeyError:
+                return None
+
+    def pop_entries(self, names) -> List[TensorTableEntry]:
+        """Remove and return the present entries among ``names`` under
+        ONE lock acquisition — a fused response's per-entry get/pop
+        pairs are a measurable share of the execution hot path."""
+        with self._lock:
+            table = self._table
+            return [table.pop(n) for n in names if n in table]
 
     def pop_entry_if_present(self, name: str):
         with self._lock:
@@ -119,6 +159,7 @@ class HandleManager:
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._last = 0
+        self._waiters = 0
         self._results: Dict[int, Optional[Status]] = {}
         self._outputs: Dict[int, Any] = {}
 
@@ -129,6 +170,18 @@ class HandleManager:
             self._results[handle] = None
             return handle
 
+    def allocate_many(self, n: int) -> List[int]:
+        """``n`` fresh handles under ONE lock acquisition — a grouped
+        submission's per-handle locking is a measurable share of the
+        steady-state submit path."""
+        with self._lock:
+            first = self._last + 1
+            self._last += n
+            handles = list(range(first, self._last + 1))
+            for h in handles:
+                self._results[h] = None
+            return handles
+
     def poll(self, handle: int) -> bool:
         with self._lock:
             if handle not in self._results:
@@ -138,16 +191,40 @@ class HandleManager:
     def mark_done(self, handle: int, status: Status,
                   output: Any = None) -> None:
         with self._cv:
-            self._results[handle] = status
+            # Output BEFORE status: wait()'s lock-free fast path keys
+            # on a non-None status, so the status store must publish
+            # last or a racing synchronize() could release a handle
+            # whose output was not yet visible.
             self._outputs[handle] = output
-            self._cv.notify_all()
+            self._results[handle] = status
+            # A fused batch completes its handles in one burst while
+            # the app waits on at most a few of them — the wake-up is
+            # only worth paying when somebody is actually blocked.
+            if self._waiters:
+                self._cv.notify_all()
+
+    _MISSING = object()
 
     def wait(self, handle: int, timeout: Optional[float] = None) -> Status:
+        # Lock-free fast path: dict reads are atomic under the GIL and
+        # mark_done stores the final Status in one assignment, so a
+        # completed handle (the common case when draining a fused
+        # batch: the first wait blocks, the rest are already done)
+        # never pays the condition-variable lock.
+        res = self._results.get(handle, self._MISSING)
+        if res is self._MISSING:
+            raise ValueError(f"Invalid handle {handle}")
+        if res is not None:
+            return res
         with self._cv:
-            if handle not in self._results:
-                raise ValueError(f"Invalid handle {handle}")
-            ok = self._cv.wait_for(
-                lambda: self._results[handle] is not None, timeout)
+            if self._results[handle] is not None:
+                return self._results[handle]
+            self._waiters += 1
+            try:
+                ok = self._cv.wait_for(
+                    lambda: self._results[handle] is not None, timeout)
+            finally:
+                self._waiters -= 1
             if not ok:
                 raise TimeoutError(
                     f"Timed out waiting for handle {handle}")
@@ -155,8 +232,10 @@ class HandleManager:
 
     def release(self, handle: int) -> Any:
         """Return the output and clear the handle
-        (reference: handle_manager.cc ReleaseHandle/WaitAndClear)."""
-        with self._lock:
-            out = self._outputs.pop(handle, None)
-            self._results.pop(handle, None)
-            return out
+        (reference: handle_manager.cc ReleaseHandle/WaitAndClear).
+        Lockless: dict pops are GIL-atomic and a handle is released by
+        exactly one caller, after completion — no invariant spans the
+        two pops."""
+        out = self._outputs.pop(handle, None)
+        self._results.pop(handle, None)
+        return out

@@ -40,6 +40,7 @@ class _NoOpTimeline:
     def negotiate_start(self, name, request_type): pass
     def negotiate_rank_ready(self, name, rank): pass
     def negotiate_end(self, name): pass
+    def negotiate_cached(self, fused=False): pass
     def start(self, name, op_name): pass
     def activity_start_all(self, names, activity): pass
     def activity_end_all(self, names): pass
@@ -118,6 +119,18 @@ class Timeline(_NoOpTimeline):
 
     def negotiate_end(self, name: str) -> None:
         self._emit("E", name, "")
+
+    def negotiate_cached(self, fused: bool = False) -> None:
+        """Instant marker for a cycle negotiated entirely through the
+        response-cache bitmask fast path — no per-tensor NEGOTIATE
+        span exists on such cycles, so this is the trace's evidence
+        of where negotiation time went (docs/performance.md).
+        ``fused`` marks the speculative single-round variant, where
+        the broadcast that followed this mark also carried the
+        world-reduced data."""
+        self._emit("i", "cycle",
+                   "NEGOTIATE_CACHED_FUSED" if fused
+                   else "NEGOTIATE_CACHED", s="g")
 
     # -- execution spans -------------------------------------------------
     def start(self, name: str, op_name: str) -> None:

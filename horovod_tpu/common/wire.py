@@ -38,8 +38,8 @@ from __future__ import annotations
 import struct
 
 from horovod_tpu.common.message import (
-    DataType, Request, RequestList, RequestType, Response, ResponseList,
-    ResponseType,
+    CacheCycleRequest, CacheCycleResponse, DataType, Request, RequestList,
+    RequestType, Response, ResponseList, ResponseType,
 )
 
 _U8 = struct.Struct("<B")
@@ -231,8 +231,9 @@ def serialize_response_list(rl: ResponseList) -> bytes:
     return w.bytes()
 
 
-def parse_response_list(data: bytes) -> ResponseList:
-    r = _Reader(data)
+def parse_response_list(data: bytes,
+                        offset: int = 0) -> ResponseList:
+    r = _Reader(data, offset)
     shutdown = bool(r.u8())
     tuned_cycle = r.f64()
     tuned_fusion = r.i64()
@@ -240,3 +241,240 @@ def parse_response_list(data: bytes) -> ResponseList:
     return ResponseList([_read_response(r) for _ in range(n)], shutdown,
                         tuned_cycle_time_ms=tuned_cycle,
                         tuned_fusion_threshold_bytes=tuned_fusion)
+
+
+# ---------------------------------------------------------------------------
+# Cycle frames — the per-cycle control payloads the runtime actually
+# moves. A one-byte kind prefix selects the legacy full encoding
+# (response cache disabled) or the cache-coherence framing:
+#
+#   CycleRequest  := u8 kind
+#     kind 0 FULL        : RequestList
+#     kind 1 CACHED      : u8 shutdown | u64 epoch | u32 nslots
+#                        | hit_mask[ceil(nslots/8)] | invalid_mask[...]
+#                        | u32 n | Request[n] (uncached remainder)
+#     kind 2 CACHED_AGG  : same layout as CACHED — an aggregate a local
+#                          root AND/OR-folded from its whole host, so
+#                          the coordinator sees ONE mask per host
+#                          instead of one frame per rank
+#     kind 3 CACHED_SPEC : u64 epoch | u32 nslots | hit_mask[...]
+#                        | segments — the fused speculative cycle: a
+#                          steady-state rank's pure-hit bitmask WITH
+#                          its pre-packed fused allreduce buffers
+#                          attached, so the grant round-trip and the
+#                          data-plane round-trip collapse into ONE
+#                          world synchronization
+#   CycleResponse := u8 kind
+#     kind 0 FULL        : ResponseList
+#     kind 1 CACHED      : u64 epoch | u32 nslots
+#                        | grant_mask[...] | invalid_mask[...]
+#                        | ResponseList (freshly negotiated remainder)
+#     kind 3 CACHED_SPEC : u64 epoch | u32 nslots | grant_mask[...]
+#                        | segments — the world-reduced fused buffers
+#                          (grant == every rank's identical hit mask)
+#
+#   segments := u32 nseg | nseg x (u8 dtype | u64 nbytes | raw bytes)
+#
+# Masks are little-endian fixed-width bit vectors, one bit per response
+# cache slot — a (non-speculative) steady-state cycle moves
+# O(capacity/8) bytes per rank; a speculative one additionally moves
+# exactly the fused tensor data the data plane would have moved anyway.
+
+FRAME_FULL = 0
+FRAME_CACHED = 1
+FRAME_CACHED_AGG = 2
+FRAME_CACHED_SPEC = 3
+CACHED_AGG_PREFIX = bytes((FRAME_CACHED_AGG,))
+# Relay envelope (NOT a cycle frame kind): a hierarchical local root
+# prefixes an UNFOLDED per-rank pack on the request tag with this
+# byte so the coordinator can distinguish it from a folded CACHED_AGG
+# frame without sniffing ambiguous bytes — a raw pack_frames blob
+# leads with its u32 frame count, and a 2-rank host's count byte is
+# exactly FRAME_CACHED_AGG.
+PACKED_PREFIX = b"\xfe"
+
+
+def _mask_nbytes(nslots: int) -> int:
+    return (nslots + 7) // 8
+
+
+def _write_mask(w: _Writer, mask: int, nslots: int) -> None:
+    w.parts.append(mask.to_bytes(_mask_nbytes(nslots), "little"))
+
+
+def _read_mask(r: _Reader, nslots: int) -> int:
+    n = _mask_nbytes(nslots)
+    mask = int.from_bytes(r.data[r.off:r.off + n], "little")
+    r.off += n
+    return mask
+
+
+def _write_segments(w: _Writer, segments) -> None:
+    """[(DataType, buffer), ...] — buffers are any contiguous
+    bytes-like (numpy arrays ride as zero-copy memoryviews)."""
+    w.u32(len(segments))
+    for dt, buf in segments:
+        view = memoryview(buf).cast("B")
+        w.u8(int(dt))
+        w.i64(view.nbytes)
+        w.parts.append(view)
+
+
+def _read_segments(r: _Reader):
+    """Zero-copy: segment buffers are memoryviews over the frame."""
+    view = memoryview(r.data)
+    segs = []
+    for _ in range(r.u32()):
+        dt = DataType(r.u8())
+        n = r.i64()
+        segs.append((dt, view[r.off:r.off + n]))
+        r.off += n
+    return segs
+
+
+def serialize_cycle_request(obj, aggregate: bool = False) -> bytes:
+    w = _Writer()
+    if isinstance(obj, RequestList):
+        w.u8(FRAME_FULL)
+        w.u8(1 if obj.shutdown else 0)
+        w.u32(len(obj.requests))
+        for req in obj.requests:
+            _write_request(w, req)
+        return w.bytes()
+    assert isinstance(obj, CacheCycleRequest)
+    if obj.spec_payload is not None:
+        w.u8(FRAME_CACHED_SPEC)
+        w.i64(obj.epoch)
+        w.u32(obj.nslots)
+        _write_mask(w, obj.hit_mask, obj.nslots)
+        _write_segments(w, obj.spec_payload)
+        return w.bytes()
+    w.u8(FRAME_CACHED_AGG if aggregate else FRAME_CACHED)
+    w.u8(1 if obj.shutdown else 0)
+    w.i64(obj.epoch)
+    w.u32(obj.nslots)
+    _write_mask(w, obj.hit_mask, obj.nslots)
+    _write_mask(w, obj.invalid_mask, obj.nslots)
+    w.u32(len(obj.requests))
+    for req in obj.requests:
+        _write_request(w, req)
+    return w.bytes()
+
+
+def parse_cycle_request(data: bytes):
+    """-> RequestList (kind FULL) or CacheCycleRequest (CACHED[_AGG])."""
+    r = _Reader(data)
+    kind = r.u8()
+    if kind == FRAME_FULL:
+        shutdown = bool(r.u8())
+        n = r.u32()
+        return RequestList([_read_request(r) for _ in range(n)],
+                           shutdown)
+    if kind == FRAME_CACHED_SPEC:
+        epoch = r.i64()
+        nslots = r.u32()
+        hit = _read_mask(r, nslots)
+        return CacheCycleRequest(epoch=epoch, nslots=nslots,
+                                 hit_mask=hit,
+                                 spec_payload=_read_segments(r))
+    if kind not in (FRAME_CACHED, FRAME_CACHED_AGG):
+        raise ConnectionError(f"unknown cycle-request kind {kind}")
+    shutdown = bool(r.u8())
+    epoch = r.i64()
+    nslots = r.u32()
+    hit = _read_mask(r, nslots)
+    invalid = _read_mask(r, nslots)
+    n = r.u32()
+    reqs = [_read_request(r) for _ in range(n)]
+    return CacheCycleRequest(epoch=epoch, nslots=nslots, hit_mask=hit,
+                             invalid_mask=invalid, requests=reqs,
+                             shutdown=shutdown)
+
+
+def serialize_cycle_response(obj) -> bytes:
+    if isinstance(obj, ResponseList):
+        return bytes((FRAME_FULL,)) + serialize_response_list(obj)
+    assert isinstance(obj, CacheCycleResponse)
+    w = _Writer()
+    if obj.spec_payload is not None:
+        w.u8(FRAME_CACHED_SPEC)
+        w.i64(obj.epoch)
+        w.u32(obj.nslots)
+        _write_mask(w, obj.grant_mask, obj.nslots)
+        _write_segments(w, obj.spec_payload)
+        return w.bytes()
+    w.u8(FRAME_CACHED)
+    w.i64(obj.epoch)
+    w.u32(obj.nslots)
+    _write_mask(w, obj.grant_mask, obj.nslots)
+    _write_mask(w, obj.invalid_mask, obj.nslots)
+    rl = obj.response_list
+    w.u8(1 if rl.shutdown else 0)
+    w.f64(rl.tuned_cycle_time_ms)
+    w.i64(rl.tuned_fusion_threshold_bytes)
+    w.u32(len(rl.responses))
+    for resp in rl.responses:
+        _write_response(w, resp)
+    return w.bytes()
+
+
+def parse_cycle_response(data: bytes):
+    """-> ResponseList (kind FULL) or CacheCycleResponse (CACHED)."""
+    r = _Reader(data)
+    kind = r.u8()
+    if kind == FRAME_FULL:
+        # offset, not data[1:]: slicing would copy the whole broadcast
+        # payload every cycle on cache-disabled worlds
+        return parse_response_list(data, offset=1)
+    if kind == FRAME_CACHED_SPEC:
+        epoch = r.i64()
+        nslots = r.u32()
+        grant = _read_mask(r, nslots)
+        return CacheCycleResponse(epoch=epoch, nslots=nslots,
+                                  grant_mask=grant,
+                                  spec_payload=_read_segments(r))
+    if kind != FRAME_CACHED:
+        raise ConnectionError(f"unknown cycle-response kind {kind}")
+    epoch = r.i64()
+    nslots = r.u32()
+    grant = _read_mask(r, nslots)
+    invalid = _read_mask(r, nslots)
+    shutdown = bool(r.u8())
+    tuned_cycle = r.f64()
+    tuned_fusion = r.i64()
+    n = r.u32()
+    rl = ResponseList([_read_response(r) for _ in range(n)], shutdown,
+                      tuned_cycle_time_ms=tuned_cycle,
+                      tuned_fusion_threshold_bytes=tuned_fusion)
+    return CacheCycleResponse(epoch=epoch, nslots=nslots,
+                              grant_mask=grant, invalid_mask=invalid,
+                              response_list=rl)
+
+
+def combine_cycle_requests(frames) -> "bytes | None":
+    """AND/OR-fold several ranks' cycle-request frames into one
+    CACHED_AGG frame — the bitmask reduction a hierarchical local root
+    applies before forwarding its host upward (hit masks AND, invalid
+    masks and the shutdown flag OR, uncached Requests concatenated;
+    every Request carries its rank, so attribution survives the fold).
+    Returns None when any frame is not cache-framed or the epochs /
+    slot counts disagree (divergence is the coordinator's to
+    diagnose — the relay then forwards the frames unfolded)."""
+    parsed = []
+    for f in frames:
+        if not f or f[0] not in (FRAME_CACHED, FRAME_CACHED_AGG):
+            return None
+        parsed.append(parse_cycle_request(f))
+    first = parsed[0]
+    combined = CacheCycleRequest(
+        epoch=first.epoch, nslots=first.nslots,
+        hit_mask=first.hit_mask, invalid_mask=first.invalid_mask,
+        requests=list(first.requests), shutdown=first.shutdown)
+    for cf in parsed[1:]:
+        if cf.epoch != first.epoch or cf.nslots != first.nslots:
+            return None
+        combined.hit_mask &= cf.hit_mask
+        combined.invalid_mask |= cf.invalid_mask
+        combined.shutdown = combined.shutdown or cf.shutdown
+        combined.requests.extend(cf.requests)
+    return serialize_cycle_request(combined, aggregate=True)

@@ -199,20 +199,20 @@ def grouped_allreduce_async(tensors, average: Optional[bool] = None,
         inspected.append((payload, ctx, device, dtype, shape, ready_fn))
 
     rt = basics.runtime()
-    handles, items = [], []
+    mark_done = rt.handle_manager.mark_done
+    handles = rt.handle_manager.allocate_many(len(inspected))
+    items = []
     for i, (payload, ctx, device, dtype, shape,
             ready_fn) in enumerate(inspected):
-        handle = rt.handle_manager.allocate()
         entry = TensorTableEntry(tensor_name=f"{name}.{i}",
                                  tensor=payload, root_rank=-1,
                                  device=device, ready_fn=ready_fn,
                                  context=ctx)
 
-        def callback(status, entry=entry, handle=handle):
-            rt.handle_manager.mark_done(handle, status, entry.output)
+        def callback(status, entry=entry, handle=handles[i]):
+            mark_done(handle, status, entry.output)
 
         entry.callback = callback
-        handles.append(handle)
         items.append((entry, dtype, shape))
 
     status = rt.enqueue_group(RequestType.ALLREDUCE, items,

@@ -58,6 +58,15 @@ class CollectiveBackend:
                 response: Response) -> bool:
         raise NotImplementedError
 
+    def fused_cycle_reducible(self, nbytes: int) -> bool:
+        """True when a fused allreduce of ``nbytes`` would ride a
+        star through the coordinator's control channels anyway — the
+        precondition for the speculative fused cycle (runtime.py) to
+        piggyback the payload on the negotiation round. Planes with
+        their own transport (shm, ring, XLA mesh) say False so
+        speculation never steals a batch from a faster data plane."""
+        return False
+
     def execute_allreduce(self, entries, response) -> Status:
         raise NotImplementedError
 

@@ -54,6 +54,14 @@ class OperationManager:
             f"No collective backend enabled for response "
             f"{response.response_type.name} ({response.tensor_names})")
 
+    def pick(self, entries: List[TensorTableEntry],
+             response: Response) -> CollectiveBackend:
+        """The backend that WOULD execute this batch — the runtime's
+        speculative fused cycle probes it (fused_cycle_reducible)
+        before deciding to piggyback the payload on the negotiation
+        round instead of dispatching here."""
+        return self._pick(entries, response)
+
     def execute(self, entries: List[TensorTableEntry],
                 response: Response) -> Status:
         backend = self._pick(entries, response)

@@ -174,6 +174,15 @@ class SocketBackend(CollectiveBackend):
     def enabled(self, entries, response) -> bool:
         return self._ctl.size > 1
 
+    def fused_cycle_reducible(self, nbytes: int) -> bool:
+        """Star-bound batches (below the ring threshold) already move
+        through the coordinator's channels — exactly what the
+        speculative fused cycle inlines. Mirrors _ring_for's routing
+        WITHOUT establishing the ring (a probe must stay passive)."""
+        return self._ctl.size > 1 and (
+            self._ring_threshold < 0 or nbytes < self._ring_threshold
+            or self._ctl.size < 3)
+
     def close(self) -> None:
         if self._ring is not None:
             self._ring.close()

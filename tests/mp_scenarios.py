@@ -652,6 +652,288 @@ def scenario_coordinator_fuzz(hvd, rank, size):
         check(job, hvd.synchronize(h))
 
 
+def _cache_runtime_stats(hvd):
+    from horovod_tpu.common import basics as _b
+    return _b.runtime().negotiation_cache_stats()
+
+
+def _cache_fingerprint_crc(hvd) -> int:
+    """CRC of the response cache's world-coherent state (slot map, LRU
+    order, epoch) — allgathered across ranks to prove the caches
+    marched in lockstep (Python hash() is process-seeded, crc32 is
+    not)."""
+    import zlib
+    from horovod_tpu.common import basics as _b
+    cache = _b.runtime()._cache
+    return zlib.crc32(repr(cache.state_fingerprint()).encode())
+
+
+def _assert_cache_coherent(hvd, rank, size, tag):
+    """Every rank's cache fingerprint must be identical right now."""
+    fp = _cache_fingerprint_crc(hvd)
+    got = np.asarray(hvd.allgather(
+        np.asarray([[fp]], np.int64), name=f"{tag}.fp"))
+    assert (got == fp).all(), \
+        f"rank {rank}: cache state diverged across ranks: {got.ravel()}"
+
+
+def scenario_response_cache_steady(hvd, rank, size):
+    """The steady-state negotiation fast path, end to end: a training-
+    shaped loop resubmitting the same tensor set must (a) return exact
+    values every step, (b) negotiate via the bitmask path (hit rate
+    ~100%, fully cached cycles observed), (c) keep the cache state
+    bit-identical across every rank, (d) invalidate coherently on
+    shape and dtype changes and renegotiate exactly, and (e) survive
+    skewed submission (a rank holding back a cached tensor: the
+    others' hits stay queued un-granted until the straggler arrives)."""
+    import time
+    from horovod_tpu.common import basics as _b
+
+    ssum = sum(range(1, size + 1))
+    names = [f"rc.{i}" for i in range(8)]
+    xs = [np.full(64 + i, float(rank + 1) * (i + 1), np.float64)
+          for i in range(8)]
+
+    def step(check=True):
+        hs = [hvd.allreduce_async(x, average=False, name=nm)
+              for x, nm in zip(xs, names)]
+        for i, h in enumerate(hs):
+            out = hvd.synchronize(h)
+            if check:
+                np.testing.assert_allclose(out, ssum * (i + 1.0))
+
+    for _ in range(3):
+        step()
+    hvd.barrier(name="rc.bar")
+    s0 = _cache_runtime_stats(hvd)
+    assert s0["enabled"], "cache must be on by default"
+    for _ in range(30):
+        step()
+    s1 = _cache_runtime_stats(hvd)
+    d_hits = s1["hits"] - s0["hits"]
+    d_misses = s1["misses"] - s0["misses"]
+    rate = d_hits / max(1, d_hits + d_misses)
+    assert rate >= 0.99, (rank, d_hits, d_misses, rate)
+    assert s1["cached_cycles"] > s0["cached_cycles"], (rank, s0, s1)
+    if os.environ.get("HOROVOD_TPU_SHM") == "0" \
+            and os.environ.get("HOROVOD_CACHE_SPECULATIVE", "1") != "0":
+        # Socket star data plane: the steady allreduce set must ride
+        # the fused speculative round (shm/ring-bound batches keep
+        # their own plane and legitimately never speculate).
+        assert s1["spec_cycles"] > s0["spec_cycles"], (rank, s0, s1)
+    _assert_cache_coherent(hvd, rank, size, "rc.a")
+
+    # (d) SHAPE change: same names, new shapes -> slot invalidated on
+    # every rank, renegotiated exactly, then hits resume
+    xs = [np.full((3, 32 + i), float(rank + 1) * (i + 1), np.float64)
+          for i in range(8)]
+    step()
+    _assert_cache_coherent(hvd, rank, size, "rc.b")
+    s2 = _cache_runtime_stats(hvd)
+    step()
+    s3 = _cache_runtime_stats(hvd)
+    assert s3["hits"] - s2["hits"] >= 8, (rank, s2, s3)  # hits resumed
+
+    # DTYPE change on one tensor: only that slot invalidates
+    xs[0] = np.full((3, 32), float(rank + 1), np.float32)
+    step()
+    _assert_cache_coherent(hvd, rank, size, "rc.c")
+    step()
+
+    # (e) skewed submission: every rank submits the cached rc.0 but
+    # rank size-1 holds back for a while -- the others' hit bits stay
+    # queued (requeued each cycle, never granted) until it arrives
+    if rank == size - 1:
+        time.sleep(0.4)
+    out = hvd.allreduce(xs[0], average=False, name=names[0])
+    np.testing.assert_allclose(np.asarray(out, np.float64), ssum * 1.0)
+    _assert_cache_coherent(hvd, rank, size, "rc.d")
+
+    # the world is fully usable afterwards (fresh names, full path)
+    out = hvd.allreduce(np.full(5, float(rank + 1), np.float32),
+                        average=False, name="rc.fresh")
+    np.testing.assert_allclose(out, ssum)
+
+
+def scenario_response_cache_hetero_spec(hvd, rank, size):
+    """HOROVOD_CACHE_SPECULATIVE disagreeing across ranks (rank 1 has
+    it off — set by the pytest wrapper) must stay CORRECT: speculation
+    is per-cycle opportunistic, so the coordinator simply never sees a
+    unanimous speculative cycle and every step rides the classic
+    two-round cached path. Values stay exact, hits still accrue, and
+    no rank ever completes a fused speculative cycle."""
+    ssum = sum(range(1, size + 1))
+    xs = [np.full(32, float(rank + 1) * (i + 1), np.float64)
+          for i in range(6)]
+    for _ in range(20):
+        hs = [hvd.allreduce_async(x, average=False, name=f"hs.{i}")
+              for i, x in enumerate(xs)]
+        for i, h in enumerate(hs):
+            np.testing.assert_allclose(hvd.synchronize(h),
+                                       ssum * (i + 1.0))
+    stats = _cache_runtime_stats(hvd)
+    assert stats["cached_cycles"] > 0, (rank, stats)
+    assert stats["spec_cycles"] == 0, (rank, stats)
+    # and the spec-on ranks UNLEARN: after a few classically-answered
+    # full grants the mask stops bidding, so the steady state is not
+    # paying a wasted fused payload every cycle forever
+    assert stats["spec_bids"] <= 8, (rank, stats)
+    _assert_cache_coherent(hvd, rank, size, "hs.fp")
+
+
+def scenario_response_cache_eviction(hvd, rank, size):
+    """Capacity eviction under a tiny HOROVOD_CACHE_CAPACITY (set by
+    the pytest wrapper): cycling through more distinct tensors than
+    slots keeps evicting in LRU order — on every rank identically —
+    and values stay exact throughout, including when an evicted name
+    comes back (miss -> full renegotiation -> re-cached)."""
+    cap = int(os.environ["HOROVOD_CACHE_CAPACITY"])
+    ssum = sum(range(1, size + 1))
+    n_names = cap * 3
+    for wave in range(3):
+        for i in range(n_names):
+            out = hvd.allreduce(
+                np.full(16, float(rank + 1) * (i + 1), np.float64),
+                average=False, name=f"ev.{i}")
+            np.testing.assert_allclose(out, ssum * (i + 1.0))
+        _assert_cache_coherent(hvd, rank, size, f"ev.fp{wave}")
+    stats = _cache_runtime_stats(hvd)
+    assert stats["entries"] <= cap, stats
+    # steady reuse of a WORKING set under capacity still gets hits
+    s0 = _cache_runtime_stats(hvd)
+    for _ in range(10):
+        for i in range(max(1, cap // 2)):
+            hvd.allreduce(np.full(8, float(rank + 1), np.float64),
+                          average=False, name=f"ws.{i}")
+    s1 = _cache_runtime_stats(hvd)
+    assert s1["hits"] > s0["hits"], (s0, s1)
+    _assert_cache_coherent(hvd, rank, size, "ev.fin")
+
+
+def scenario_abort_sigkill_cached(hvd, rank, size):
+    """SIGKILL a rank squarely mid-CACHED-cycle: fault injection fires
+    at an op index reached deep in bitmask steady state, so the
+    survivors are blocked in a bits-frame gather when the victim dies.
+    They must still raise WorldAbortedError naming the dead rank within
+    the heartbeat deadline (the PR 2 fail-fast invariant holds on the
+    fast path), and handles enqueued afterwards must fail the same
+    structured way."""
+    import time
+    from horovod_tpu.common.status import WorldAbortedError
+
+    victim = 1
+    deadline = float(os.environ["HOROVOD_HEARTBEAT_TIMEOUT"]) + 12.0
+    x = np.full(64, float(rank + 1), np.float32)
+    t0 = time.monotonic()
+    i = 0
+    aborted = None
+    while True:
+        try:
+            # SAME name every iteration: after the first op the cycle
+            # is pure bitmask — the fault (op=40) lands mid-fast-path
+            hvd.allreduce(x, average=False, name="ck.steady")
+        except WorldAbortedError as e:
+            aborted = e
+            break
+        i += 1
+        assert time.monotonic() - t0 < deadline, (
+            f"rank {rank}: collectives kept succeeding {deadline}s "
+            f"after the fault")
+    assert aborted.origin_rank == victim, (rank, str(aborted))
+    assert f"rank {victim}" in str(aborted), str(aborted)
+    assert time.monotonic() - t0 < deadline
+    # the kill really did land in cached steady state
+    stats = _cache_runtime_stats(hvd)
+    assert stats["cached_cycles"] >= 10, stats
+    try:
+        hvd.allreduce(x, average=False, name="ck.post")
+        raise AssertionError("enqueue after world abort must fail")
+    except WorldAbortedError as e:
+        assert e.origin_rank == victim, str(e)
+    hvd.shutdown()
+
+
+def scenario_cache_byte_budget(hvd, rank, size):
+    """Control-plane byte-budget regression guard: in bitmask steady
+    state a cycle must move O(capacity/8) control bytes per rank —
+    asserted through a counting wrapper on Channel.send/recv that
+    tallies ONLY the control tags (TAG_REQUESTS/TAG_RESPONSES; data
+    payloads and PINGs ride other tags). A regression that quietly
+    re-serializes Request lists every cycle trips the per-cycle
+    budget by an order of magnitude. The pytest wrapper disables
+    HOROVOD_CACHE_SPECULATIVE: fused speculative frames deliberately
+    carry the batch's tensor data on the request tag (that is the
+    point — one round for grant AND data), so the mask-path budget is
+    only measurable with speculation off."""
+    from horovod_tpu.common import controller as _ctl
+    from horovod_tpu.common import network as _net
+
+    counts = {"bytes": 0}
+    ctrl_tags = (_ctl.TAG_REQUESTS, _ctl.TAG_RESPONSES)
+    orig_send, orig_recv = _net.Channel.send, _net.Channel.recv
+
+    def send(self, payload, tag=0):
+        if tag in ctrl_tags:
+            counts["bytes"] += len(_net.as_byte_view(payload))
+        return orig_send(self, payload, tag)
+
+    def recv(self):
+        tag, data = orig_recv(self)
+        if tag in ctrl_tags:
+            counts["bytes"] += len(data)
+        return tag, data
+
+    _net.Channel.send, _net.Channel.recv = send, recv
+    hvd.init()
+    from horovod_tpu.common import basics as _b
+    rt = _b.runtime()
+
+    capacity = int(os.environ["HOROVOD_CACHE_CAPACITY"])
+    ssum = sum(range(1, size + 1))
+    names = [f"bb.{i}" for i in range(16)]
+    xs = [np.full(64, float(rank + 1) * (i + 1), np.float64)
+          for i in range(16)]
+
+    def step():
+        hs = [hvd.allreduce_async(x, average=False, name=nm)
+              for x, nm in zip(xs, names)]
+        for h in hs:
+            hvd.synchronize(h)
+
+    for _ in range(5):
+        step()
+    hvd.barrier(name="bb.bar")
+    bytes0, cycles0 = counts["bytes"], rt._cycle_count
+    for _ in range(50):
+        step()
+    bytes1, cycles1 = counts["bytes"], rt._cycle_count
+    stats = rt.negotiation_cache_stats()
+    d_cycles = max(1, cycles1 - cycles0)
+    per_cycle = (bytes1 - bytes0) / d_cycles
+    # Worker budget: one bitmask request frame + one bitmask response
+    # frame per cycle — two masks each plus fixed headers. The full
+    # path for 16 tensors moves well over 1 KB per cycle.
+    budget = 2 * ((capacity + 7) // 8) + 160
+    if rank != 0:
+        # rank 0's per-cycle frames ride the native fan-out, not
+        # Channel.send/recv — the budget is asserted on workers, whose
+        # Python channel is the steady-state path being guarded.
+        assert per_cycle <= budget, (
+            f"rank {rank}: steady-state control plane moved "
+            f"{per_cycle:.0f} B/cycle (budget {budget} B with "
+            f"HOROVOD_CACHE_CAPACITY={capacity}) — fast-path "
+            f"regression")
+    assert stats["hit_rate"] >= 0.95, stats
+    # correctness spot check after all the counting
+    out = hvd.allreduce(np.full(8, float(rank + 1), np.float64),
+                        average=False, name="bb.check")
+    np.testing.assert_allclose(out, ssum)
+    _net.Channel.send, _net.Channel.recv = orig_send, orig_recv
+
+
+scenario_cache_byte_budget.no_auto_init = True
+
+
 def scenario_kitchen_sink(hvd, rank, size):
     """Every auxiliary subsystem enabled at once — autotune (+log),
     timeline (+cycle marks), hierarchical shm over a fake 2-host

@@ -388,6 +388,91 @@ def test_coordinator_fuzz(plane, ranks):
                  extra_env=extra)
 
 
+@pytest.mark.parametrize("plane", ["shm", "socket"])
+def test_response_cache_steady_state(plane):
+    """Steady-state traffic negotiates through the bitmask fast path
+    (hit rate ~100%, fully cached cycles observed), stays exact, keeps
+    the cache bit-identical across ranks, and invalidates coherently
+    on shape/dtype changes and skewed submission."""
+    extra = {} if plane == "shm" else {"HOROVOD_TPU_SHM": "0"}
+    run_scenario("response_cache_steady", 3, timeout=120.0,
+                 extra_env=extra)
+
+
+def test_response_cache_steady_state_hier_controller():
+    """Same steady-state contract with the hit bitmasks AND-reduced at
+    each fake host's local root before reaching the coordinator (the
+    CACHED_AGG fold in the gather tree)."""
+    run_scenario(
+        "response_cache_steady", 4, timeout=180.0,
+        per_rank_env=lambda rank: {
+            "HOROVOD_HOSTNAME": f"fakehost{rank // 2}"})
+
+
+def test_response_cache_capacity_eviction_coherent():
+    """A tiny capacity forces constant LRU eviction; the eviction order
+    (and thus slot reuse) must be world-identical and values exact —
+    including names that come back after being evicted."""
+    run_scenario("response_cache_eviction", 3, timeout=180.0,
+                 extra_env={"HOROVOD_CACHE_CAPACITY": "8"})
+
+
+def test_response_cache_disabled_via_env():
+    """HOROVOD_CACHE_ENABLED=0 falls back to full negotiation on every
+    rank (homogeneous) and the whole collective mix stays exact."""
+    run_scenario("mixed_op_storm", 3, timeout=120.0,
+                 extra_env={"HOROVOD_CACHE_ENABLED": "0"})
+
+
+def test_response_cache_disabled_hier_two_rank_host():
+    """Cache off + a 2-rank remote host: the local root relays an
+    UNFOLDED per-rank pack on the request tag, whose raw leading
+    byte (the u32 frame count, 2) collides with the CACHED_AGG kind —
+    the PACKED envelope must disambiguate (regression: the coordinator
+    once sniffed the count byte as a folded cache frame and aborted
+    with a spurious divergence error)."""
+    run_scenario(
+        "mixed_op_storm", 4, timeout=180.0,
+        extra_env={"HOROVOD_CACHE_ENABLED": "0"},
+        per_rank_env=lambda rank: {
+            "HOROVOD_HOSTNAME": f"fakehost{rank // 2}"})
+
+
+def test_response_cache_spec_hier_two_rank_host():
+    """Speculative fused frames through a 2-rank remote host: payload
+    frames cannot be mask-folded, so the root forwards them under the
+    PACKED envelope and the coordinator still reduces the unanimous
+    cycle inline — steady state, exact values, coherent caches."""
+    run_scenario(
+        "response_cache_steady", 4, timeout=180.0,
+        extra_env={"HOROVOD_TPU_SHM": "0"},
+        per_rank_env=lambda rank: {
+            "HOROVOD_HOSTNAME": f"fakehost{rank // 2}"})
+
+
+def test_cache_control_plane_byte_budget():
+    """Steady-state cycles must move O(capacity/8) control bytes per
+    rank — a counting wrapper on Channel send/recv asserts the
+    per-cycle budget on a worker rank at world_size=4 (speculative
+    fused frames carry tensor data on the request tag by design, so
+    they are disabled to expose the mask-path budget)."""
+    run_scenario("cache_byte_budget", 4, timeout=180.0,
+                 extra_env={"HOROVOD_CACHE_CAPACITY": "256",
+                            "HOROVOD_CACHE_SPECULATIVE": "0"})
+
+
+def test_response_cache_heterogeneous_speculation_knob():
+    """HOROVOD_CACHE_SPECULATIVE off on ONE rank only: the fused
+    single-round path requires unanimity per cycle, so the world
+    falls back to the classic two-round cached path everywhere —
+    correct results, zero completed speculative cycles."""
+    run_scenario(
+        "response_cache_hetero_spec", 3, timeout=120.0,
+        extra_env={"HOROVOD_TPU_SHM": "0"},
+        per_rank_env=lambda rank: (
+            {"HOROVOD_CACHE_SPECULATIVE": "0"} if rank == 1 else {}))
+
+
 def test_kitchen_sink_all_subsystems(tmp_path):
     """Cross-subsystem integration: autotune (+log), timeline (+cycle
     marks), hierarchical shm over a fake 2-host topology, and the stall
@@ -545,6 +630,19 @@ def test_abort_sigkill_coordinator():
         extra_env={**_HB_ENV,
                    "HOROVOD_FAULT_SPEC": "rank=0:kill:op=3"},
         expect_rc={0: _SIGKILL_RC})
+
+
+def test_abort_sigkill_mid_cached_cycle():
+    """SIGKILL rank 1 deep in bitmask steady state (op=40 of a
+    single-tensor loop is long past warmup): the survivors are blocked
+    in a bits-frame gather when the victim dies, and must still raise
+    WorldAbortedError naming rank 1 within the heartbeat deadline —
+    the PR 2 fail-fast invariant holds on the negotiation fast path."""
+    run_scenario(
+        "abort_sigkill_cached", 3, timeout=60.0,
+        extra_env={**_HB_ENV,
+                   "HOROVOD_FAULT_SPEC": "rank=1:kill:op=40"},
+        expect_rc={1: _SIGKILL_RC})
 
 
 def test_abort_heartbeat_detects_silent_hang():

@@ -1,0 +1,358 @@
+"""Unit tests for the steady-state negotiation fast path: the
+world-coherent ResponseCache (slot assignment, LRU eviction,
+invalidation), the cache-coherence wire frames, and the runtime's
+unfuse/replay helpers. Cross-rank coherence is modeled by feeding two
+cache instances the SAME world-identical event stream with DIFFERENT
+rank-local signatures (device ids, allgather dim-0) and asserting their
+coherent state fingerprints stay bit-identical — the invariant the
+bitmask protocol stands on. End-to-end multi-process coverage lives in
+tests/test_multiprocess.py (response_cache_* and cache_byte_budget)."""
+
+import pytest
+
+from horovod_tpu.common import wire
+from horovod_tpu.common.coordinator import ResponseCache, fuse_responses
+from horovod_tpu.common.message import (
+    CacheCycleRequest, CacheCycleResponse, DataType, Request, RequestList,
+    RequestType, Response, ResponseList, ResponseType,
+)
+
+
+def _req(name, rank=0, shape=(4,), dtype=DataType.FLOAT32, device=-1,
+         op=RequestType.ALLREDUCE, root=-1):
+    return Request(request_rank=rank, request_type=op, tensor_type=dtype,
+                   tensor_name=name, root_rank=root, device=device,
+                   tensor_shape=shape)
+
+
+def _resp(name, numel=4, devices=(-1, -1)):
+    return Response(response_type=ResponseType.ALLREDUCE,
+                    tensor_names=[name], devices=list(devices),
+                    tensor_sizes=[numel])
+
+
+def _put(cache, name, req=None, resp=None):
+    req = req or _req(name)
+    cache.put(name, ResponseCache.signature(req), resp or _resp(name),
+              req.tensor_type, 1)
+
+
+class TestResponseCache:
+    def test_lookup_states(self):
+        c = ResponseCache(4)
+        assert c.lookup(_req("g"))[0] == ResponseCache.MISS
+        _put(c, "g")
+        state, slot = c.lookup(_req("g"))
+        assert state == ResponseCache.HIT and slot == 0
+        # shape change -> INVALID, same slot reported for eviction
+        state, slot = c.lookup(_req("g", shape=(8,)))
+        assert state == ResponseCache.INVALID and slot == 0
+        # dtype change -> INVALID too
+        state, _ = c.lookup(_req("g", dtype=DataType.FLOAT64))
+        assert state == ResponseCache.INVALID
+        assert c.hits == 1 and c.misses == 3
+
+    def test_lru_capacity_eviction_and_slot_reuse(self):
+        c = ResponseCache(2)
+        _put(c, "a")
+        _put(c, "b")
+        _put(c, "c")  # evicts a (LRU), reuses its slot 0
+        assert c.lookup(_req("a"))[0] == ResponseCache.MISS
+        assert c.lookup(_req("c")) == (ResponseCache.HIT, 0)
+        assert c.lookup(_req("b")) == (ResponseCache.HIT, 1)
+
+    def test_touch_steers_eviction_order(self):
+        c = ResponseCache(2)
+        _put(c, "a")
+        _put(c, "b")
+        c.touch_mask(0b01)  # a is now most-recently-used
+        _put(c, "c")        # so b gets evicted, not a
+        assert c.lookup(_req("b"))[0] == ResponseCache.MISS
+        assert c.lookup(_req("a"))[0] == ResponseCache.HIT
+
+    def test_touch_does_not_bump_epoch(self):
+        """Hit cycles must not invalidate steady-state replay plans:
+        only structural mutations (puts/evictions) move the epoch."""
+        c = ResponseCache(4)
+        _put(c, "a")
+        e = c.epoch
+        c.touch_mask(0b1)
+        assert c.epoch == e
+
+    def test_evict_slots_mask_ascending(self):
+        c = ResponseCache(4)
+        for n in "abcd":
+            _put(c, n)
+        c.evict_slots(0b0101)  # slots 0 and 2 -> a and c
+        assert c.lookup(_req("a"))[0] == ResponseCache.MISS
+        assert c.lookup(_req("c"))[0] == ResponseCache.MISS
+        assert c.lookup(_req("b"))[0] == ResponseCache.HIT
+        # freed slots are reused lowest-first — deterministically
+        _put(c, "e")
+        assert c.lookup(_req("e")) == (ResponseCache.HIT, 0)
+
+    def test_two_ranks_march_in_lockstep(self):
+        """The coherence contract: identical event streams with
+        DIFFERENT rank-local signatures (device ids, allgather dim-0)
+        must leave the coherent state — slot map, LRU order, epoch —
+        bit-identical. This is what lets a slot bit stand in for a
+        serialized Request."""
+        r0, r1 = ResponseCache(3), ResponseCache(3)
+        names = ["g0", "g1", "g2", "g3", "g0", "g4"]
+        for i, n in enumerate(names):
+            resp = _resp(n)
+            # rank 0 submits on device 0, rank 1 on device 1, and their
+            # allgather-ish shapes differ — signatures are local-only
+            r0.put(n, ResponseCache.signature(
+                _req(n, rank=0, device=0, shape=(i + 1, 4))),
+                resp, DataType.FLOAT32, 4)
+            r1.put(n, ResponseCache.signature(
+                _req(n, rank=1, device=1, shape=(2 * i + 1, 4))),
+                resp, DataType.FLOAT32, 4)
+            assert r0.state_fingerprint() == r1.state_fingerprint()
+        # mask-driven events stay coherent too
+        r0.touch_mask(0b011)
+        r1.touch_mask(0b011)
+        r0.evict_slots(0b010)
+        r1.evict_slots(0b010)
+        assert r0.state_fingerprint() == r1.state_fingerprint()
+
+
+class TestCycleFrames:
+    def test_full_request_round_trip(self):
+        rl = RequestList([_req("a"), _req("b", rank=3)], shutdown=True)
+        out = wire.parse_cycle_request(wire.serialize_cycle_request(rl))
+        assert isinstance(out, RequestList) and out == rl
+
+    def test_cached_request_round_trip(self):
+        cf = CacheCycleRequest(epoch=42, nslots=19, hit_mask=0b1011,
+                               invalid_mask=1 << 17,
+                               requests=[_req("u", rank=2)],
+                               shutdown=True)
+        out = wire.parse_cycle_request(wire.serialize_cycle_request(cf))
+        assert isinstance(out, CacheCycleRequest) and out == cf
+
+    def test_cached_request_frame_is_capacity_bounded(self):
+        """The steady-state frame is O(nslots/8) bytes — the whole
+        point of the fast path (the byte-budget mp test asserts the
+        live world's traffic; this pins the encoding itself)."""
+        cf = CacheCycleRequest(epoch=1, nslots=1024,
+                               hit_mask=(1 << 1024) - 1,
+                               invalid_mask=0, requests=[])
+        frame = wire.serialize_cycle_request(cf)
+        assert len(frame) <= 2 * (1024 // 8) + 32, len(frame)
+
+    def test_full_response_round_trip(self):
+        rl = ResponseList([_resp("a")], shutdown=False,
+                          tuned_cycle_time_ms=2.0,
+                          tuned_fusion_threshold_bytes=4096)
+        out = wire.parse_cycle_response(
+            wire.serialize_cycle_response(rl))
+        assert isinstance(out, ResponseList) and out == rl
+
+    def test_cached_response_round_trip(self):
+        cr = CacheCycleResponse(
+            epoch=7, nslots=9, grant_mask=0b101, invalid_mask=0b10,
+            response_list=ResponseList([_resp("n")], shutdown=True,
+                                       tuned_cycle_time_ms=1.5,
+                                       tuned_fusion_threshold_bytes=64))
+        out = wire.parse_cycle_response(
+            wire.serialize_cycle_response(cr))
+        assert isinstance(out, CacheCycleResponse) and out == cr
+
+    def test_combine_folds_masks_and_concats_requests(self):
+        a = wire.serialize_cycle_request(CacheCycleRequest(
+            epoch=5, nslots=8, hit_mask=0b0111, invalid_mask=0b1000,
+            requests=[_req("x", rank=1)]))
+        b = wire.serialize_cycle_request(CacheCycleRequest(
+            epoch=5, nslots=8, hit_mask=0b1101, invalid_mask=0b0010,
+            requests=[_req("y", rank=2)], shutdown=True))
+        combined = wire.combine_cycle_requests([a, b])
+        assert combined is not None
+        assert combined[0] == wire.FRAME_CACHED_AGG
+        out = wire.parse_cycle_request(combined)
+        assert out.hit_mask == 0b0101       # AND
+        assert out.invalid_mask == 0b1010   # OR
+        assert out.shutdown is True         # OR
+        assert [r.tensor_name for r in out.requests] == ["x", "y"]
+        assert [r.request_rank for r in out.requests] == [1, 2]
+
+    def test_combine_is_associative_through_agg_frames(self):
+        """A root's CACHED_AGG output can itself be folded again
+        upstream (deeper trees)."""
+        frames = [wire.serialize_cycle_request(CacheCycleRequest(
+            epoch=1, nslots=4, hit_mask=m, invalid_mask=0,
+            requests=[])) for m in (0b1111, 0b1110, 0b1011)]
+        once = wire.combine_cycle_requests(frames[:2])
+        twice = wire.combine_cycle_requests([once, frames[2]])
+        assert wire.parse_cycle_request(twice).hit_mask == 0b1010
+
+    def test_spec_request_round_trip(self):
+        import numpy as np
+        seg = [(DataType.FLOAT64, np.arange(8, dtype=np.float64)),
+               (DataType.FLOAT32, np.ones(3, dtype=np.float32))]
+        cf = CacheCycleRequest(epoch=3, nslots=9, hit_mask=0b101,
+                               spec_payload=seg)
+        frame = wire.serialize_cycle_request(cf)
+        assert frame[0] == wire.FRAME_CACHED_SPEC
+        out = wire.parse_cycle_request(frame)
+        assert isinstance(out, CacheCycleRequest)
+        assert out.hit_mask == 0b101 and out.epoch == 3
+        assert out.requests == [] and not out.shutdown
+        (d0, b0), (d1, b1) = out.spec_payload
+        assert d0 == DataType.FLOAT64 and d1 == DataType.FLOAT32
+        np.testing.assert_array_equal(
+            np.frombuffer(b0, np.float64), np.arange(8.0))
+        np.testing.assert_array_equal(
+            np.frombuffer(b1, np.float32), np.ones(3, np.float32))
+
+    def test_spec_response_round_trip(self):
+        import numpy as np
+        seg = [(DataType.FLOAT64, np.full(4, 36.0))]
+        cr = CacheCycleResponse(epoch=7, nslots=5, grant_mask=0b11,
+                                spec_payload=seg)
+        out = wire.parse_cycle_response(
+            wire.serialize_cycle_response(cr))
+        assert isinstance(out, CacheCycleResponse)
+        assert out.grant_mask == 0b11 and out.epoch == 7
+        assert out.response_list.responses == []
+        np.testing.assert_array_equal(
+            np.frombuffer(out.spec_payload[0][1], np.float64),
+            np.full(4, 36.0))
+
+    def test_combine_refuses_spec_frames(self):
+        """A local root must never mask-fold frames carrying fused
+        payloads — the coordinator reduces them (the relay forwards
+        them per-rank instead)."""
+        import numpy as np
+        spec = wire.serialize_cycle_request(CacheCycleRequest(
+            epoch=1, nslots=4, hit_mask=0b1,
+            spec_payload=[(DataType.FLOAT64,
+                           np.ones(2, np.float64))]))
+        plain = wire.serialize_cycle_request(CacheCycleRequest(
+            epoch=1, nslots=4, hit_mask=0b1, invalid_mask=0,
+            requests=[]))
+        assert wire.combine_cycle_requests([spec, plain]) is None
+        assert wire.combine_cycle_requests([spec, spec]) is None
+
+    def test_reduce_spec_sums_ranks(self):
+        import numpy as np
+
+        from horovod_tpu.common.runtime import Runtime
+        frames = [CacheCycleRequest(
+            epoch=0, nslots=2, hit_mask=0b11,
+            spec_payload=[(DataType.FLOAT64,
+                           memoryview(np.full(4, float(r + 1))))])
+            for r in range(3)]
+        out = Runtime._reduce_spec(frames)
+        assert out[0][0] == DataType.FLOAT64
+        np.testing.assert_array_equal(out[0][1], np.full(4, 6.0))
+
+    def test_reduce_spec_rejects_layout_divergence(self):
+        import numpy as np
+
+        from horovod_tpu.common.runtime import Runtime
+        a = CacheCycleRequest(epoch=0, nslots=1, hit_mask=1,
+                              spec_payload=[(DataType.FLOAT64,
+                                             memoryview(np.ones(4)))])
+        b = CacheCycleRequest(epoch=0, nslots=1, hit_mask=1,
+                              spec_payload=[(DataType.FLOAT64,
+                                             memoryview(np.ones(5)))])
+        with pytest.raises(ConnectionError):
+            Runtime._reduce_spec([a, b])
+
+    def test_combine_refuses_mixed_or_diverged_frames(self):
+        cached = wire.serialize_cycle_request(CacheCycleRequest(
+            epoch=1, nslots=4, hit_mask=0b1, invalid_mask=0,
+            requests=[]))
+        full = wire.serialize_cycle_request(RequestList([]))
+        assert wire.combine_cycle_requests([cached, full]) is None
+        other_epoch = wire.serialize_cycle_request(CacheCycleRequest(
+            epoch=2, nslots=4, hit_mask=0b1, invalid_mask=0,
+            requests=[]))
+        assert wire.combine_cycle_requests(
+            [cached, other_epoch]) is None
+
+
+class TestReplay:
+    def _runtime_shell(self):
+        """A bare object exposing just what _unfuse/_replay_grants
+        need — keeps these tests transport-free."""
+        from horovod_tpu.common.runtime import Runtime
+        return Runtime.__new__(Runtime)
+
+    def test_unfuse_fused_allreduce(self):
+        from horovod_tpu.common.runtime import Runtime
+        fused = Response(response_type=ResponseType.ALLREDUCE,
+                         tensor_names=["a", "b"], devices=[-1, -1],
+                         tensor_sizes=[10, 20], prescale_factor=0.5)
+        one = Runtime._unfuse(fused, 1, world_size=2)
+        assert one.tensor_names == ["b"]
+        assert one.tensor_sizes == [20]
+        assert one.prescale_factor == 0.5
+        assert one.devices == [-1, -1]
+
+    def test_unfuse_fused_allgather_entry_major(self):
+        from horovod_tpu.common.runtime import Runtime
+        # 2 entries x 3 ranks, entry-major sizes
+        fused = Response(response_type=ResponseType.ALLGATHER,
+                         tensor_names=["g1", "g2"],
+                         devices=[-1, -1, -1],
+                         tensor_sizes=[3, 4, 5, 1, 1, 1])
+        assert Runtime._unfuse(fused, 0, 3).tensor_sizes == [3, 4, 5]
+        assert Runtime._unfuse(fused, 1, 3).tensor_sizes == [1, 1, 1]
+
+    def test_unfuse_sizeless_response(self):
+        from horovod_tpu.common.runtime import Runtime
+        bc = Response(response_type=ResponseType.BROADCAST,
+                      tensor_names=["w"], devices=[-1, -1])
+        one = Runtime._unfuse(bc, 0, 2)
+        assert one.tensor_names == ["w"] and one.tensor_sizes == []
+
+    def test_replayed_fusion_never_mutates_cached_entries(self):
+        """fuse_responses mutates the batch head's lists; the replay
+        must clone before fusing or the cache would corrupt after one
+        hit cycle."""
+        c = ResponseCache(4)
+        _put(c, "a")
+        _put(c, "b")
+        clones = [c.entry(s).clone_response() for s in (0, 1)]
+        fused = fuse_responses(
+            clones, {"a": DataType.FLOAT32, "b": DataType.FLOAT32},
+            1 << 20, {"a": 1, "b": 1})
+        assert fused[0].tensor_names == ["a", "b"]
+        assert c.entry(0).response.tensor_names == ["a"]
+        assert c.entry(1).response.tensor_names == ["b"]
+
+    def test_iter_slots_ascending(self):
+        from horovod_tpu.common.runtime import Runtime
+        mask = (1 << 63) | (1 << 5) | 1
+        assert list(Runtime._iter_slots(mask)) == [0, 5, 63]
+
+
+class TestConfigKnobs:
+    def test_env_knobs(self, monkeypatch):
+        from horovod_tpu.common.config import Config
+        monkeypatch.setenv("HOROVOD_CACHE_ENABLED", "0")
+        monkeypatch.setenv("HOROVOD_CACHE_CAPACITY", "77")
+        monkeypatch.setenv("HOROVOD_CACHE_SPECULATIVE", "0")
+        c = Config.from_env()
+        assert c.cache_enabled is False
+        assert c.cache_capacity == 77
+        assert c.cache_speculative is False
+
+    def test_zero_capacity_disables(self):
+        from horovod_tpu.common.config import Config
+        from horovod_tpu.common.controller import LocalController
+        from horovod_tpu.common.runtime import Runtime
+        from horovod_tpu.ops.local_ops import LocalBackend
+        from horovod_tpu.ops.operation_manager import OperationManager
+        cfg = Config(cache_capacity=0, async_completion=False)
+        rt = Runtime(cfg, LocalController(),
+                     OperationManager([LocalBackend(lambda: 1)]))
+        assert rt._cache is None
+        assert rt.negotiation_cache_stats() == {"enabled": False}
+
+    def test_capacity_must_be_positive(self):
+        with pytest.raises(ValueError):
+            ResponseCache(0)

@@ -85,6 +85,28 @@ def test_timeline_two_process(tmp_path):
             if e.get("ph") == "X"} >= {"0", "1"}
 
 
+def test_timeline_cached_negotiation_markers(tmp_path):
+    """Hit cycles carry no per-tensor NEGOTIATE spans, so the trace's
+    evidence of the fast path is the instant NEGOTIATE_CACHED marker —
+    and NEGOTIATE_CACHED_FUSED when the cycle also carried the fused
+    data (docs/performance.md)."""
+    # classic bitmask cycles (shm data plane -> no speculation)
+    p1 = str(tmp_path / "tl_cached.json")
+    run_scenario("response_cache_steady", 2, timeout=120.0,
+                 extra_env={"HOROVOD_TIMELINE": p1})
+    names = {e.get("name") for e in _load_events(p1)}
+    assert "NEGOTIATE_CACHED" in names, sorted(
+        n for n in names if n and "NEGOT" in n)
+    # fused speculative cycles (socket star data plane)
+    p2 = str(tmp_path / "tl_spec.json")
+    run_scenario("response_cache_steady", 2, timeout=120.0,
+                 extra_env={"HOROVOD_TIMELINE": p2,
+                            "HOROVOD_TPU_SHM": "0"})
+    names = {e.get("name") for e in _load_events(p2)}
+    assert "NEGOTIATE_CACHED_FUSED" in names, sorted(
+        n for n in names if n and "NEGOT" in n)
+
+
 def test_timeline_off_by_default(tmp_path, monkeypatch):
     import horovod_tpu as hvd
     hvd.shutdown()
