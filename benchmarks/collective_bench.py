@@ -402,6 +402,52 @@ def _cache_bench_section(np_: int) -> dict:
             "speedup": round(ratios[len(ratios) // 2], 2)}
 
 
+def _metrics_bench_section(np_: int) -> dict:
+    """Metrics-plane overhead A/B on the PR 3 steady bucket (the
+    worker_cache loop: 64 x 4 KiB grouped allreduce per step, cache
+    on): HOROVOD_TPU_METRICS off (the default — this leg must hold
+    the recorded negotiation_cache.cache_on baseline within the <2%
+    acceptance bar, since the disabled path installs only no-op
+    hooks) vs on (pricing the armed counters/histograms + the
+    per-interval world fold). Same simultaneous-pair protocol as the
+    cache section: this host throttles in multi-second bursts, so
+    only per-pair ratios are stable."""
+    import threading
+    base_env = {"HOROVOD_TPU_SHM": "0",
+                "HOROVOD_TPU_RING_THRESHOLD": "-1"}
+    on_env = dict(base_env, HOROVOD_TPU_METRICS="1",
+                  HOROVOD_TPU_METRICS_INTERVAL="1")
+
+    offs, ons, ratios = [], [], []
+    for rep in range(3):
+        pair = {}
+
+        def _go(key, env):
+            pair[key] = _run_world("cache", np_, timeout=600.0,
+                                   extra_env=env)
+
+        ta = threading.Thread(target=_go, args=("off", base_env))
+        tb = threading.Thread(target=_go, args=("on", on_env))
+        ta.start()
+        tb.start()
+        ta.join()
+        tb.join()
+        offs.append(pair["off"])
+        ons.append(pair["on"])
+        ratios.append(pair["on"]["us_per_op"]
+                      / pair["off"]["us_per_op"])
+    offs.sort(key=lambda d: d["us_per_op"])
+    ons.sort(key=lambda d: d["us_per_op"])
+    ratios.sort()
+    med_ratio = ratios[len(ratios) // 2]
+    return {"world_size": np_,
+            "metrics_off": offs[len(offs) // 2],
+            "metrics_on": ons[len(ons) // 2],
+            "pair_overhead_pct": [round((r - 1) * 100, 2)
+                                  for r in ratios],
+            "enabled_overhead_pct": round((med_ratio - 1) * 100, 2)}
+
+
 AUTOTUNE_VALUE_TENSORS = 24
 AUTOTUNE_VALUE_BYTES = 32 << 10
 AUTOTUNE_VALUE_STEPS = 40
@@ -850,6 +896,9 @@ def main() -> None:
     ap.add_argument("--cache-only", action="store_true",
                     help="run just the negotiation-cache A/B and merge "
                          "it into the existing RESULTS_cpu.json")
+    ap.add_argument("--metrics-only", action="store_true",
+                    help="run just the metrics-plane overhead A/B and "
+                         "merge it into the existing RESULTS_cpu.json")
     args = ap.parse_args()
 
     if args.worker:
@@ -867,6 +916,26 @@ def main() -> None:
     np_ = args.np
     cores = os.cpu_count() or 1
     results_path = os.path.join(REPO, "benchmarks", "RESULTS_cpu.json")
+
+    if args.metrics_only:
+        print(f"== metrics-plane overhead A/B (np={np_}, steady "
+              f"bucket) ==", flush=True)
+        mo = _metrics_bench_section(np_)
+        print(f"  metrics off {mo['metrics_off']['us_per_op']} us/op"
+              f"   on {mo['metrics_on']['us_per_op']} us/op   "
+              f"enabled overhead {mo['enabled_overhead_pct']}%",
+              flush=True)
+        try:
+            with open(results_path) as fh:
+                merged = json.load(fh)
+        except (OSError, ValueError):
+            merged = {}
+        merged["metrics_overhead"] = mo
+        with open(results_path, "w") as fh:
+            json.dump(merged, fh, indent=2)
+            fh.write("\n")
+        print(f"merged metrics_overhead into {results_path}")
+        return
 
     if args.cache_only:
         print(f"== negotiation cache A/B (np={np_}, socket star) ==",
@@ -1004,6 +1073,21 @@ def main() -> None:
             print(f"  negotiation cache bench failed: {e!r}",
                   flush=True)
 
+    mo = {}
+    if not args.skip_variants:
+        print(f"== metrics-plane overhead A/B (np={np_}, steady "
+              f"bucket) ==", flush=True)
+        try:
+            mo = _metrics_bench_section(np_)
+            print(f"  metrics off {mo['metrics_off']['us_per_op']} "
+                  f"us/op   on {mo['metrics_on']['us_per_op']} us/op"
+                  f"   enabled overhead "
+                  f"{mo['enabled_overhead_pct']}%", flush=True)
+        except Exception as e:
+            mo = {"error": repr(e)}
+            print(f"  metrics overhead bench failed: {e!r}",
+                  flush=True)
+
     print(f"== scaling (fixed {FIXED_COMPUTE_S * 1e3:.0f} ms compute — "
           f"parallelizable, isolates comm overhead) ==", flush=True)
     f1 = _median_world("fixed_compute", 1)
@@ -1100,6 +1184,7 @@ def main() -> None:
         "ragged_allgather": rag,
         "autotune_value": av,
         "negotiation_cache": nc,
+        "metrics_overhead": mo,
         "projected_scaling": projection,
         "fixed_compute_ms": FIXED_COMPUTE_S * 1e3,
         "fixed_compute_steps_per_sec": {

@@ -30,6 +30,7 @@ from horovod_tpu.common.basics import (
     init,
     shutdown,
     initialized,
+    metrics,
     rank,
     size,
     local_rank,
@@ -70,7 +71,7 @@ from horovod_tpu.common.status import (
 __all__ = [
     "HorovodInternalError", "WorldAbortedError",
     "__version__",
-    "init", "shutdown", "initialized",
+    "init", "shutdown", "initialized", "metrics",
     "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
     "is_homogeneous", "coordinator_threads_supported", "mpi_threads_supported",
     "allreduce", "allreduce_async",

@@ -210,6 +210,18 @@ def is_homogeneous() -> bool:
     return _require_runtime().controller.topology.is_homogeneous
 
 
+def metrics() -> dict:
+    """The live metrics view (HOROVOD_TPU_METRICS=1, docs/metrics.md):
+    ``{"enabled": bool, "local": {...}, "world": {...}|None,
+    "http_port": int|None}``. ``local`` is this rank's freshest
+    registry snapshot; ``world`` is the control-tree aggregate and
+    materializes only on rank 0 (the fold point); ``http_port`` is the
+    live Prometheus endpoint's bound port when
+    HOROVOD_TPU_METRICS_PORT enabled it. With metrics disabled the
+    snapshots are empty and ``enabled`` is False."""
+    return _require_runtime().metrics_view()
+
+
 def coordinator_threads_supported() -> bool:
     """Enqueues may come from any thread (the table is mutex-guarded),
     so multi-threaded use is always supported — unlike the reference,

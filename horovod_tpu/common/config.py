@@ -127,6 +127,28 @@ class Config:
     timeline_path: str = ""
     timeline_mark_cycles: bool = False
 
+    # Metrics plane (TPU-native extension; the reference has no live
+    # observability at all — timeline/stall/autotune are post-hoc).
+    # HOROVOD_TPU_METRICS=1 arms per-rank counters/gauges/histograms
+    # across the runtime, controller and op backends, world-aggregated
+    # over the control tree every metrics_interval_s seconds. Default
+    # OFF: the disabled path installs only no-op hooks (the
+    # _NoOpTimeline pattern) so steady-state cost is zero.
+    metrics_enabled: bool = False
+    metrics_interval_s: float = 5.0
+    # Rank-0 Prometheus endpoint: GET /metrics in text exposition
+    # format. -1 disables the HTTP server; 0 binds an ephemeral port
+    # (readable via horovod_tpu.metrics()["http_port"]).
+    metrics_port: int = -1
+    # Bind address for the endpoint. Default all interfaces (the
+    # exporter convention — Prometheus usually scrapes from another
+    # host); the endpoint is UNAUTHENTICATED, so on shared networks
+    # set HOROVOD_TPU_METRICS_ADDR=127.0.0.1 and tunnel/proxy.
+    metrics_addr: str = ""
+    # Rank-0 JSONL snapshot log: one world-aggregated snapshot line
+    # per interval. Empty disables.
+    metrics_log: str = ""
+
     # Async collective completion (reference: cuda_operations.cc:148-179
     # detached finalizer threads + Status::InProgress). Off = the cycle
     # loop blocks until each collective's outputs are ready.
@@ -217,6 +239,16 @@ class Config:
         c.timeline_path = os.environ.get("HOROVOD_TIMELINE", "")
         c.timeline_mark_cycles = _env_bool(
             "HOROVOD_TIMELINE_MARK_CYCLES", c.timeline_mark_cycles)
+        c.metrics_enabled = _env_bool("HOROVOD_TPU_METRICS",
+                                      c.metrics_enabled)
+        c.metrics_interval_s = _env_float(
+            "HOROVOD_TPU_METRICS_INTERVAL", c.metrics_interval_s)
+        c.metrics_port = _env_int("HOROVOD_TPU_METRICS_PORT",
+                                  c.metrics_port)
+        c.metrics_addr = os.environ.get("HOROVOD_TPU_METRICS_ADDR",
+                                        c.metrics_addr)
+        c.metrics_log = os.environ.get("HOROVOD_TPU_METRICS_LOG",
+                                       c.metrics_log)
         c.async_completion = _env_bool(
             "HOROVOD_ASYNC_COMPLETION", c.async_completion)
         c.stall_check_disable = _env_bool(

@@ -75,6 +75,9 @@ TAG_RESPONSES = 3   # coordinator -> worker: serialized ResponseList
 TAG_DATA = 4        # data-plane payload (socket fallback backend)
 TAG_PING = 5        # downward liveness beacon (heartbeat.encode_ping)
 TAG_ABORT = 6       # world abort notice (heartbeat.encode_abort)
+TAG_METRICS = 7     # upward metrics snapshot (wire.*_metrics_frame) —
+                    # out-of-band like PING: absorbed wherever a
+                    # control frame is awaited, never negotiated
 
 
 def _dead_peers(channels: Dict[int, "network.Channel"]) -> List[int]:
@@ -287,9 +290,13 @@ class _NativeFanout:
     per-channel Python loops."""
 
     def __init__(self, lib, ctypes_mod, channels: Dict[int, "network.Channel"],
-                 secret: bytes, hb=None):
+                 secret: bytes, hb=None, on_metrics=None):
         self._lib = lib
         self._ct = ctypes_mod
+        # callable(rank, payload) fired when a TAG_METRICS frame
+        # arrives in a gather slice (the sender stays pending — its
+        # real cycle frame is still owed). None drops such frames.
+        self._on_metrics = on_metrics
         self.ranks = sorted(channels)
         fds = [channels[r].sock.fileno() for r in self.ranks]
         self._fd_list = fds
@@ -315,7 +322,7 @@ class _NativeFanout:
         self._hb = hb
 
     @classmethod
-    def create(cls, channels, secret: bytes, hb=None):
+    def create(cls, channels, secret: bytes, hb=None, on_metrics=None):
         if not channels:
             return None
         from horovod_tpu import native
@@ -323,7 +330,8 @@ class _NativeFanout:
         if lib is None:
             return None
         import ctypes
-        return cls(lib, ctypes, channels, secret, hb=hb)
+        return cls(lib, ctypes, channels, secret, hb=hb,
+                   on_metrics=on_metrics)
 
     def _as_u8(self, data):
         """bytes/buffer → ctypes u8 array at memcpy speed (never a
@@ -357,6 +365,7 @@ class _NativeFanout:
             lens = (ct.c_int64 * n)()
             tags = (ct.c_uint8 * n)()
             still: List[int] = []
+            absorbed = False  # out-of-band frames harvested this slice
             try:
                 rc = self._lib.hvd_gather_frames(
                     fds, n, self._secret_buf, len(self._secret),
@@ -395,6 +404,18 @@ class _NativeFanout:
                         origin, cause = heartbeat.decode_abort(
                             ct.string_at(bufs[j], lens[j]))
                         raise _abort_error(origin, cause, resolved=True)
+                    if tags[j] == TAG_METRICS:
+                        # Out-of-band observability frame: absorb it
+                        # and keep the sender pending — its real cycle
+                        # frame is still owed this gather. It also
+                        # counts as proof of life (the frame's arrival
+                        # resets the silence window below).
+                        if self._on_metrics is not None:
+                            self._on_metrics(r, ct.string_at(bufs[j],
+                                                             lens[j]))
+                        absorbed = True
+                        still.append(i)
+                        continue
                     if tags[j] != expect_tag:
                         raise ConnectionError(
                             f"expected tag {expect_tag} from rank {r}, "
@@ -407,8 +428,9 @@ class _NativeFanout:
             if rc == -errno.ETIMEDOUT:
                 if on_idle is not None:
                     on_idle()
-                if len(still) != len(pending):
-                    # some frames landed this slice: the world is
+                if len(still) != len(pending) or absorbed:
+                    # some frames landed this slice (cycle frames, or
+                    # absorbed out-of-band metrics): the world is
                     # moving — restart the silence window
                     deadline = time.monotonic() + timeout_s
                 elif time.monotonic() > deadline:
@@ -518,6 +540,44 @@ class Controller:
     """Abstract control plane."""
 
     topology: Topology
+
+    # -- metrics plane (common/metrics.py) -------------------------------
+    # Rank-0 sink for METRICS frames arriving off the control tree:
+    # callable(owner_rank, payload). Set by the runtime once its
+    # WorldAggregator exists; frames arriving earlier are dropped
+    # (best-effort totals — the next interval resends them).
+    metrics_sink = None
+    # Control-plane byte counters + liveness tracking, installed by
+    # attach_metrics. The class-attribute defaults keep every
+    # unattached (metrics-off) path at a no-op method call.
+    _m_ctrl_rx = None
+    _m_ctrl_tx = None
+    _metrics_on = False
+
+    def attach_metrics(self, registry) -> None:
+        """Install control-plane instrumentation from the runtime's
+        registry (a no-op registry hands back no-op metrics, keeping
+        the disabled path free)."""
+        self._m_ctrl_rx = registry.counter(
+            'hvd_control_bytes_total{direction="rx"}',
+            "control-plane bytes received by this rank")
+        self._m_ctrl_tx = registry.counter(
+            'hvd_control_bytes_total{direction="tx"}',
+            "control-plane bytes sent by this rank")
+        self._metrics_on = bool(registry.enabled)
+
+    def send_metrics(self, payload: bytes) -> None:
+        """Best-effort upward METRICS frame (workers; a hierarchical
+        local root folds its host's latest frames in first). Never
+        raises — observability must not take the control plane down;
+        a dead channel is the cycle path's to report."""
+
+    def peer_heartbeat_ages(self) -> Dict[int, float]:
+        """Seconds since the last control frame from each directly
+        connected peer (owner channels for the coordinator, upward
+        peer + leaves for workers). Only maintained while metrics are
+        attached; empty otherwise."""
+        return {}
 
     @property
     def rank(self) -> int:
@@ -668,6 +728,10 @@ class TcpCoordinator(Controller):
         self._members: Dict[int, List[int]] = {}
         self._owner_of: Dict[int, int] = {}
         self._has_aggregates = False
+        # owner rank -> monotonic time of its last control frame
+        # (maintained only when metrics are attached; feeds the
+        # per-peer heartbeat-age gauges).
+        self._last_seen: Dict[int, float] = {}
 
     def accept_workers(self) -> None:
         deadline = time.monotonic() + self._start_timeout
@@ -725,7 +789,8 @@ class TcpCoordinator(Controller):
                        on_idle=self._ping_peers)
         if self._size > 1:
             self._fanout = _NativeFanout.create(self._channels,
-                                                self._secret, hb=hb)
+                                                self._secret, hb=hb,
+                                                on_metrics=self._on_metrics)
         hlog.debug(f"coordinator up: {self._size} ranks, "
                    f"{self.topology.cross_size} hosts, "
                    f"fan-in {len(self._channels)}", rank=0)
@@ -849,6 +914,23 @@ class TcpCoordinator(Controller):
         a merely slow peer)."""
         _maybe_ping(self, self._channels, 0)
 
+    def _on_metrics(self, r: int, payload: bytes) -> None:
+        """A METRICS frame from owner channel ``r`` (native gather or
+        the Python recv loop): record liveness and hand it to the
+        runtime's aggregator when one is attached."""
+        if self._metrics_on:
+            self._last_seen[r] = time.monotonic()
+        sink = self.metrics_sink
+        if sink is not None:
+            sink(r, payload)
+
+    def peer_heartbeat_ages(self) -> Dict[int, float]:
+        # list() snapshots the dict atomically under the GIL — the
+        # background loop inserts new peers while user threads
+        # (hvd.metrics()) iterate.
+        now = time.monotonic()
+        return {r: now - t for r, t in list(self._last_seen.items())}
+
     def _recv_ctrl(self, r: int, ch: network.Channel,
                    expect_tag: int) -> bytes:
         """One control frame from rank ``r``'s channel: PINGs are
@@ -865,6 +947,9 @@ class TcpCoordinator(Controller):
                     from e
             if tag == TAG_PING:
                 continue
+            if tag == TAG_METRICS:
+                self._on_metrics(r, data)
+                continue
             if tag == TAG_ABORT:
                 origin, cause = heartbeat.decode_abort(data)
                 raise _abort_error(origin, cause, resolved=True)
@@ -872,6 +957,8 @@ class TcpCoordinator(Controller):
                 raise ConnectionError(
                     f"expected tag {expect_tag} from rank {r}, "
                     f"got {tag}")
+            if self._metrics_on:
+                self._last_seen[r] = time.monotonic()
             return data
 
     def _raise_transport(self, e: Exception) -> None:
@@ -896,11 +983,24 @@ class TcpCoordinator(Controller):
         out[0] = payload
         try:
             if self._fanout is not None:
-                for r, data in self._fanout.gather(expect_tag).items():
-                    out[r] = data
+                gathered = self._fanout.gather(expect_tag)
+                if self._metrics_on:
+                    now = time.monotonic()
+                    rx = 0
+                    for r, data in gathered.items():
+                        out[r] = data
+                        self._last_seen[r] = now
+                        rx += len(data)
+                    self._m_ctrl_rx.inc(rx)
+                else:
+                    for r, data in gathered.items():
+                        out[r] = data
             else:
                 for r, ch in self._channels.items():
                     out[r] = self._recv_ctrl(r, ch, expect_tag)
+                if self._metrics_on:
+                    self._m_ctrl_rx.inc(sum(
+                        len(out[r]) for r in self._channels))
         except WorldAbortedError:
             raise
         except (ConnectionError, OSError) as e:
@@ -913,6 +1013,8 @@ class TcpCoordinator(Controller):
 
     def broadcast_responses(self, payload: Optional[bytes]) -> bytes:
         assert payload is not None
+        if self._metrics_on:
+            self._m_ctrl_tx.inc(len(payload) * len(self._channels))
         try:
             if self._fanout is not None:
                 self._fanout.send_all(payload, TAG_RESPONSES)
@@ -1062,6 +1164,13 @@ class TcpWorker(Controller):
         self._children: Dict[int, network.Channel] = {}
         self._child_fanout: Optional[_NativeFanout] = None
         self._members: List[int] = [rank]  # this host's ranks, ascending
+        # leaf rank -> its latest raw METRICS frame: folded with this
+        # root's own snapshot into ONE frame upward (send_metrics) so
+        # coordinator metrics fan-in scales with hosts, like CACHED_AGG.
+        self._child_metrics: Dict[int, bytes] = {}
+        # liveness timestamps for peer_heartbeat_ages (metrics only)
+        self._up_seen = time.monotonic()
+        self._child_seen: Dict[int, float] = {}
         if (info.get("hier") and self.topology.cross_rank != 0
                 and self.topology.local_size > 1):
             _, host_members = host_groups(hostnames)
@@ -1080,7 +1189,8 @@ class TcpWorker(Controller):
                        on_idle=self._ping_children)
         if self._children:
             self._child_fanout = _NativeFanout.create(
-                self._children, secret, hb=hb)
+                self._children, secret, hb=hb,
+                on_metrics=self._on_child_metrics)
 
     def _become_local_root(self, members: List[int], secret: bytes,
                            start_timeout: float) -> None:
@@ -1148,6 +1258,43 @@ class TcpWorker(Controller):
         if self._children:
             _maybe_ping(self, self._children, self.rank)
 
+    def _on_child_metrics(self, r: int, payload: bytes) -> None:
+        """A leaf's METRICS frame: keep only the LATEST per leaf —
+        snapshots are totals, so folding the most recent frame from
+        each member is exact regardless of drop/reorder."""
+        self._child_metrics[r] = payload
+        if self._metrics_on:
+            self._child_seen[r] = time.monotonic()
+
+    def send_metrics(self, payload: bytes) -> None:
+        try:
+            if self._child_metrics:
+                # drop_incompatible: ONE leaf on skewed code must not
+                # silence the root and every healthy sibling forever —
+                # its frame is skipped, the rest of the host reports.
+                payload = wire.combine_metrics_frames(
+                    [payload] + [self._child_metrics[r]
+                                 for r in sorted(self._child_metrics)],
+                    drop_incompatible=True)
+            self._ch.send(payload, TAG_METRICS)
+            if self._metrics_on:
+                self._m_ctrl_tx.inc(len(payload))
+        except Exception:
+            pass  # best-effort: the cycle path owns channel errors
+
+    def peer_heartbeat_ages(self) -> Dict[int, float]:
+        if not self._metrics_on:
+            # _up_seen/_child_seen are only maintained with metrics
+            # attached; reporting the stale __init__ stamp would feed
+            # the stall report an ever-growing bogus age for a
+            # perfectly healthy upward peer.
+            return {}
+        now = time.monotonic()
+        ages = {self._up_rank: now - self._up_seen}
+        for r, t in list(self._child_seen.items()):
+            ages[r] = now - t
+        return ages
+
     def _relay_children_safe(self, data, tag: int) -> None:
         """Best-effort PING/ABORT relay downward — never raises (runs
         on liveness/failure paths)."""
@@ -1172,9 +1319,13 @@ class TcpWorker(Controller):
                     self._up_rank,
                     f"control channel to {self._ch.peer} failed: {e}") \
                     from e
+            if self._metrics_on:
+                self._up_seen = time.monotonic()
             if tag == TAG_PING:
                 self._relay_children_safe(data, TAG_PING)
                 continue
+            if tag == TAG_METRICS:
+                continue  # metrics only flow upward; tolerate strays
             if tag == TAG_ABORT:
                 origin, cause = heartbeat.decode_abort(data)
                 self._relay_children_safe(data, TAG_ABORT)
@@ -1183,24 +1334,32 @@ class TcpWorker(Controller):
                 raise ConnectionError(
                     f"expected tag {expect_tag} from {self._ch.peer}, "
                     f"got {tag}")
+            if self._metrics_on:
+                self._m_ctrl_rx.inc(len(data))
             return data
 
     def _recv_child(self, r: int, tag: int) -> bytes:
-        try:
-            t, data = self._children[r].recv()
-        except WorldAbortedError:
-            raise
-        except (ConnectionError, OSError) as e:
-            raise _abort_error(
-                r, f"control channel to local rank {r} failed: {e}") \
-                from e
-        if t == TAG_ABORT:
-            origin, cause = heartbeat.decode_abort(data)
-            raise _abort_error(origin, cause, resolved=True)
-        if t != tag:
-            raise ConnectionError(
-                f"expected tag {tag} from local rank {r}, got {t}")
-        return data
+        while True:
+            try:
+                t, data = self._children[r].recv()
+            except WorldAbortedError:
+                raise
+            except (ConnectionError, OSError) as e:
+                raise _abort_error(
+                    r, f"control channel to local rank {r} failed: {e}") \
+                    from e
+            if t == TAG_METRICS:
+                self._on_child_metrics(r, data)
+                continue
+            if t == TAG_ABORT:
+                origin, cause = heartbeat.decode_abort(data)
+                raise _abort_error(origin, cause, resolved=True)
+            if t != tag:
+                raise ConnectionError(
+                    f"expected tag {tag} from local rank {r}, got {t}")
+            if self._metrics_on:
+                self._child_seen[r] = time.monotonic()
+            return data
 
     def _raise_child_transport(self, e: Exception, what: str):
         """Turn an anonymous transport error on the leaf tier into a
@@ -1224,6 +1383,8 @@ class TcpWorker(Controller):
             self._raise_child_transport(e, "relay to local leaves")
 
     def _send_up(self, payload, tag: int) -> None:
+        if self._metrics_on:
+            self._m_ctrl_tx.inc(len(payload))
         try:
             self._ch.send(payload, tag)
         except (ConnectionError, OSError) as e:

@@ -424,6 +424,7 @@ class ResponseCache:
         # local observability (not part of the coherent state)
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
 
     def __len__(self) -> int:
         return len(self._lru)
@@ -484,6 +485,7 @@ class ResponseCache:
             self._slots[victim.slot] = None
             heapq.heappush(self._free, victim.slot)
             self.epoch += 1
+            self.evictions += 1
         if self._free:
             slot = heapq.heappop(self._free)
         else:
@@ -514,6 +516,7 @@ class ResponseCache:
         del self._lru[e.name]
         heapq.heappush(self._free, slot)
         self.epoch += 1
+        self.evictions += 1
 
     def touch_mask(self, mask: int) -> None:
         """Mark granted slots most-recently-used, ascending slot order
@@ -566,16 +569,24 @@ class StallInspector:
         the process lifetime warns again (MessageTable.remove hook)."""
         self._warned.discard(name)
 
-    def check(self, table: MessageTable, cache_stats: str = "") -> bool:
+    def check(self, table: MessageTable, cache_stats: str = "",
+              world_stats: str = "") -> bool:
         """Log a report of stalled tensors; returns True if the shutdown
         threshold was exceeded (caller must initiate shutdown).
         ``cache_stats`` — a one-line negotiation-cache summary (hits /
         misses / cached cycles) surfaced with the periodic report so a
         timeline reader can tell whether negotiation time went to full
-        rounds or to the bitmask fast path."""
+        rounds or to the bitmask fast path. ``world_stats`` — steady-
+        state health context (tensor-queue depth, per-peer heartbeat
+        ages, timeline drop count) appended to each stall warning so
+        one warning carries enough to diagnose without a second
+        tool."""
         self._last_check = time.monotonic()
         if cache_stats:
             hlog.info(f"negotiation {cache_stats}")
+        if world_stats:
+            hlog.info(f"world health: {world_stats}")
+        suffix = f" [world: {world_stats}]" if world_stats else ""
         must_shutdown = False
         for name, age, ranks_reported in table.pending():
             if age < self.warning_time:
@@ -593,7 +604,7 @@ class StallInspector:
                 f"waiting for remainder of ranks for more than "
                 f"{int(age)} seconds. Stalled op: {name} "
                 f"[ready ranks: {ranks_reported}, "
-                f"waiting on ranks: {missing}]")
+                f"waiting on ranks: {missing}]{suffix}")
             if self.shutdown_time > 0 and age >= self.shutdown_time:
                 hlog.error(
                     f"Stalled tensor {name} exceeded the shutdown "

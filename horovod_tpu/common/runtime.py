@@ -27,6 +27,7 @@ from typing import Dict, List, Optional
 
 from horovod_tpu.common import faults
 from horovod_tpu.common import logging as hlog
+from horovod_tpu.common import metrics as hmetrics
 from horovod_tpu.common import wire
 from horovod_tpu.common.config import Config
 from horovod_tpu.common.controller import Controller
@@ -198,6 +199,82 @@ class Runtime:
         # requeued: their peers were already granted and will not be
         # re-enqueued, so they must never trigger a burst hold.
         self._requeued_names: frozenset = frozenset()
+        # Monotonic count of speculative bids the world answered with
+        # a classic full grant (per-mask slates in _spec_denied reset
+        # on success; observability wants the lifetime total).
+        self._spec_denials_total = 0
+
+        # -- metrics plane (HOROVOD_TPU_METRICS, common/metrics.py) ----
+        # Disabled (the default) hands every call site the shared
+        # no-op metric — same zero-overhead contract as _NoOpTimeline;
+        # _metrics_on additionally gates the extra clock reads so the
+        # disabled hot path does not even pay a time.monotonic().
+        self.metrics = hmetrics.create_registry(config.metrics_enabled)
+        self._metrics_on = bool(config.metrics_enabled)
+        reg = self.metrics
+        self._m_cycle_s = reg.histogram(
+            "hvd_cycle_seconds", "negotiation cycle wall time")
+        self._m_negotiation_s = reg.histogram(
+            "hvd_negotiation_seconds",
+            "request gather -> response broadcast round trip")
+        self._m_cycles = reg.counter("hvd_cycles_total")
+        self._m_cached_cycles = reg.counter(
+            "hvd_cached_cycles_total",
+            "cycles negotiated purely via the cache bitmask")
+        self._m_spec_cycles = reg.counter(
+            "hvd_fused_spec_cycles_total",
+            "single-round fused speculative cycles completed")
+        self._m_spec_bids = reg.counter("hvd_spec_bids_total")
+        self._m_spec_denials = reg.counter("hvd_spec_denials_total")
+        self._m_cache_hits = reg.counter("hvd_cache_hits_total")
+        self._m_cache_misses = reg.counter("hvd_cache_misses_total")
+        self._m_cache_evictions = reg.counter(
+            "hvd_cache_evictions_total")
+        self._m_cache_entries = reg.gauge("hvd_cache_entries")
+        self._m_queue_depth = reg.gauge(
+            "hvd_tensor_queue_depth",
+            "in-flight collectives tabled on this rank")
+        self._m_burst_hold_s = reg.counter(
+            "hvd_burst_hold_seconds_total",
+            "time spent absorbing enqueue bursts")
+        self._m_idle_hold_s = reg.counter(
+            "hvd_idle_hold_seconds_total",
+            "time spent in the steady-state idle hold")
+        self._m_timeline_dropped = reg.counter(
+            "hvd_timeline_dropped_events_total")
+        # The fused speculative cycle bypasses OperationManager, so the
+        # runtime owns its share of the allreduce op/byte totals (the
+        # registry memoizes by name — these are the SAME counters the
+        # OperationManager increments on the classic path).
+        self._m_bytes_allreduced = reg.counter(
+            "hvd_bytes_allreduced_total")
+        self._m_ops_allreduce = reg.counter(
+            'hvd_ops_total{op="allreduce"}')
+        self.timeline.attach_drop_counter(self._m_timeline_dropped)
+        controller.attach_metrics(reg)
+        op_manager.attach_metrics(
+            reg, lambda: self._world_fusion_threshold)
+        # Rank-0 world aggregation + read surfaces: control-tree
+        # METRICS frames fold here, exposed over Prometheus HTTP
+        # (HOROVOD_TPU_METRICS_PORT), a JSONL snapshot log
+        # (HOROVOD_TPU_METRICS_LOG) and horovod_tpu.metrics().
+        self._aggregator = None
+        self._metrics_http = None
+        self._metrics_log = None
+        self._metrics_last_pub = 0.0
+        if self._metrics_on:
+            reg.add_collector(self._collect_runtime_metrics)
+            if controller.rank == 0:
+                self._aggregator = hmetrics.WorldAggregator(
+                    controller.size)
+                controller.metrics_sink = self._aggregator.ingest
+                if config.metrics_port >= 0:
+                    self._metrics_http = hmetrics.MetricsHTTPServer(
+                        self._aggregator.world, config.metrics_port,
+                        host=config.metrics_addr)
+                if config.metrics_log:
+                    self._metrics_log = hmetrics.JsonlMetricsLog(
+                        config.metrics_log)
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:
@@ -380,16 +457,47 @@ class Runtime:
                        rank=self.controller.rank)
         finally:
             self._done.set()
-            # Drain in-flight async completions first so every issued
-            # collective fires its real status, then fail what was never
-            # issued (reference: operations.cc:898-913).
-            if self.finalizer is not None:
-                self.finalizer.drain()
+            # Teardown stages are individually guarded: a raising
+            # finalizer drain or user completion callback must not
+            # skip the stages after it — in particular the timeline
+            # flush, or the trace of exactly the aborted runs you most
+            # want to inspect is left an unterminated JSON fragment.
+            try:
+                # Drain in-flight async completions first so every
+                # issued collective fires its real status, then fail
+                # what was never issued (reference:
+                # operations.cc:898-913).
+                if self.finalizer is not None:
+                    self.finalizer.drain()
+            except Exception as e:
+                hlog.warning(f"finalizer drain failed at shutdown: "
+                             f"{e!r}", rank=self.controller.rank)
             terminal = self._terminal_status()
             for entry in self.tensor_table.pop_all():
                 if entry.callback:
-                    entry.callback(terminal)
-            self.timeline.shutdown()
+                    try:
+                        entry.callback(terminal)
+                    except Exception:
+                        pass  # user callback; teardown must continue
+            try:
+                self.timeline.shutdown()
+            except Exception:
+                pass
+            if self._aggregator is not None \
+                    and self._metrics_log is not None:
+                # Final JSONL line with rank 0's own totals exact and
+                # every owner's last-received frame folded in (workers
+                # tear down concurrently, so their tail interval is
+                # inherently best-effort — the log is a sampled view;
+                # live exactness is the API/endpoint's job).
+                try:
+                    self._aggregator.update_local(
+                        self.metrics.snapshot())
+                    self._metrics_log.append(self._aggregator.world())
+                except Exception:
+                    pass
+            if self._metrics_http is not None:
+                self._metrics_http.close()
             self.op_manager.close()
             try:
                 self.controller.close()
@@ -613,11 +721,18 @@ class Runtime:
 
         requests = self.tensor_table.pop_messages()
         if requests and self._cache is not None:
-            requests = self._absorb_burst(requests)
+            if self._metrics_on:
+                tb = time.monotonic()
+                requests = self._absorb_burst(requests)
+                self._m_burst_hold_s.inc(time.monotonic() - tb)
+            else:
+                requests = self._absorb_burst(requests)
         shutting_down = self._shutdown_requested.is_set()
         payload, bit_requests = self._build_request_frame(
             requests, shutting_down)
 
+        if self._metrics_on:
+            tn = time.monotonic()
         gathered = self.controller.gather_requests(payload)
         if self.controller.is_coordinator:
             reply, meta = self._coordinate_cycle(gathered)
@@ -625,6 +740,8 @@ class Runtime:
         else:
             data = self.controller.broadcast_responses(None)
             meta = wire.parse_cycle_response(data)
+        if self._metrics_on:
+            self._m_negotiation_s.observe(time.monotonic() - tn)
 
         if isinstance(meta, CacheCycleResponse):
             resp_list = self._apply_cached_cycle(meta, bit_requests)
@@ -661,6 +778,10 @@ class Runtime:
         else:
             self._idle_cycles += 1
         elapsed = time.monotonic() - t0
+        if self._metrics_on:
+            self._m_cycle_s.observe(elapsed)
+            self._maybe_publish_metrics()
+        idle_hold = False
         sleep_s = cycle_time_ms / 1000.0 - elapsed
         if not self.tensor_table.queue_pending():
             if sleep_s <= 0:
@@ -704,6 +825,7 @@ class Runtime:
                     # heartbeat deadline
                     hold = min(hold, hb / 4.0)
                 sleep_s = max(sleep_s, hold)
+                idle_hold = True
         backoff_ms = self.config.idle_backoff_ms
         if backoff_ms > 0 and self._idle_cycles > self._IDLE_GRACE:
             backoff_s = backoff_ms / 1000.0
@@ -721,7 +843,12 @@ class Runtime:
         if sleep_s > 0:
             # Wake early on shutdown OR new local work (enqueue sets
             # _wake) so backoff never adds submit latency.
-            self._wake.wait(sleep_s)
+            if self._metrics_on and idle_hold:
+                tw = time.monotonic()
+                self._wake.wait(sleep_s)
+                self._m_idle_hold_s.inc(time.monotonic() - tw)
+            else:
+                self._wake.wait(sleep_s)
         self._wake.clear()
         return True
 
@@ -881,6 +1008,7 @@ class Runtime:
                     bid |= 1 << slot
                 self._spec_denied[bid] = \
                     self._spec_denied.get(bid, 0) + 1
+                self._spec_denials_total += 1
                 self._spec_inflight = None
             if not missed and not inner.responses \
                     and not meta.invalid_mask:
@@ -997,11 +1125,19 @@ class Runtime:
                 "fused speculative response does not match the frame "
                 "this rank sent — control plane corrupted")
         timeline_on = self.timeline.enabled
+        metrics_on = self._metrics_on
         ok = Status.OK()
         for (resp, entries, arrays), (dt, buf) in zip(
                 inflight, meta.spec_payload):
             self._op_count += 1
             faults.tick_op(self, self._op_count)
+            if metrics_on:
+                # The fused round IS the data plane for this batch:
+                # keep the allreduce op/byte totals exact even though
+                # OperationManager.execute never sees it.
+                self._m_ops_allreduce.inc()
+                self._m_bytes_allreduced.inc(
+                    sum(a.nbytes for a in arrays))
             names = resp.tensor_names
             popped = self.tensor_table.pop_entries(names)
             # bytearray: callers receive writable tensors, never views
@@ -1084,6 +1220,83 @@ class Runtime:
                 cache.put(name, sig, self._unfuse(resp, i, world_size),
                           dtype, slice_numel)
 
+    # -- metrics plane ---------------------------------------------------
+    def _collect_runtime_metrics(self) -> None:
+        """Registry collector: mirror counters whose true source lives
+        on hot paths that must not pay per-event metric calls (cache
+        hit/miss tallies, cycle counts, queue depth, per-peer
+        heartbeat ages). Runs once per snapshot, never per event."""
+        c = self._cache
+        if c is not None:
+            self._m_cache_hits.set_total(c.hits)
+            self._m_cache_misses.set_total(c.misses)
+            self._m_cache_evictions.set_total(c.evictions)
+            self._m_cache_entries.set(len(c))
+        self._m_cycles.set_total(self._cycle_count)
+        self._m_cached_cycles.set_total(self._cached_cycles)
+        self._m_spec_cycles.set_total(self._spec_cycles)
+        self._m_spec_bids.set_total(self._spec_bids)
+        self._m_spec_denials.set_total(self._spec_denials_total)
+        self._m_queue_depth.set(len(self.tensor_table))
+        for r, age in self.controller.peer_heartbeat_ages().items():
+            self.metrics.gauge(
+                f'hvd_peer_heartbeat_age_seconds{{peer="{r}"}}',
+                "seconds since the last control frame from this peer",
+                agg=hmetrics.AGG_MAX).set(age)
+
+    def _maybe_publish_metrics(self) -> None:
+        """Per-interval fold point (background thread only): snapshot
+        the local registry, then either feed the rank-0 aggregator
+        (plus the JSONL log) or ship one compact METRICS frame up the
+        control tree — out-of-band, the way PING frames ride."""
+        now = time.monotonic()
+        if now - self._metrics_last_pub \
+                < self.config.metrics_interval_s:
+            return
+        self._metrics_last_pub = now
+        snap = self.metrics.snapshot()
+        if self._aggregator is not None:
+            self._aggregator.update_local(snap)
+            if self._metrics_log is not None:
+                self._metrics_log.append(self._aggregator.world())
+            return
+        try:
+            payload = wire.serialize_metrics_frame(1, snap)
+        except Exception:
+            return  # a malformed record must not kill the loop
+        self.controller.send_metrics(payload)
+
+    def metrics_view(self) -> Dict:
+        """The horovod_tpu.metrics() payload: the freshest local
+        snapshot, the world aggregate (rank 0; None elsewhere — the
+        world view materializes only at the fold point), and the HTTP
+        port when the Prometheus endpoint is live."""
+        local = self.metrics.snapshot()
+        view = {"enabled": self._metrics_on, "local": local,
+                "world": None, "http_port": None}
+        if self._aggregator is not None:
+            self._aggregator.update_local(local)
+            view["world"] = self._aggregator.world()
+        if self._metrics_http is not None:
+            view["http_port"] = self._metrics_http.port
+        return view
+
+    def _world_status_line(self) -> str:
+        """Steady-state health context for the stall report: queue
+        depth and timeline drops always; per-peer heartbeat ages when
+        the metrics plane maintains them — one warning then carries
+        enough to diagnose without a second tool."""
+        parts = [f"tensor queue depth {len(self.tensor_table)}"]
+        ages = self.controller.peer_heartbeat_ages()
+        if ages:
+            worst = sorted(ages.items(), key=lambda kv: -kv[1])[:4]
+            parts.append("oldest peer heartbeat ages: " + ", ".join(
+                f"rank {r} {a:.1f}s" for r, a in worst))
+        if self.timeline.dropped_events:
+            parts.append(f"timeline events dropped "
+                         f"{self.timeline.dropped_events}")
+        return "; ".join(parts)
+
     def negotiation_cache_stats(self) -> Dict:
         """Local observability for benchmarks, tests and the stall
         report: lookup hit/miss counters, cached-cycle count, and the
@@ -1119,7 +1332,8 @@ class Runtime:
         if not self._stall.should_check():
             return
         if self._stall.check(table,
-                             cache_stats=self._cache_stats_line()):
+                             cache_stats=self._cache_stats_line(),
+                             world_stats=self._world_status_line()):
             # The stall-shutdown threshold fires the fail-fast
             # abort so every rank gets a structured error naming
             # the condition, instead of the silent clean-shutdown

@@ -36,7 +36,9 @@ class _NoOpTimeline:
     """Disabled timeline: every hook is a cheap no-op."""
 
     enabled = False
+    dropped_events = 0
 
+    def attach_drop_counter(self, counter): pass
     def negotiate_start(self, name, request_type): pass
     def negotiate_rank_ready(self, name, rank): pass
     def negotiate_end(self, name): pass
@@ -56,10 +58,23 @@ class Timeline(_NoOpTimeline):
 
     enabled = True
 
-    def __init__(self, path: str, mark_cycles: bool = False):
+    # Writer-queue bound: the writer drains to disk on its own thread,
+    # and a slow or hung disk previously grew the unbounded queue
+    # without limit (every event the job ever traced, resident). Past
+    # this depth new events are DROPPED and counted — a lossy trace
+    # from a sick disk beats an OOM'd training job.
+    DEFAULT_QUEUE_CAPACITY = 1 << 16
+
+    def __init__(self, path: str, mark_cycles: bool = False,
+                 queue_capacity: int = DEFAULT_QUEUE_CAPACITY):
         self._path = path
         self.mark_cycles = mark_cycles
-        self._queue: "queue.Queue[Optional[dict]]" = queue.Queue()
+        self._queue: "queue.Queue[Optional[dict]]" = queue.Queue(
+            maxsize=queue_capacity)
+        self.dropped_events = 0
+        # hvd_timeline_dropped_events_total mirror (metrics plane);
+        # the runtime swaps in a real counter when metrics are on.
+        self._drop_metric = None
         self._pids: Dict[str, int] = {}
         self._next_pid = 1
         self._lock = threading.Lock()
@@ -68,6 +83,21 @@ class Timeline(_NoOpTimeline):
                                         name="hvd-timeline-writer",
                                         daemon=True)
         self._writer.start()
+
+    def attach_drop_counter(self, counter) -> None:
+        self._drop_metric = counter
+
+    def _put(self, rec: dict) -> None:
+        """Enqueue one event; on overflow drop it and count the drop
+        (surfaced in the stall report and the metrics registry). The
+        counter bump is racy-cheap on purpose: drops only happen when
+        the writer is already wedged."""
+        try:
+            self._queue.put_nowait(rec)
+        except queue.Full:
+            self.dropped_events += 1
+            if self._drop_metric is not None:
+                self._drop_metric.inc()
 
     # -- writer thread (reference: timeline.h:46-74 TimelineWriter) ------
     def _write_loop(self):
@@ -95,10 +125,10 @@ class Timeline(_NoOpTimeline):
                 pid = self._next_pid
                 self._next_pid += 1
                 self._pids[name] = pid
-                self._queue.put({"name": "process_name", "ph": "M",
-                                 "pid": pid, "args": {"name": name}})
-                self._queue.put({"name": "process_sort_index", "ph": "M",
-                                 "pid": pid, "args": {"sort_index": pid}})
+                self._put({"name": "process_name", "ph": "M",
+                           "pid": pid, "args": {"name": name}})
+                self._put({"name": "process_sort_index", "ph": "M",
+                           "pid": pid, "args": {"sort_index": pid}})
             return pid
 
     def _emit(self, ph: str, name: str, event_name: str, **kw):
@@ -106,7 +136,7 @@ class Timeline(_NoOpTimeline):
         if event_name:
             rec["name"] = event_name
         rec.update(kw)
-        self._queue.put(rec)
+        self._put(rec)
 
     # -- negotiation (reference: timeline.cc NegotiateStart/RankReady/End,
     # called from IncrementTensorCount, operations.cc:174-186) -----------
@@ -175,7 +205,14 @@ class Timeline(_NoOpTimeline):
             self._emit("i", "cycle", "CYCLE_START", s="g")
 
     def shutdown(self) -> None:
-        self._queue.put(None)
+        # A bounded queue can be full when the writer is wedged on a
+        # sick disk: give the sentinel a short blocking window, then
+        # give up — joining a stuck writer would hang teardown, and
+        # the trace is already lossy at that point.
+        try:
+            self._queue.put(None, timeout=1.0)
+        except queue.Full:
+            pass
         self._writer.join(timeout=5.0)
 
 

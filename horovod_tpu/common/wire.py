@@ -451,6 +451,123 @@ def parse_cycle_response(data: bytes):
                               response_list=rl)
 
 
+# ---------------------------------------------------------------------------
+# METRICS frames — the periodic observability payload that rides the
+# control tree out-of-band (TAG_METRICS), the way PING frames do: each
+# rank encodes its registry snapshot on HOROVOD_TPU_METRICS_INTERVAL, a
+# hierarchical local root sums its host's latest frames into ONE frame
+# upward, and rank 0 folds the owners into the world view
+# (common/metrics.py WorldAggregator).
+#
+#   MetricsFrame := u8 version | u32 nranks | u32 nmetrics | Metric[n]
+#   Metric       := u8 kind | str name | payload
+#     kind 'c' COUNTER   : f64 value
+#     kind 'g' GAUGE     : u8 agg ('s' sum | 'm' max) | f64 value
+#     kind 'h' HISTOGRAM : u16 nbounds | f64 bounds[nbounds]
+#                        | u64 counts[nbounds+1] | f64 sum | u64 count
+#
+# Bounds travel with every histogram so a frame is self-describing:
+# the aggregator can verify bucket identity instead of assuming it.
+
+_METRICS_VERSION = 1
+_KIND_BYTE = {"c": 0, "g": 1, "h": 2}
+_BYTE_KIND = {v: k for k, v in _KIND_BYTE.items()}
+_AGG_BYTE = {"sum": 0, "max": 1}
+_BYTE_AGG = {v: k for k, v in _AGG_BYTE.items()}
+_U16 = struct.Struct("<H")
+_U64 = struct.Struct("<Q")
+
+
+def serialize_metrics_frame(nranks: int, snap: dict) -> bytes:
+    """Encode a (possibly pre-summed) snapshot; ``nranks`` is how many
+    ranks the frame represents (1 for a leaf, local_size for a folded
+    host frame) so rank 0 can report hvd_ranks_reporting."""
+    w = _Writer()
+    w.u8(_METRICS_VERSION)
+    w.u32(nranks)
+    w.u32(len(snap))
+    for name, rec in snap.items():
+        w.u8(_KIND_BYTE[rec["k"]])
+        w.string(name)
+        if rec["k"] == "c":
+            w.f64(rec["v"])
+        elif rec["k"] == "g":
+            w.u8(_AGG_BYTE[rec.get("agg", "sum")])
+            w.f64(rec["v"])
+        else:
+            bounds = rec["bounds"]
+            w.parts.append(_U16.pack(len(bounds)))
+            if bounds:
+                w.parts.append(
+                    struct.pack(f"<{len(bounds)}d", *bounds))
+            counts = rec["counts"]
+            w.parts.append(
+                struct.pack(f"<{len(counts)}Q", *counts))
+            w.f64(rec["sum"])
+            w.parts.append(_U64.pack(rec["count"]))
+    return w.bytes()
+
+
+def parse_metrics_frame(data: bytes):
+    """-> (nranks, snapshot dict). Raises on a malformed or
+    unknown-version frame; callers on the control plane treat that as
+    a droppable best-effort payload, not a world error."""
+    r = _Reader(data)
+    version = r.u8()
+    if version != _METRICS_VERSION:
+        raise ValueError(f"unknown metrics frame version {version}")
+    nranks = r.u32()
+    snap = {}
+    for _ in range(r.u32()):
+        kind = _BYTE_KIND[r.u8()]
+        name = r.string()
+        if kind == "c":
+            snap[name] = {"k": "c", "v": r.f64()}
+        elif kind == "g":
+            agg = _BYTE_AGG[r.u8()]
+            snap[name] = {"k": "g", "agg": agg, "v": r.f64()}
+        else:
+            (nb,) = _U16.unpack_from(r.data, r.off)
+            r.off += _U16.size
+            bounds = list(struct.unpack_from(f"<{nb}d", r.data, r.off))
+            r.off += 8 * nb
+            counts = list(struct.unpack_from(f"<{nb + 1}Q", r.data,
+                                             r.off))
+            r.off += 8 * (nb + 1)
+            total = r.f64()
+            (count,) = _U64.unpack_from(r.data, r.off)
+            r.off += _U64.size
+            snap[name] = {"k": "h", "bounds": bounds, "counts": counts,
+                          "sum": total, "count": count}
+    return nranks, snap
+
+
+def combine_metrics_frames(frames, drop_incompatible: bool = False
+                           ) -> bytes:
+    """Sum several METRICS frames into one (a local root folding its
+    host before forwarding upward — the metrics analog of
+    combine_cycle_requests). nranks adds; metric records merge with
+    the registry's world semantics. ``drop_incompatible`` skips a
+    garbled or identity-mismatched frame (one leaf on skewed code)
+    instead of raising — the rest of the host must keep reporting;
+    each frame folds into a scratch copy first so a half-merged bad
+    frame can never leak partial sums."""
+    from horovod_tpu.common.metrics import merge_into
+    total_ranks = 0
+    merged: dict = {}
+    for f in frames:
+        try:
+            nranks, snap = parse_metrics_frame(f)
+            trial = merge_into(merge_into({}, merged), snap)
+        except Exception:
+            if drop_incompatible:
+                continue
+            raise
+        merged = trial
+        total_ranks += nranks
+    return serialize_metrics_frame(total_ranks, merged)
+
+
 def combine_cycle_requests(frames) -> "bytes | None":
     """AND/OR-fold several ranks' cycle-request frames into one
     CACHED_AGG frame — the bitmask reduction a hierarchical local root

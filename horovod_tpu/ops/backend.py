@@ -21,6 +21,7 @@ from contextlib import contextmanager
 from typing import List
 
 from horovod_tpu.common.message import Response
+from horovod_tpu.common.metrics import NOOP_METRIC
 from horovod_tpu.common.status import Status
 from horovod_tpu.common.tensor_table import TensorTableEntry
 from horovod_tpu.common.timeline import NOOP_TIMELINE
@@ -39,6 +40,21 @@ class CollectiveBackend:
     # in MEMCPY_IN/OUT_FUSION_BUFFER activities so timelines show where
     # fusion time goes (reference: mpi_operations.cc:35-62).
     timeline = NOOP_TIMELINE
+
+    # Per-plane observability (common/metrics.py), installed by
+    # OperationManager.attach_metrics; the class-attribute no-ops keep
+    # unattached/disabled paths free. Subclasses may override
+    # attach_metrics (calling super) to add plane-specific metrics.
+    m_ops = NOOP_METRIC
+    m_bytes = NOOP_METRIC
+
+    def attach_metrics(self, registry) -> None:
+        self.m_ops = registry.counter(
+            f'hvd_backend_ops_total{{backend="{self.name}"}}',
+            "collective batches executed by this data plane")
+        self.m_bytes = registry.counter(
+            f'hvd_backend_bytes_total{{backend="{self.name}"}}',
+            "payload bytes moved through this data plane")
 
     @contextmanager
     def activity(self, names, act, enabled: bool = True):

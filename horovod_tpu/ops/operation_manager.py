@@ -10,6 +10,7 @@ local (size-1).
 
 from __future__ import annotations
 
+import time
 from typing import List
 
 from horovod_tpu.common.message import Response, ResponseType
@@ -21,6 +22,48 @@ from horovod_tpu.ops.backend import CollectiveBackend
 class OperationManager:
     def __init__(self, backends: List[CollectiveBackend]):
         self._backends = backends
+        self._metrics_on = False
+        self._fusion_threshold_fn = None
+
+    def attach_metrics(self, registry, fusion_threshold_fn=None) -> None:
+        """Install the per-op-type instrumentation the runtime's
+        registry provides (the disabled registry hands back no-op
+        metrics, keeping every call free): op counts, payload bytes
+        per collective kind, collective wall-time histograms (issue
+        time for async backends — completion rides the finalizer), and
+        the fusion-buffer fill ratio against the world threshold.
+        Backends get their own per-plane counters via
+        CollectiveBackend.attach_metrics."""
+        from horovod_tpu.common.metrics import RATIO_BUCKETS
+        self._metrics_on = bool(registry.enabled)
+        self._fusion_threshold_fn = fusion_threshold_fn
+        bytes_names = {
+            ResponseType.ALLREDUCE: "hvd_bytes_allreduced_total",
+            ResponseType.ALLGATHER: "hvd_bytes_allgathered_total",
+            ResponseType.BROADCAST: "hvd_bytes_broadcast_total",
+            ResponseType.ALLTOALL: "hvd_bytes_alltoall_total",
+            ResponseType.REDUCESCATTER:
+                "hvd_bytes_reducescattered_total",
+        }
+        self._m_ops = {}
+        self._m_bytes = {}
+        self._m_wall = {}
+        for rt, bname in bytes_names.items():
+            op = rt.name.lower()
+            self._m_ops[rt] = registry.counter(
+                f'hvd_ops_total{{op="{op}"}}')
+            self._m_bytes[rt] = registry.counter(bname)
+            self._m_wall[rt] = registry.histogram(
+                f'hvd_collective_seconds{{op="{op}"}}',
+                "collective execution wall time (issue time for "
+                "async backends)")
+        self._m_ops[ResponseType.BARRIER] = registry.counter(
+            'hvd_ops_total{op="barrier"}')
+        self._m_fill = registry.histogram(
+            "hvd_fusion_fill_ratio",
+            "fused batch bytes / fusion threshold", RATIO_BUCKETS)
+        for b in self._backends:
+            b.attach_metrics(registry)
 
     def attach_finalizer(self, finalizer) -> None:
         """Give every backend the runtime's Finalizer so it may return
@@ -66,6 +109,31 @@ class OperationManager:
                 response: Response) -> Status:
         backend = self._pick(entries, response)
         rt = response.response_type
+        if not self._metrics_on:
+            return self._dispatch(backend, rt, entries, response)
+        nbytes = sum(getattr(e.tensor, "nbytes", 0) for e in entries)
+        op_counter = self._m_ops.get(rt)
+        if op_counter is not None:
+            op_counter.inc()
+        byte_counter = self._m_bytes.get(rt)
+        if byte_counter is not None:
+            byte_counter.inc(nbytes)
+        backend.m_ops.inc()
+        backend.m_bytes.inc(nbytes)
+        if len(entries) > 1 and self._fusion_threshold_fn is not None:
+            threshold = self._fusion_threshold_fn()
+            if threshold > 0:
+                self._m_fill.observe(nbytes / threshold)
+        t0 = time.perf_counter()
+        try:
+            return self._dispatch(backend, rt, entries, response)
+        finally:
+            wall = self._m_wall.get(rt)
+            if wall is not None:
+                wall.observe(time.perf_counter() - t0)
+
+    @staticmethod
+    def _dispatch(backend, rt, entries, response) -> Status:
         if rt == ResponseType.ALLREDUCE:
             return backend.execute_allreduce(entries, response)
         if rt == ResponseType.ALLGATHER:

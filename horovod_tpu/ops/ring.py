@@ -33,6 +33,7 @@ import numpy as np
 from horovod_tpu import native as _native
 from horovod_tpu.common import logging as hlog
 from horovod_tpu.common import network
+from horovod_tpu.common.metrics import NOOP_METRIC
 
 _TAG_RING_HELLO = 40
 _TAG_RING_DATA = 41
@@ -41,6 +42,11 @@ _TAG_RING_DATA = 41
 class Ring:
     """Established ring: one channel to the next rank, one from the
     previous. Single-threaded use per phase (the background loop)."""
+
+    # Link-bytes counter (metrics plane): the socket backend installs
+    # the real counter when it establishes the ring; the class-level
+    # no-op keeps unattached/disabled rings free.
+    m_link_bytes = NOOP_METRIC
 
     def __init__(self, rank: int, size: int, next_ch: network.Channel,
                  prev_ch: network.Channel):
@@ -67,6 +73,7 @@ class Ring:
         """Full-duplex step: ship ``send_arr`` to the next rank while
         filling ``recv_arr`` from the previous rank. Both are contiguous
         numpy views — nothing is copied through intermediate bytes."""
+        self.m_link_bytes.inc(send_arr.nbytes)
         err: List[Exception] = []
 
         def _send():

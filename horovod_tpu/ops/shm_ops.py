@@ -86,6 +86,15 @@ class ShmBackend(CollectiveBackend):
         self._gen = 0
         self._dead = False
         self._opt_in = True if config is None else config.shm_enabled
+        self._m_regrows = None  # set by attach_metrics
+
+    def attach_metrics(self, registry) -> None:
+        super().attach_metrics(registry)
+        # Each regrow re-establishes the segment world-wide — a climbing
+        # count means payload sizes keep outgrowing the stride.
+        self._m_regrows = registry.counter(
+            "hvd_shm_segment_regrows_total",
+            "shared-memory segment re-establishments")
 
     def enabled(self, entries, response) -> bool:
         """World-consistent by construction: topology is identical on
@@ -132,6 +141,8 @@ class ShmBackend(CollectiveBackend):
         stride = _pad(max(stride, 2 * self._stride))
         total = stride * t.local_size * 2
         self._gen += 1
+        if self._m_regrows is not None:
+            self._m_regrows.inc()
         my_host = _my_hostname()
         new_map = None
         path = ""

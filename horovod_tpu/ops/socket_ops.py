@@ -31,6 +31,7 @@ from horovod_tpu.common.controller import Controller
 from horovod_tpu.common.message import (
     Response, datatype_to_numpy_dtype, numpy_dtype_to_datatype,
 )
+from horovod_tpu.common.metrics import NOOP_METRIC
 from horovod_tpu.common.status import Status
 from horovod_tpu.common.timeline import (
     ACT_MEMCPY_IN_FUSION_BUFFER, ACT_MEMCPY_OUT_FUSION_BUFFER,
@@ -156,6 +157,11 @@ def _unpack_fused(entries, arrays, result: np.ndarray, response: Response):
 class SocketBackend(CollectiveBackend):
     name = "socket"
 
+    # Metrics defaults for never-attached (metrics-off) backends.
+    _m_star_ops = NOOP_METRIC
+    _m_ring_ops = NOOP_METRIC
+    _m_ring_link_bytes = None
+
     def __init__(self, controller: Controller, secret: bytes = b"",
                  config=None):
         from horovod_tpu.common.config import Config
@@ -173,6 +179,19 @@ class SocketBackend(CollectiveBackend):
 
     def enabled(self, entries, response) -> bool:
         return self._ctl.size > 1
+
+    def attach_metrics(self, registry) -> None:
+        super().attach_metrics(registry)
+        # Which route the negotiated size picked — the live answer to
+        # "are my payloads riding the ring or funneling through the
+        # star?" (docs/metrics.md).
+        self._m_star_ops = registry.counter(
+            'hvd_socket_path_ops_total{path="star"}')
+        self._m_ring_ops = registry.counter(
+            'hvd_socket_path_ops_total{path="ring"}')
+        self._m_ring_link_bytes = registry.counter(
+            "hvd_ring_link_bytes_total",
+            "bytes this rank shipped over its ring link")
 
     def fused_cycle_reducible(self, nbytes: int) -> bool:
         """Star-bound batches (below the ring threshold) already move
@@ -200,6 +219,9 @@ class SocketBackend(CollectiveBackend):
             from horovod_tpu.ops import ring as _ring
             self._ring = _ring.establish(self._ctl, self._secret,
                                          hb=self._ring_hb)
+            if self._ring is not None \
+                    and self._m_ring_link_bytes is not None:
+                self._ring.m_link_bytes = self._m_ring_link_bytes
         return self._ring
 
     # -- allreduce -------------------------------------------------------
@@ -215,6 +237,8 @@ class SocketBackend(CollectiveBackend):
         # Large payloads ride the ring (every rank computes the same
         # negotiated size, so the path choice is world-consistent).
         ring = self._ring_for(fused.nbytes)
+        (self._m_ring_ops if ring is not None
+         else self._m_star_ops).inc()
         if ring is not None:
             # allreduce is not in-place at the API: never mutate a buffer
             # that may alias the caller's tensor.

@@ -77,6 +77,20 @@ class XlaMeshBackend(CollectiveBackend):
         self._my_device = None
         self._cache: Dict[Tuple, object] = {}
         self._available = None
+        self._m_compiles = None  # set by attach_metrics
+        self._m_cache_size = None
+
+    def attach_metrics(self, registry) -> None:
+        super().attach_metrics(registry)
+        # Compilation is the mesh plane's dominant first-use cost; a
+        # climbing compile count in steady state means shape churn is
+        # defeating the executable cache.
+        self._m_compiles = registry.counter(
+            "hvd_xla_compiles_total",
+            "collective executables built (shard_map jit)")
+        self._m_cache_size = registry.gauge(
+            "hvd_xla_compiled_cache_size",
+            "distinct compiled collective executables held")
 
     def _rank_fn(self):
         return self._ctl.rank
@@ -204,6 +218,9 @@ class XlaMeshBackend(CollectiveBackend):
             if fn is None:
                 fn = builder()
                 self._cache[key] = fn
+                if self._m_compiles is not None:
+                    self._m_compiles.inc()
+                    self._m_cache_size.set(len(self._cache))
         return fn
 
     def _run_shard_op(self, kind: str, flat, out_specs, body, extra=(),

@@ -338,6 +338,19 @@ def main(argv: Optional[List[str]] = None) -> None:
                         help="re-probe ssh reachability of every host "
                              "even if a recent check succeeded "
                              "(reference: horovodrun --disable-cache)")
+    parser.add_argument("--metrics", action="store_true",
+                        help="arm the metrics plane on every rank "
+                             "(env HOROVOD_TPU_METRICS; docs/metrics.md)")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        help="rank-0 Prometheus /metrics port (implies "
+                             "--metrics; 0 = ephemeral; env "
+                             "HOROVOD_TPU_METRICS_PORT)")
+    parser.add_argument("--metrics-interval", type=float, default=None,
+                        help="seconds between world metric folds (env "
+                             "HOROVOD_TPU_METRICS_INTERVAL)")
+    parser.add_argument("--metrics-log", default=None,
+                        help="rank-0 JSONL snapshot file (implies "
+                             "--metrics; env HOROVOD_TPU_METRICS_LOG)")
     parser.add_argument("--verbose", action="store_true")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="training command")
@@ -354,13 +367,34 @@ def main(argv: Optional[List[str]] = None) -> None:
     start_timeout = args.start_timeout or float(
         os.environ.get("HOROVOD_START_TIMEOUT", "30"))
 
+    # Metrics-plane knobs, plumbed to every spawned rank (workers read
+    # them through Config.from_env; the flags win over inherited env).
+    metrics_env: Dict[str, str] = {}
+    if args.metrics or args.metrics_port is not None \
+            or args.metrics_log is not None:
+        metrics_env["HOROVOD_TPU_METRICS"] = "1"
+    if args.metrics_port is not None:
+        metrics_env["HOROVOD_TPU_METRICS_PORT"] = str(args.metrics_port)
+    if args.metrics_interval is not None:
+        metrics_env["HOROVOD_TPU_METRICS_INTERVAL"] = \
+            str(args.metrics_interval)
+    if args.metrics_log is not None:
+        metrics_env["HOROVOD_TPU_METRICS_LOG"] = args.metrics_log
+    # Multihost task servers forward only an explicit env set; carry
+    # env-configured metrics knobs across hosts too, not just flags.
+    for key in ("HOROVOD_TPU_METRICS", "HOROVOD_TPU_METRICS_PORT",
+                "HOROVOD_TPU_METRICS_INTERVAL",
+                "HOROVOD_TPU_METRICS_LOG"):
+        if key in os.environ:
+            metrics_env.setdefault(key, os.environ[key])
+
     if not args.hosts or all(
             h in _local_hosts() for h, _ in parse_hosts(args.hosts)):
         if args.hosts:
             total = sum(s for _, s in parse_hosts(args.hosts))
             if total != args.num_proc:
                 parser.error(f"-np {args.num_proc} != total slots {total}")
-        sys.exit(run_local(args.num_proc, command,
+        sys.exit(run_local(args.num_proc, command, env=metrics_env,
                            start_timeout=start_timeout))
 
     hosts = parse_hosts(args.hosts)
@@ -369,6 +403,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         parser.error(f"-np {args.num_proc} != total slots {total}")
     try:
         sys.exit(run_multihost(hosts, command, ssh_port=args.ssh_port,
+                               env=metrics_env,
                                start_timeout=start_timeout,
                                disable_cache=args.disable_cache))
     except RuntimeError as e:

@@ -934,6 +934,129 @@ def scenario_cache_byte_budget(hvd, rank, size):
 scenario_cache_byte_budget.no_auto_init = True
 
 
+def scenario_metrics_world(hvd, rank, size):
+    """World-aggregated metrics plane end to end (HOROVOD_TPU_METRICS
+    + interval + ephemeral port set by the pytest wrapper): a steady
+    allreduce loop runs, every rank allgathers its LOCAL
+    hvd_bytes_allreduced_total, and rank 0 polls its control-tree
+    world aggregate until it equals the per-rank sum exactly — then
+    scrapes the live Prometheus endpoint and asserts the text view
+    agrees. Runs identically across shm / socket / hierarchical
+    worlds (the hier wrapper proves local roots fold their host into
+    one METRICS frame without losing counts)."""
+    import time
+    import urllib.request
+
+    ssum = sum(range(1, size + 1))
+    x = np.full(256, float(rank + 1), np.float64)
+    steps = 20
+    for _ in range(steps):
+        out = hvd.allreduce(x, average=False, name="mw.steady")
+        np.testing.assert_allclose(out, ssum)
+
+    view = hvd.metrics()
+    assert view["enabled"], view
+    local = view["local"]["hvd_bytes_allreduced_total"]["v"]
+    assert local == steps * x.nbytes, (rank, local, steps * x.nbytes)
+    # Share the true per-rank totals over the data plane (allgather
+    # moves bytes too, but not ALLREDUCE bytes — the counter under
+    # test stays frozen from here on).
+    got = np.asarray(hvd.allgather(
+        np.asarray([[local]], np.float64), name="mw.locals"))
+    expected_world = float(got.sum())
+
+    if rank == 0:
+        port = view["http_port"]
+        assert port and port > 0, view
+        deadline = time.monotonic() + 30.0
+        world_v = None
+        while time.monotonic() < deadline:
+            world = hvd.metrics()["world"]
+            world_v = world.get("hvd_bytes_allreduced_total",
+                                {}).get("v")
+            reporting = world.get("hvd_ranks_reporting", {}).get("v")
+            if world_v == expected_world and reporting == size:
+                break
+            time.sleep(0.1)
+        assert world_v == expected_world, (world_v, expected_world)
+        # the live Prometheus endpoint must agree with the API view
+        txt = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ).read().decode()
+        value_line = [l for l in txt.splitlines()
+                      if l.startswith("hvd_bytes_allreduced_total ")]
+        assert value_line, txt[:2000]
+        assert float(value_line[0].split()[1]) == expected_world, \
+            (value_line, expected_world)
+        assert "# TYPE hvd_bytes_allreduced_total counter" in txt
+        assert "hvd_negotiation_seconds_count" in txt
+        assert "hvd_cycle_seconds_bucket" in txt
+        if size > 1:
+            assert "hvd_peer_heartbeat_age_seconds" in txt
+    # hold the world together until rank 0 finished polling/scraping
+    hvd.barrier(name="mw.done")
+
+
+def scenario_metrics_sigkill(hvd, rank, size):
+    """SIGKILL a rank mid-run WHILE rank 0 is being scraped (fault
+    spec + metrics env set by the pytest wrapper): the metrics plane —
+    out-of-band frames on the very channels the abort protocol
+    watches — must not mask PR 2's fail-fast invariant. Survivors
+    raise WorldAbortedError naming the dead rank within the heartbeat
+    deadline, with a scraper thread hammering /metrics throughout."""
+    import threading
+    import time
+    import urllib.request
+    from horovod_tpu.common.status import WorldAbortedError
+
+    victim = 1
+    deadline_s = float(os.environ["HOROVOD_HEARTBEAT_TIMEOUT"]) + 12.0
+    scrapes = []
+    stop = threading.Event()
+    if rank == 0:
+        port = hvd.metrics()["http_port"]
+        assert port and port > 0
+
+        def _scrape_loop():
+            while not stop.is_set():
+                try:
+                    txt = urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics",
+                        timeout=2).read().decode()
+                    scrapes.append("hvd_cycles_total" in txt)
+                except Exception:
+                    pass
+                time.sleep(0.05)
+
+        t = threading.Thread(target=_scrape_loop, daemon=True)
+        t.start()
+
+    x = np.full(64, float(rank + 1), np.float32)
+    t0 = time.monotonic()
+    aborted = None
+    while True:
+        try:
+            hvd.allreduce(x, average=False, name="ms.steady")
+        except WorldAbortedError as e:
+            aborted = e
+            break
+        assert time.monotonic() - t0 < deadline_s, (
+            f"rank {rank}: collectives kept succeeding {deadline_s}s "
+            f"after the fault")
+    assert aborted.origin_rank == victim, (rank, str(aborted))
+    assert f"rank {victim}" in str(aborted), str(aborted)
+    if rank == 0:
+        stop.set()
+        assert scrapes and any(scrapes), \
+            "no successful scrape while the world was live"
+    try:
+        hvd.allreduce(x, average=False, name="ms.post")
+        raise AssertionError("enqueue after world abort must fail")
+    except WorldAbortedError as e:
+        assert e.origin_rank == victim, str(e)
+    hvd.shutdown()
+
+
 def scenario_kitchen_sink(hvd, rank, size):
     """Every auxiliary subsystem enabled at once — autotune (+log),
     timeline (+cycle marks), hierarchical shm over a fake 2-host

@@ -1,0 +1,410 @@
+"""Metrics-plane tests: registry semantics, world-fold merge rules,
+wire codec, Prometheus rendering, the rank-0 read surfaces, the
+disabled path's no-op guarantee, and multi-process world aggregation
+(including the hierarchical local-root fold and a SIGKILL mid-scrape
+preserving the PR 2 fail-fast abort)."""
+
+import json
+import os
+import signal
+import urllib.request
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common import metrics as hm
+from horovod_tpu.common import wire
+from tests.test_multiprocess import run_scenario
+
+_METRICS_ENV = {
+    "HOROVOD_TPU_METRICS": "1",
+    "HOROVOD_TPU_METRICS_INTERVAL": "0.2",
+    "HOROVOD_TPU_METRICS_PORT": "0",
+}
+
+
+# -- registry / metric semantics -------------------------------------------
+
+class TestRegistry:
+    def test_counter_gauge_histogram_basics(self):
+        reg = hm.MetricsRegistry()
+        c = reg.counter("c_total")
+        c.inc()
+        c.inc(4)
+        g = reg.gauge("g", agg=hm.AGG_MAX)
+        g.set(2.5)
+        h = reg.histogram("h_seconds", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        snap = reg.snapshot()
+        assert snap["c_total"] == {"k": "c", "v": 5.0}
+        assert snap["g"] == {"k": "g", "agg": "max", "v": 2.5}
+        assert snap["h_seconds"]["counts"] == [1, 1, 1]
+        assert snap["h_seconds"]["count"] == 3
+        assert snap["h_seconds"]["sum"] == pytest.approx(5.55)
+
+    def test_factories_memoize_by_name(self):
+        reg = hm.MetricsRegistry()
+        assert reg.counter("x") is reg.counter("x")
+        with pytest.raises(ValueError):
+            reg.gauge("x")  # kind mismatch on a reused name
+
+    def test_reuse_with_different_identity_raises(self):
+        """agg and bucket bounds are metric identity (merge_into fails
+        loudly on them cross-rank) — a second call site disagreeing
+        within a rank must raise, not silently adopt the first."""
+        reg = hm.MetricsRegistry()
+        reg.gauge("g", agg=hm.AGG_MAX)
+        with pytest.raises(ValueError):
+            reg.gauge("g")  # default agg=sum
+        reg.histogram("h", buckets=(0.1, 1.0))
+        with pytest.raises(ValueError):
+            reg.histogram("h")  # default latency buckets
+
+    def test_histogram_rejects_unsorted_buckets(self):
+        with pytest.raises(ValueError):
+            hm.Histogram("h", buckets=(1.0, 0.5))
+
+    def test_collectors_run_at_snapshot(self):
+        reg = hm.MetricsRegistry()
+        g = reg.gauge("depth")
+        reg.add_collector(lambda: g.set(7))
+        assert reg.snapshot()["depth"]["v"] == 7.0
+
+    def test_disabled_registry_is_noop(self):
+        reg = hm.create_registry(False)
+        assert reg is hm.NOOP_REGISTRY
+        assert reg.counter("a") is hm.NOOP_METRIC
+        assert reg.gauge("b") is hm.NOOP_METRIC
+        assert reg.histogram("c") is hm.NOOP_METRIC
+        hm.NOOP_METRIC.inc()
+        hm.NOOP_METRIC.observe(1.0)
+        hm.NOOP_METRIC.set(2.0)
+        assert reg.snapshot() == {}
+
+
+class TestMergeSemantics:
+    def test_counters_sum(self):
+        a = {"c": {"k": "c", "v": 3.0}}
+        hm.merge_into(a, {"c": {"k": "c", "v": 4.0}})
+        assert a["c"]["v"] == 7.0
+
+    def test_gauges_sum_or_max(self):
+        a = {"d": {"k": "g", "agg": "sum", "v": 2.0},
+             "age": {"k": "g", "agg": "max", "v": 1.0}}
+        hm.merge_into(a, {"d": {"k": "g", "agg": "sum", "v": 5.0},
+                          "age": {"k": "g", "agg": "max", "v": 9.0}})
+        assert a["d"]["v"] == 7.0
+        assert a["age"]["v"] == 9.0  # max-age: oldest silence wins
+
+    def test_histograms_add_bucketwise(self):
+        a = {"h": {"k": "h", "bounds": [0.1, 1.0],
+                   "counts": [1, 0, 2], "sum": 5.0, "count": 3}}
+        hm.merge_into(a, {"h": {"k": "h", "bounds": [0.1, 1.0],
+                                "counts": [0, 4, 1], "sum": 2.0,
+                                "count": 5}})
+        assert a["h"]["counts"] == [1, 4, 3]
+        assert a["h"]["sum"] == 7.0 and a["h"]["count"] == 8
+
+    def test_identity_mismatches_raise(self):
+        with pytest.raises(ValueError):
+            hm.merge_into({"x": {"k": "c", "v": 1.0}},
+                          {"x": {"k": "g", "agg": "sum", "v": 1.0}})
+        with pytest.raises(ValueError):
+            hm.merge_into(
+                {"h": {"k": "h", "bounds": [1.0], "counts": [0, 0],
+                       "sum": 0.0, "count": 0}},
+                {"h": {"k": "h", "bounds": [2.0], "counts": [0, 0],
+                       "sum": 0.0, "count": 0}})
+
+    def test_merge_into_copies_new_records(self):
+        src = {"h": {"k": "h", "bounds": [1.0], "counts": [1, 0],
+                     "sum": 0.5, "count": 1}}
+        dst = hm.merge_into({}, src)
+        hm.merge_into(dst, src)
+        assert src["h"]["counts"] == [1, 0]  # source untouched
+        assert dst["h"]["counts"] == [2, 0]
+
+
+class TestWireCodec:
+    def _snap(self):
+        reg = hm.MetricsRegistry()
+        reg.counter("bytes_total").inc(4096)
+        reg.gauge('age{peer="3"}', agg=hm.AGG_MAX).set(1.5)
+        h = reg.histogram("lat_seconds", buckets=(0.01, 0.1))
+        h.observe(0.005)
+        h.observe(0.05)
+        return reg.snapshot()
+
+    def test_roundtrip(self):
+        snap = self._snap()
+        nranks, back = wire.parse_metrics_frame(
+            wire.serialize_metrics_frame(3, snap))
+        assert nranks == 3
+        assert back == snap
+
+    def test_combine_sums_frames_and_ranks(self):
+        snap = self._snap()
+        f = wire.serialize_metrics_frame(1, snap)
+        nranks, merged = wire.parse_metrics_frame(
+            wire.combine_metrics_frames([f, f, f]))
+        assert nranks == 3
+        assert merged["bytes_total"]["v"] == 3 * 4096
+        assert merged['age{peer="3"}']["v"] == 1.5  # max, not sum
+        assert merged["lat_seconds"]["counts"] == [3, 3, 0]
+
+    def test_unknown_version_rejected(self):
+        blob = bytearray(wire.serialize_metrics_frame(1, {}))
+        blob[0] = 99
+        with pytest.raises(ValueError):
+            wire.parse_metrics_frame(bytes(blob))
+
+    def test_combine_drop_incompatible_keeps_healthy_frames(self):
+        """A local root folding its host must skip ONE skewed leaf's
+        frame, not silence the whole host (TcpWorker.send_metrics)."""
+        good = wire.serialize_metrics_frame(
+            1, {"b_total": {"k": "c", "v": 5.0}})
+        skewed = wire.serialize_metrics_frame(
+            1, {"b_total": {"k": "g", "agg": "sum", "v": 1.0}})
+        nranks, merged = wire.parse_metrics_frame(
+            wire.combine_metrics_frames(
+                [good, skewed, b"\x99garbage", good],
+                drop_incompatible=True))
+        assert nranks == 2
+        assert merged["b_total"] == {"k": "c", "v": 10.0}
+        with pytest.raises(Exception):
+            wire.combine_metrics_frames([good, skewed])
+
+
+class TestPrometheusRendering:
+    def test_counters_gauges_and_labels(self):
+        txt = hm.render_prometheus({
+            "a_total": {"k": "c", "v": 5.0},
+            'ops_total{op="allreduce"}': {"k": "c", "v": 2.0},
+            "depth": {"k": "g", "agg": "sum", "v": 3.0},
+        })
+        assert "# TYPE a_total counter" in txt
+        assert "a_total 5" in txt.splitlines()
+        assert 'ops_total{op="allreduce"} 2' in txt.splitlines()
+        assert "# TYPE depth gauge" in txt
+
+    def test_help_renders_once_per_base(self):
+        reg = hm.MetricsRegistry()
+        reg.counter('ops_total{op="a"}', "batches executed").inc()
+        reg.counter('ops_total{op="b"}').inc()
+        txt = hm.render_prometheus(reg.snapshot())
+        assert txt.count("# HELP ops_total batches executed") == 1
+        assert txt.count("# TYPE ops_total counter") == 1
+
+    def test_histogram_renders_cumulative_with_inf(self):
+        txt = hm.render_prometheus({
+            'h_seconds{op="x"}': {"k": "h", "bounds": [0.1, 1.0],
+                                  "counts": [2, 1, 3], "sum": 9.5,
+                                  "count": 6}})
+        lines = txt.splitlines()
+        assert "# TYPE h_seconds histogram" in lines
+        assert 'h_seconds_bucket{op="x",le="0.1"} 2' in lines
+        assert 'h_seconds_bucket{op="x",le="1"} 3' in lines
+        assert 'h_seconds_bucket{op="x",le="+Inf"} 6' in lines
+        assert 'h_seconds_sum{op="x"} 9.5' in lines
+        assert 'h_seconds_count{op="x"} 6' in lines
+
+
+class TestWorldAggregator:
+    def test_world_folds_local_and_owner_frames(self):
+        agg = hm.WorldAggregator(size=4)
+        agg.update_local({"b_total": {"k": "c", "v": 10.0}})
+        frame = wire.serialize_metrics_frame(
+            2, {"b_total": {"k": "c", "v": 32.0}})
+        agg.ingest(2, frame)
+        w = agg.world()
+        assert w["b_total"]["v"] == 42.0
+        assert w["hvd_ranks_reporting"]["v"] == 3.0  # 1 local + 2 folded
+        assert w["hvd_world_size"]["v"] == 4.0
+
+    def test_latest_frame_wins_no_double_count(self):
+        agg = hm.WorldAggregator(size=2)
+        for v in (5.0, 8.0):
+            agg.ingest(1, wire.serialize_metrics_frame(
+                1, {"b_total": {"k": "c", "v": v}}))
+        assert agg.world()["b_total"]["v"] == 8.0
+
+    def test_garbled_frame_dropped(self):
+        agg = hm.WorldAggregator(size=2)
+        agg.ingest(1, b"\x99garbage")
+        assert agg.world()["hvd_ranks_reporting"]["v"] == 0.0
+
+    def test_identity_mismatched_frame_dropped_not_poisonous(self):
+        """A parseable frame whose metric identity disagrees (skewed
+        code across ranks) must be dropped at ingest — never stored to
+        make every later world() raise and 500 the endpoint."""
+        agg = hm.WorldAggregator(size=2)
+        agg.update_local({"x": {"k": "c", "v": 1.0}})
+        agg.ingest(1, wire.serialize_metrics_frame(
+            1, {"x": {"k": "g", "agg": "sum", "v": 9.0}}))
+        w = agg.world()  # must not raise
+        assert w["x"]["v"] == 1.0
+        assert w["hvd_ranks_reporting"]["v"] == 1.0
+
+
+def test_http_server_serves_prometheus_and_json():
+    snap = {"up_total": {"k": "c", "v": 1.0}}
+    srv = hm.MetricsHTTPServer(lambda: snap, port=0)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        txt = urllib.request.urlopen(base + "/metrics",
+                                     timeout=5).read().decode()
+        assert "up_total 1" in txt
+        data = json.loads(urllib.request.urlopen(
+            base + "/metrics.json", timeout=5).read().decode())
+        assert data == snap
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(base + "/nope", timeout=5)
+    finally:
+        srv.close()
+
+
+# -- stall-report extension (satellite) ------------------------------------
+
+def test_stall_report_carries_world_stats(capsys):
+    from horovod_tpu.common import logging as hlog
+    from horovod_tpu.common.coordinator import MessageTable, StallInspector
+    from horovod_tpu.common.message import Request
+
+    hlog.set_level("warning")
+    insp = StallInspector(size=2, warning_time=0.0)
+    table = MessageTable()
+    table.increment_tensor_count(
+        Request(request_rank=0, tensor_name="grad"), 2)
+    insp.check(table, world_stats="tensor queue depth 3; oldest peer "
+                                  "heartbeat ages: rank 1 4.2s")
+    err = capsys.readouterr().err
+    assert "Stalled op: grad" in err
+    assert "[world: tensor queue depth 3" in err
+    assert "rank 1 4.2s" in err
+
+
+# -- the disabled path: no-op hooks on every instrumented site -------------
+
+def test_disabled_metrics_installs_noop_hooks_everywhere():
+    """Tier-1 guard for the zero-overhead contract: with
+    HOROVOD_TPU_METRICS unset (the default), every instrumented call
+    site across the runtime, controller and op backends must hold the
+    shared no-op metric — not a real counter, not None-guarded
+    ad-hockery — and the gated clock reads must be off."""
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics as _b
+
+    hvd.shutdown()
+    assert os.environ.get("HOROVOD_TPU_METRICS", "0") != "1"
+    hvd.init()
+    try:
+        rt = _b.runtime()
+        assert rt.metrics is hm.NOOP_REGISTRY
+        assert not rt._metrics_on
+        sites = [n for n in dir(rt) if n.startswith("_m_")]
+        assert len(sites) >= 15, sites
+        for n in sites:
+            assert getattr(rt, n) is hm.NOOP_METRIC, n
+        om = rt.op_manager
+        assert not om._metrics_on
+        for m in (list(om._m_ops.values()) + list(om._m_bytes.values())
+                  + list(om._m_wall.values()) + [om._m_fill]):
+            assert m is hm.NOOP_METRIC
+        for b in om._backends:
+            assert b.m_ops is hm.NOOP_METRIC, b.name
+            assert b.m_bytes is hm.NOOP_METRIC, b.name
+        ctl = rt.controller
+        assert not ctl._metrics_on
+        assert ctl._m_ctrl_rx is hm.NOOP_METRIC
+        assert ctl._m_ctrl_tx is hm.NOOP_METRIC
+        assert rt._aggregator is None
+        assert rt._metrics_http is None
+        view = hvd.metrics()
+        assert not view["enabled"] and view["local"] == {}
+    finally:
+        hvd.shutdown()
+
+
+# -- single-process end-to-end (size-1 world, all three surfaces) ----------
+
+def test_metrics_single_process_surfaces(tmp_path):
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics as _b
+    from horovod_tpu.common.config import Config
+
+    hvd.shutdown()
+    log_path = str(tmp_path / "metrics.jsonl")
+    cfg = Config.from_env()
+    cfg.metrics_enabled = True
+    cfg.metrics_interval_s = 0.05
+    cfg.metrics_port = 0
+    cfg.metrics_log = log_path
+    hvd.init(config=cfg)
+    try:
+        x = np.ones(512, np.float32)
+        for i in range(4):
+            hvd.allreduce(x, average=False, name=f"sp.{i}")
+        import time
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if os.path.exists(log_path) and \
+                    os.path.getsize(log_path) > 0:
+                break
+            time.sleep(0.05)
+        view = hvd.metrics()
+        assert view["enabled"]
+        assert view["local"]["hvd_bytes_allreduced_total"]["v"] \
+            == 4 * x.nbytes
+        assert view["world"]["hvd_bytes_allreduced_total"]["v"] \
+            == 4 * x.nbytes
+        assert view["local"]['hvd_ops_total{op="allreduce"}']["v"] == 4
+        assert view["local"]["hvd_cycle_seconds"]["count"] > 0
+        txt = urllib.request.urlopen(
+            f"http://127.0.0.1:{view['http_port']}/metrics",
+            timeout=5).read().decode()
+        assert f"hvd_bytes_allreduced_total {4 * x.nbytes}" in txt
+        with open(log_path) as f:
+            line = json.loads(f.readline())
+        assert "world" in line and "ts" in line
+    finally:
+        hvd.shutdown()
+
+
+# -- multi-process world aggregation ---------------------------------------
+
+@pytest.mark.parametrize("mode,extra", [
+    ("shm", {}),
+    ("socket", {"HOROVOD_TPU_SHM": "0"}),
+])
+def test_metrics_world_aggregation(mode, extra):
+    """ws=4: rank 0's world-aggregated bytes_allreduced must equal the
+    sum of every rank's local counter, and the live /metrics scrape
+    must agree (the acceptance-criteria assertion)."""
+    run_scenario("metrics_world", 4, timeout=120.0,
+                 extra_env={**_METRICS_ENV, **extra})
+
+
+def test_metrics_world_aggregation_hier_controller():
+    """Same world-sum exactness when remote leaves fold behind a local
+    root: the root must combine its host's METRICS frames into one
+    upward frame without losing or double-counting ranks."""
+    run_scenario("metrics_world", 4, timeout=120.0,
+                 extra_env=_METRICS_ENV,
+                 per_rank_env=lambda rank: {
+                     "HOROVOD_HOSTNAME": f"fakehost{rank // 2}"})
+
+
+def test_metrics_sigkill_mid_scrape_preserves_abort():
+    """SIGKILL rank 1 mid-collective while rank 0 is being scraped:
+    survivors still raise WorldAbortedError naming the dead rank
+    within the heartbeat deadline — the metrics plane must never mask
+    the PR 2 fail-fast invariant."""
+    run_scenario(
+        "metrics_sigkill", 3, timeout=60.0,
+        extra_env={**_METRICS_ENV,
+                   "HOROVOD_HEARTBEAT_INTERVAL": "0.3",
+                   "HOROVOD_HEARTBEAT_TIMEOUT": "3",
+                   "HOROVOD_FAULT_SPEC": "rank=1:kill:op=25"},
+        expect_rc={1: -signal.SIGKILL})

@@ -107,6 +107,112 @@ def test_timeline_cached_negotiation_markers(tmp_path):
         n for n in names if n and "NEGOT" in n)
 
 
+def test_timeline_flushed_on_world_abort(tmp_path, monkeypatch):
+    """Abort-path flush regression: a WorldAbortedError teardown —
+    even one where the finalizer drain AND a user completion callback
+    raise — must still close the timeline's JSON array. The aborted
+    runs are exactly the traces you most want to inspect."""
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics as _b
+    from horovod_tpu.common.message import Request
+    from horovod_tpu.common.status import (
+        WorldAbortedError, world_abort_message,
+    )
+    from horovod_tpu.common.tensor_table import TensorTableEntry
+
+    hvd.shutdown()
+    path = str(tmp_path / "tl_abort.json")
+    monkeypatch.setenv("HOROVOD_TIMELINE", path)
+    hvd.init()
+    try:
+        rt = _b.runtime()
+        x = np.ones(8, np.float32)
+        np.testing.assert_allclose(
+            hvd.allreduce(x, average=False, name="ab.ar"), x)
+
+        # hostile teardown: a raising finalizer drain and a pending
+        # entry whose completion callback raises
+        if rt.finalizer is not None:
+            def _bad_drain():
+                raise RuntimeError("drain boom")
+            rt.finalizer.drain = _bad_drain
+
+        def _bad_cb(status):
+            raise RuntimeError("user callback boom")
+        rt.tensor_table.add(
+            TensorTableEntry("ab.pending", x, callback=_bad_cb),
+            Request(tensor_name="ab.pending"))
+
+        def _abort(payload):
+            raise WorldAbortedError(
+                world_abort_message(0, "injected test abort"),
+                origin_rank=0, cause="injected test abort")
+        rt.controller.gather_requests = _abort
+        rt._wake.set()
+        rt.join(timeout=20.0)
+        assert rt._done.is_set()
+        assert isinstance(rt._error, WorldAbortedError)
+    finally:
+        hvd.shutdown()
+    events = _load_events(path)  # valid JSON: the array was closed
+    assert any(e.get("name") == "ALLREDUCE" for e in events)
+
+
+def test_timeline_flushed_on_sigkill_abort(tmp_path):
+    """End-to-end: rank 1 of 3 is SIGKILL'd mid-collective; rank 0's
+    timeline must still be a terminated, loadable trace after its
+    WorldAbortedError teardown."""
+    import signal
+    path = str(tmp_path / "tl_sigkill.json")
+    run_scenario(
+        "abort_sigkill_leaf", 3, timeout=60.0,
+        extra_env={"HOROVOD_TIMELINE": path,
+                   "HOROVOD_HEARTBEAT_INTERVAL": "0.3",
+                   "HOROVOD_HEARTBEAT_TIMEOUT": "3",
+                   "HOROVOD_FAULT_SPEC": "rank=1:kill:op=3"},
+        expect_rc={1: -signal.SIGKILL})
+    events = _load_events(path)
+    assert any(e.get("name") == "ALLREDUCE" for e in events)
+
+
+def test_timeline_writer_queue_bounded(tmp_path):
+    """A wedged writer (hung disk) must not grow the queue without
+    limit: events past the cap are dropped and counted, the dropped
+    count feeds an attached metrics counter, and the trace still
+    terminates as valid JSON once the writer recovers."""
+    import threading
+
+    from horovod_tpu.common.metrics import MetricsRegistry
+    from horovod_tpu.common.timeline import Timeline
+
+    gate = threading.Event()
+    orig_loop = Timeline._write_loop
+
+    def stalled_loop(self):
+        gate.wait()
+        orig_loop(self)
+
+    path = str(tmp_path / "tl_bounded.json")
+    Timeline._write_loop = stalled_loop
+    try:
+        tl = Timeline(path, queue_capacity=8)
+        counter = MetricsRegistry().counter(
+            "hvd_timeline_dropped_events_total")
+        tl.attach_drop_counter(counter)
+        for i in range(100):
+            tl.start(f"t{i}", "ALLREDUCE")
+            tl.end(f"t{i}")
+        assert tl.dropped_events > 0
+        assert tl._queue.qsize() <= 8
+        assert counter.value == tl.dropped_events
+        gate.set()
+        tl.shutdown()
+    finally:
+        Timeline._write_loop = orig_loop
+    events = _load_events(path)  # lossy but valid + terminated
+    assert len(events) <= 9
+
+
 def test_timeline_off_by_default(tmp_path, monkeypatch):
     import horovod_tpu as hvd
     hvd.shutdown()
