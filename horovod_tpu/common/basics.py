@@ -14,9 +14,9 @@ loop so async semantics/fusion/timeline behave identically at any size.
 from __future__ import annotations
 
 import atexit
-import threading
 from typing import Optional
 
+from horovod_tpu.common import lockdep
 from horovod_tpu.common import logging as hlog
 from horovod_tpu.common.config import Config
 from horovod_tpu.common.controller import (
@@ -28,7 +28,7 @@ from horovod_tpu.ops.operation_manager import OperationManager
 from horovod_tpu.ops.socket_ops import SocketBackend
 from horovod_tpu.ops.xla_ops import XlaMeshBackend
 
-_lock = threading.Lock()
+_lock = lockdep.lock("basics._lock")
 _runtime: Optional[Runtime] = None
 
 

@@ -5,6 +5,14 @@ background-thread startup (reference: horovod/common/operations.cc:626-639
 helpers and 792-871). We keep the exact same names so scripts tuned for
 the reference carry over, plus ``HOROVOD_TPU_*`` extensions for the
 TPU-specific machinery.
+
+This module is the ONLY place the runtime reads the environment —
+enforced by ``python -m tools.hvdlint`` (the ``knobs`` analyzer):
+modules that need a knob outside a ``Config`` snapshot (module-level
+singletons, the launcher's child-env plumbing) go through the public
+``env_str``/``env_int``/``env_float``/``env_bool`` helpers so
+defaults, truthiness rules and the documentation contract stay in one
+place.
 """
 
 from __future__ import annotations
@@ -13,7 +21,12 @@ import dataclasses
 import os
 
 
-def _env_int(name: str, default: int) -> int:
+def env_str(name: str, default: str = "") -> str:
+    v = os.environ.get(name)
+    return default if v is None or v == "" else v
+
+
+def env_int(name: str, default: int) -> int:
     v = os.environ.get(name)
     if v is None or v == "":
         return default
@@ -23,7 +36,7 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
-def _env_float(name: str, default: float) -> float:
+def env_float(name: str, default: float) -> float:
     v = os.environ.get(name)
     if v is None or v == "":
         return default
@@ -33,12 +46,18 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
-def _env_bool(name: str, default: bool) -> bool:
+def env_bool(name: str, default: bool) -> bool:
     # Reference semantics: set and == "1" → on (operations.cc:626-631).
     v = os.environ.get(name)
     if v is None or v == "":
         return default
     return v.strip() in ("1", "true", "True", "TRUE", "yes", "on")
+
+
+# Internal aliases kept for the from_env body below.
+_env_int = env_int
+_env_float = env_float
+_env_bool = env_bool
 
 
 @dataclasses.dataclass
@@ -158,6 +177,12 @@ class Config:
     stall_check_disable: bool = False
     stall_check_time_seconds: float = 60.0
     stall_shutdown_time_seconds: float = 0.0
+
+    # Runtime lockdep (HOROVOD_TPU_LOCKCHECK, docs/static_analysis.md)
+    # is deliberately NOT a Config field: module-level locks exist
+    # before any Config snapshot does, so common/lockdep.py reads the
+    # knob once at first lock creation via env_str — a field here would
+    # be an inert second source of truth.
 
     # Fail-fast liveness (TPU-native extension; the reference has no
     # peer-death detection — a SIGKILL'd rank leaves peers blocked in

@@ -26,6 +26,7 @@ import struct
 import time
 from typing import Dict, List, Optional
 
+from horovod_tpu.common import config as hconfig
 from horovod_tpu.common import heartbeat
 from horovod_tpu.common import logging as hlog
 from horovod_tpu.common import network
@@ -38,7 +39,7 @@ def _my_hostname() -> str:
     hostname is meaningless, and lets tests force a multi-host shape
     on one machine (reference analog: host_hash's override-free
     hostname grouping, run/common/util/host_hash.py)."""
-    return os.environ.get("HOROVOD_HOSTNAME") or socket.gethostname()
+    return hconfig.env_str("HOROVOD_HOSTNAME") or socket.gethostname()
 
 
 def _local_root_addr() -> str:
@@ -47,7 +48,7 @@ def _local_root_addr() -> str:
     the host's ranks share a network namespace; per-rank containers
     that share only HOROVOD_HOSTNAME set HOROVOD_TPU_LOCAL_ROOT_ADDR
     to a mutually reachable address (the root binds it too)."""
-    return os.environ.get("HOROVOD_TPU_LOCAL_ROOT_ADDR", "127.0.0.1")
+    return hconfig.env_str("HOROVOD_TPU_LOCAL_ROOT_ADDR", "127.0.0.1")
 
 
 def host_groups(hostnames: List[str]):
@@ -1114,7 +1115,10 @@ class TcpCoordinator(Controller):
 
     def close(self) -> None:
         for ch in self._channels.values():
-            ch.close()
+            try:
+                ch.close()
+            except OSError:
+                pass  # stage-guarded: the listener must still close
         self._server.close()
 
 
@@ -1503,5 +1507,8 @@ class TcpWorker(Controller):
 
     def close(self) -> None:
         for ch in self._children.values():
-            ch.close()
+            try:
+                ch.close()
+            except OSError:
+                pass  # stage-guarded: the upward channel must still close
         self._ch.close()

@@ -416,11 +416,14 @@ class ResponseCache:
         if capacity <= 0:
             raise ValueError("ResponseCache capacity must be positive")
         self.capacity = capacity
-        self.epoch = 0
+        self.epoch = 0  # hvdlint: world-replicated
         # name -> entry, maintained in LRU order (first = oldest)
-        self._lru: "OrderedDict[str, _CacheEntry]" = OrderedDict()
-        self._slots: List[Optional[_CacheEntry]] = []
-        self._free: List[int] = []  # min-heap of freed slot indices
+        self._lru: "OrderedDict[str, _CacheEntry]" = \
+            OrderedDict()  # hvdlint: world-replicated
+        self._slots: List[Optional[_CacheEntry]] = \
+            []  # hvdlint: world-replicated
+        # min-heap of freed slot indices
+        self._free: List[int] = []  # hvdlint: world-replicated
         # local observability (not part of the coherent state)
         self.hits = 0
         self.misses = 0

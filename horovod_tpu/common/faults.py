@@ -48,6 +48,7 @@ import signal
 import time
 from typing import List, Optional
 
+from horovod_tpu.common import config as hconfig
 from horovod_tpu.common import logging as hlog
 
 _ACTIONS = ("kill", "exit", "hang", "sever", "delay")
@@ -162,7 +163,7 @@ def load_env() -> None:
     if _ENV_LOADED:
         return
     _ENV_LOADED = True
-    spec = os.environ.get("HOROVOD_FAULT_SPEC", "")
+    spec = hconfig.env_str("HOROVOD_FAULT_SPEC", "")
     if not spec:
         return
     parsed = parse_spec(spec)

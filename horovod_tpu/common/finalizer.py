@@ -24,6 +24,7 @@ import threading
 import time
 from typing import Callable, List
 
+from horovod_tpu.common import lockdep
 from horovod_tpu.common import logging as hlog
 
 
@@ -31,7 +32,7 @@ class Finalizer:
     """Detached per-batch completion threads with a drainable registry."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("finalizer.Finalizer._lock")
         self._threads: List[threading.Thread] = []
         self._closed = False
 

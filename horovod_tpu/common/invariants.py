@@ -1,0 +1,24 @@
+"""Machine-checked invariant annotations (see docs/static_analysis.md).
+
+``@world_coherent`` marks a function whose inputs are world-identical
+by construction — the broadcast response stream, the coordinator's
+grant/invalidate masks, the fused speculative verdict. hvdlint's
+``world-coherence`` analyzer enforces that every mutation of
+world-replicated state (the attributes carrying a
+``# hvdlint: world-replicated`` marker: the ResponseCache's
+slots/LRU/epoch, the runtime's steady predictor) is reachable ONLY
+through functions carrying this decorator. The decorator itself is
+identity at runtime; its value is that removing it — or adding a new
+rank-local call path to coherent state — fails the lint tier instead
+of diverging a live world.
+"""
+
+from __future__ import annotations
+
+
+def world_coherent(fn):
+    """Identity decorator: this function applies only world-identical
+    inputs, in the canonical world order, and may therefore mutate
+    world-replicated state (enforced by `python -m tools.hvdlint`)."""
+    fn.__world_coherent__ = True
+    return fn

@@ -1,0 +1,199 @@
+"""Runtime lock-order checker ("lockdep"): ``HOROVOD_TPU_LOCKCHECK``.
+
+The dynamic half of hvdlint's static ``lock-order`` analyzer (see
+docs/static_analysis.md): the static pass proves what it can resolve;
+this wrapper observes what actually runs — callback indirection,
+monkeypatched test seams, code paths the resolver cannot follow.
+
+Design, following the kernel's lockdep: locks are grouped into
+**classes by allocation-site name** (``"tensor_table.TensorTable
+._lock"`` — the same identities the static analyzer reports, so a
+runtime inversion and a static finding name the same thing). Each
+thread keeps its held-class stack; acquiring B while holding A records
+the world-visible edge A→B. The FIRST time the reverse edge of an
+already-recorded edge is attempted, that acquisition is an observed
+inversion: two threads interleaving those paths can deadlock. Modes:
+
+* ``HOROVOD_TPU_LOCKCHECK=1`` (or ``raise``/``on``/``true``) — raise
+  :class:`LockInversionError` *before* taking the lock, naming both
+  orders with their witness threads. Armed in the multiprocess test
+  worlds, so every mp scenario doubles as an inversion regression
+  test.
+* ``HOROVOD_TPU_LOCKCHECK=warn`` — log and count, never raise
+  (production triage). Either mode feeds
+  ``hvd_lockcheck_inversions_total`` on the metrics plane.
+* unset/empty — :func:`lock` returns a plain ``threading.Lock``:
+  zero steady-state overhead, nothing wrapped.
+
+Same-class edges (two *instances* of one allocation site) are skipped:
+instances are indistinguishable at class granularity and per-instance
+tracking would make every ``Counter._lock`` pair a false cycle.
+Conditions created via :func:`condition` share their lock's class, so
+``with cv:`` and ``with lock:`` order-check as the one lock they are.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from horovod_tpu.common import config as hconfig
+
+
+class LockInversionError(RuntimeError):
+    """Observed lock acquisition-order inversion (latent deadlock)."""
+
+
+_MODE_MAP = {"1": "raise", "true": "raise", "on": "raise",
+             "raise": "raise", "warn": "warn"}
+_mode: Optional[str] = None          # None = env not read yet
+_graph_lock = threading.Lock()
+# (first_class, then_class) -> thread name that witnessed the order
+_edges: Dict[Tuple[str, str], str] = {}
+_inversions = 0
+_tls = threading.local()
+
+
+def _get_mode() -> str:
+    global _mode
+    if _mode is None:
+        raw = hconfig.env_str("HOROVOD_TPU_LOCKCHECK", "").strip().lower()
+        _mode = _MODE_MAP.get(raw, "")
+    return _mode
+
+
+def enabled() -> bool:
+    return bool(_get_mode())
+
+
+def inversion_count() -> int:
+    """Lifetime observed inversions (mirrored to the metrics plane as
+    hvd_lockcheck_inversions_total by the runtime's collector)."""
+    return _inversions
+
+
+def reset(mode: Optional[str] = None) -> None:
+    """Tests only: drop the recorded graph/counter and re-read (or
+    force) the mode."""
+    global _mode, _inversions
+    with _graph_lock:
+        _edges.clear()
+        _inversions = 0
+    _mode = _MODE_MAP.get(mode, "") if mode is not None else None
+
+
+def _held() -> list:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+def _note_acquire(name: str) -> None:
+    """Record edges held->name; report on the first observed reverse.
+    Runs BEFORE the underlying acquire, so ``raise`` mode refuses the
+    inverting acquisition instead of deadlocking on it."""
+    global _inversions
+    held = _held()
+    me = threading.current_thread().name
+    for prev in held:
+        if prev == name:
+            continue  # same class: instances are indistinguishable
+        with _graph_lock:
+            witness = _edges.get((name, prev))
+            if witness is not None:
+                _inversions += 1
+                count = _inversions
+            else:
+                _edges.setdefault((prev, name), me)
+                continue
+        msg = (f"lock-order inversion: thread {me!r} acquires "
+               f"'{name}' while holding '{prev}', but thread "
+               f"{witness!r} established the order '{name}' -> "
+               f"'{prev}' — two threads interleaving these paths "
+               f"deadlock (inversion #{count}; "
+               f"HOROVOD_TPU_LOCKCHECK armed)")
+        if _get_mode() == "raise":
+            raise LockInversionError(msg)
+        from horovod_tpu.common import logging as hlog
+        hlog.warning(msg)
+
+
+def _push(name: str) -> None:
+    _held().append(name)
+
+
+def _pop(name: str) -> None:
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] == name:
+            del held[i]
+            return
+
+
+class _CheckedLock:
+    """Order-checking wrapper. Exposes the small surface the codebase
+    (and ``threading.Condition``) actually uses; Condition's fallback
+    protocol drives plain ``acquire``/``release``, which keeps the
+    held-stack exact across ``cv.wait()``'s release/reacquire."""
+
+    __slots__ = ("_name", "_lock")
+
+    def __init__(self, name: str, factory=threading.Lock):
+        self._name = name
+        self._lock = factory()
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if blocking:
+            # check/record first: refusing (or logging) the inverting
+            # acquisition BEFORE blocking on it is what turns a latent
+            # deadlock into a diagnosable error
+            _note_acquire(self._name)
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            _push(self._name)
+        return ok
+
+    def release(self) -> None:
+        self._lock.release()
+        _pop(self._name)
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "_CheckedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<_CheckedLock {self._name!r} {self._lock!r}>"
+
+
+def lock(name: str) -> "threading.Lock | _CheckedLock":
+    """A lock belonging to lockdep class ``name``. Plain
+    ``threading.Lock`` when lockcheck is off — call sites pay nothing
+    for the instrumentation they are not using."""
+    if not enabled():
+        return threading.Lock()
+    return _CheckedLock(name)
+
+
+def rlock(name: str) -> "threading.RLock | _CheckedLock":
+    if not enabled():
+        return threading.RLock()
+    return _CheckedLock(name, factory=threading.RLock)
+
+
+def condition(name: str, lock_obj=None) -> threading.Condition:
+    """A Condition order-checked under ``name`` (or sharing
+    ``lock_obj``'s class when given — Condition(lock) IS that lock)."""
+    if lock_obj is None:
+        lock_obj = lock(name)
+    return threading.Condition(lock_obj)

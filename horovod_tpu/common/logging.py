@@ -8,19 +8,20 @@ can be hidden with ``HOROVOD_LOG_HIDE_TIME``.
 
 from __future__ import annotations
 
-import os
 import sys
-import threading
 import time
+
+from horovod_tpu.common import config as hconfig
+from horovod_tpu.common import lockdep
 
 TRACE, DEBUG, INFO, WARNING, ERROR, FATAL = range(6)
 
 _LEVEL_NAMES = ["trace", "debug", "info", "warning", "error", "fatal"]
-_lock = threading.Lock()
+_lock = lockdep.lock("logging._lock")
 
 
 def _min_level() -> int:
-    name = os.environ.get("HOROVOD_LOG_LEVEL", "warning").lower()
+    name = hconfig.env_str("HOROVOD_LOG_LEVEL", "warning").lower()
     try:
         return _LEVEL_NAMES.index(name)
     except ValueError:
@@ -50,7 +51,7 @@ def log(level: int, msg: str, rank: int | None = None) -> None:
     if level < _min:
         return
     parts = []
-    if not os.environ.get("HOROVOD_LOG_HIDE_TIME"):
+    if not hconfig.env_bool("HOROVOD_LOG_HIDE_TIME", False):
         t = time.time()
         parts.append(time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(t))
                      + ".%06d" % int((t % 1) * 1e6))

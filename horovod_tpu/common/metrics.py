@@ -40,6 +40,8 @@ from __future__ import annotations
 import json
 import threading
 import time
+
+from horovod_tpu.common import lockdep
 from bisect import bisect_left
 from typing import Callable, Dict, List, Tuple
 
@@ -91,7 +93,7 @@ class Counter:
         self.name = name
         self.help = help
         self._v = 0.0
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("metrics.Counter._lock")
 
     def inc(self, v=1) -> None:
         with self._lock:
@@ -164,7 +166,7 @@ class Histogram:
         self._counts = [0] * (len(bounds) + 1)
         self._sum = 0.0
         self._count = 0
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("metrics.Histogram._lock")
 
     def observe(self, v) -> None:
         i = bisect_left(self.bounds, v)
@@ -222,7 +224,7 @@ class MetricsRegistry:
     enabled = True
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("metrics.MetricsRegistry._lock")
         self._metrics: "Dict[str, object]" = {}
         self._collectors: List[Callable[[], None]] = []
 
@@ -391,7 +393,7 @@ class WorldAggregator:
     the HTTP server thread and the public API."""
 
     def __init__(self, size: int = 1):
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("metrics.WorldAggregator._lock")
         self._size = size
         self._local: dict = {}
         # owner rank -> (nranks represented, snapshot, recv time)

@@ -26,6 +26,7 @@ from collections import OrderedDict
 from typing import Dict, List, Optional
 
 from horovod_tpu.common import faults
+from horovod_tpu.common import lockdep
 from horovod_tpu.common import logging as hlog
 from horovod_tpu.common import metrics as hmetrics
 from horovod_tpu.common import wire
@@ -35,6 +36,7 @@ from horovod_tpu.common.coordinator import (
     CACHEABLE_REQUESTS, CACHEABLE_RESPONSES, MessageTable, ResponseCache,
     StallInspector, construct_response, fuse_responses, iter_set_bits,
 )
+from horovod_tpu.common.invariants import world_coherent
 from horovod_tpu.common.message import (
     CacheCycleRequest, CacheCycleResponse, DataType, Request, RequestList,
     RequestType, Response, ResponseList, ResponseType,
@@ -170,9 +172,12 @@ class Runtime:
         # buffered training alternates two gradient buckets, periodic
         # metrics add an every-N-steps set — and each deserves the
         # fused round. Slot-based, so any structural cache event
-        # (epoch move) invalidates them all.
-        self._steady: "OrderedDict[int, frozenset]" = OrderedDict()
-        self._steady_epoch = -1
+        # (epoch move) invalidates them all. Epoch-coupled predictions
+        # are world-replicated state: they may only move on broadcast
+        # verdicts, which hvdlint's world-coherence analyzer enforces.
+        self._steady: "OrderedDict[int, frozenset]" = \
+            OrderedDict()  # hvdlint: world-replicated
+        self._steady_epoch = -1  # hvdlint: world-replicated
         # The coordinator's effective fusion threshold, broadcast on
         # cached-cycle responses: replay and speculative packing must
         # fuse with the WORLD's value, not this rank's local config
@@ -242,6 +247,10 @@ class Runtime:
             "time spent in the steady-state idle hold")
         self._m_timeline_dropped = reg.counter(
             "hvd_timeline_dropped_events_total")
+        self._m_lock_inversions = reg.counter(
+            "hvd_lockcheck_inversions_total",
+            "lock-order inversions observed by the runtime lockdep "
+            "(HOROVOD_TPU_LOCKCHECK; 0 when unarmed)")
         # The fused speculative cycle bypasses OperationManager, so the
         # runtime owns its share of the allreduce op/byte totals (the
         # registry memoizes by name — these are the SAME counters the
@@ -497,8 +506,14 @@ class Runtime:
                 except Exception:
                     pass
             if self._metrics_http is not None:
-                self._metrics_http.close()
-            self.op_manager.close()
+                try:
+                    self._metrics_http.close()
+                except Exception:
+                    pass  # stage-guarded: backends must still close
+            try:
+                self.op_manager.close()
+            except Exception:
+                pass  # stage-guarded: the controller must still close
             try:
                 self.controller.close()
             except Exception:
@@ -939,6 +954,7 @@ class Runtime:
     # eviction can never drift apart.
     _iter_slots = staticmethod(iter_set_bits)
 
+    @world_coherent
     def _apply_cached_cycle(self, meta: CacheCycleResponse,
                             bit_requests: List[tuple]) -> ResponseList:
         """Apply the coordinator's cycle verdict to the local cache —
@@ -946,7 +962,8 @@ class Runtime:
         (ascending), replay the granted slots (ascending, fused with
         the threshold this very frame carries), repopulate from the
         freshly negotiated responses (stream order), and requeue hits
-        the world did not grant."""
+        the world did not grant. @world_coherent: every input here is
+        the broadcast verdict itself."""
         cache = self._cache
         if cache is None or meta.epoch != cache.epoch \
                 or meta.nslots != cache.nslots:
@@ -1107,6 +1124,7 @@ class Runtime:
             out.append((dt, acc))
         return out
 
+    @world_coherent
     def _complete_spec_cycle(self, meta: CacheCycleResponse,
                              bit_requests: List[tuple]) -> ResponseList:
         """Worker half of the fused speculative cycle: the grant is by
@@ -1186,6 +1204,7 @@ class Runtime:
                         prescale_factor=resp.prescale_factor,
                         postscale_factor=resp.postscale_factor)
 
+    @world_coherent
     def _populate_cache(self, resp_list: ResponseList) -> None:
         """Refresh the cache from freshly negotiated responses — in
         broadcast-stream order, the world-identical order every rank
@@ -1238,6 +1257,7 @@ class Runtime:
         self._m_spec_bids.set_total(self._spec_bids)
         self._m_spec_denials.set_total(self._spec_denials_total)
         self._m_queue_depth.set(len(self.tensor_table))
+        self._m_lock_inversions.set_total(lockdep.inversion_count())
         for r, age in self.controller.peer_heartbeat_ages().items():
             self.metrics.gauge(
                 f'hvd_peer_heartbeat_age_seconds{{peer="{r}"}}',
@@ -1435,7 +1455,7 @@ class Runtime:
             self._op_name = op_name
             self._batch_id = batch_id
             self._remaining = n_entries
-            self._lock = threading.Lock()
+            self._lock = lockdep.lock("runtime._SpanCloser._lock")
             self._closed = False
 
         def entry_done(self) -> None:

@@ -13,6 +13,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
+from horovod_tpu.common import lockdep
 from horovod_tpu.common.message import Request
 from horovod_tpu.common.status import Status
 
@@ -46,7 +47,7 @@ class TensorTable:
     (reference: operations.cc:1455 mutex usage)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("tensor_table.TensorTable._lock")
         self._table: Dict[str, TensorTableEntry] = {}
         self._message_queue: List[Request] = []
 
@@ -156,7 +157,7 @@ class HandleManager:
     (reference: horovod/torch/handle_manager.{h,cc})."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("tensor_table.HandleManager._lock")
         self._cv = threading.Condition(self._lock)
         self._last = 0
         self._waiters = 0

@@ -23,6 +23,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from horovod_tpu.common import lockdep
 from horovod_tpu.common.message import RequestType
 
 # Activity names (reference: common.h:30-51 macros).
@@ -77,7 +78,7 @@ class Timeline(_NoOpTimeline):
         self._drop_metric = None
         self._pids: Dict[str, int] = {}
         self._next_pid = 1
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("timeline.Timeline._lock")
         self._start_ts = time.monotonic()
         self._writer = threading.Thread(target=self._write_loop,
                                         name="hvd-timeline-writer",

@@ -83,33 +83,50 @@ class _Reader:
         self.data = data
         self.off = offset
 
+    def _need(self, n: int) -> None:
+        """Length guard ahead of every fixed-width read: a truncated
+        frame must surface as a transport error (ConnectionError) the
+        abort machinery understands, never as struct.error/IndexError
+        deep inside a parse — and a short mask/segment slice must
+        never silently decode a WRONG value (hvdlint: wire-protocol)."""
+        if self.off + n > len(self.data):
+            raise ConnectionError(
+                f"truncated control frame: need {n} bytes at offset "
+                f"{self.off}, have {len(self.data) - self.off}")
+
     def u8(self):
+        self._need(1)
         v = _U8.unpack_from(self.data, self.off)[0]
         self.off += 1
         return v
 
     def u32(self):
+        self._need(4)
         v = _U32.unpack_from(self.data, self.off)[0]
         self.off += 4
         return v
 
     def i32(self):
+        self._need(4)
         v = _I32.unpack_from(self.data, self.off)[0]
         self.off += 4
         return v
 
     def i64(self):
+        self._need(8)
         v = _I64.unpack_from(self.data, self.off)[0]
         self.off += 8
         return v
 
     def f64(self):
+        self._need(8)
         v = _F64.unpack_from(self.data, self.off)[0]
         self.off += 8
         return v
 
     def string(self) -> str:
         n = self.u32()
+        self._need(n)
         s = self.data[self.off:self.off + n].decode("utf-8")
         self.off += n
         return s
@@ -130,14 +147,21 @@ def _write_request(w: _Writer, req: Request) -> None:
 
 def _read_request(r: _Reader) -> Request:
     data, off = r.data, r.off
+    r._need(_REQ_HEAD.size)
     (req_type, request_rank, tensor_type, root_rank, device,
      namelen) = _REQ_HEAD.unpack_from(data, off)
     off += _REQ_HEAD.size
+    if off + namelen + _REQ_TAIL.size > len(data):
+        raise ConnectionError(
+            f"truncated request frame at offset {off}")
     name = data[off:off + namelen].decode("utf-8")
     off += namelen
     prescale, postscale, ndim = _REQ_TAIL.unpack_from(data, off)
     off += _REQ_TAIL.size
     if ndim:
+        if off + 8 * ndim > len(data):
+            raise ConnectionError(
+                f"truncated request frame at offset {off}")
         shape = struct.unpack_from(f"<{ndim}q", data, off)
         off += 8 * ndim
     else:
@@ -205,12 +229,14 @@ def _read_response(r: _Reader) -> Response:
     names = [r.string() for _ in range(r.u32())]
     ndev = r.u32()
     if ndev:
+        r._need(4 * ndev)
         devices = list(struct.unpack_from(f"<{ndev}i", r.data, r.off))
         r.off += 4 * ndev
     else:
         devices = []
     nsz = r.u32()
     if nsz:
+        r._need(8 * nsz)
         sizes = list(struct.unpack_from(f"<{nsz}q", r.data, r.off))
         r.off += 8 * nsz
     else:
@@ -304,6 +330,10 @@ def _write_mask(w: _Writer, mask: int, nslots: int) -> None:
 
 def _read_mask(r: _Reader, nslots: int) -> int:
     n = _mask_nbytes(nslots)
+    # guard BEFORE the slice: int.from_bytes over a short slice would
+    # silently decode a WRONG (truncated) mask — worse than a crash on
+    # a world whose grants are driven by these bits
+    r._need(n)
     mask = int.from_bytes(r.data[r.off:r.off + n], "little")
     r.off += n
     return mask
@@ -327,6 +357,10 @@ def _read_segments(r: _Reader):
     for _ in range(r.u32()):
         dt = DataType(r.u8())
         n = r.i64()
+        if n < 0:
+            raise ConnectionError(
+                f"corrupt segment length {n} in control frame")
+        r._need(n)
         segs.append((dt, view[r.off:r.off + n]))
         r.off += n
     return segs
@@ -527,14 +561,18 @@ def parse_metrics_frame(data: bytes):
             agg = _BYTE_AGG[r.u8()]
             snap[name] = {"k": "g", "agg": agg, "v": r.f64()}
         else:
+            r._need(_U16.size)
             (nb,) = _U16.unpack_from(r.data, r.off)
             r.off += _U16.size
+            r._need(8 * nb)
             bounds = list(struct.unpack_from(f"<{nb}d", r.data, r.off))
             r.off += 8 * nb
+            r._need(8 * (nb + 1))
             counts = list(struct.unpack_from(f"<{nb + 1}Q", r.data,
                                              r.off))
             r.off += 8 * (nb + 1)
             total = r.f64()
+            r._need(_U64.size)
             (count,) = _U64.unpack_from(r.data, r.off)
             r.off += _U64.size
             snap[name] = {"k": "h", "bounds": bounds, "counts": counts,

@@ -14,12 +14,13 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 from typing import Optional
 
+from horovod_tpu.common import config as hconfig
+from horovod_tpu.common import lockdep
 from horovod_tpu.common import logging as hlog
 
-_lock = threading.Lock()
+_lock = lockdep.lock("native._lock")
 _lib = None
 _tried = False
 
@@ -98,9 +99,13 @@ def get() -> Optional[ctypes.CDLL]:
             return _lib
         _tried = True
         # Two spellings for compatibility: HOROVOD_NATIVE (docs) and
-        # HOROVOD_TPU_NATIVE (Config.native_core, common/config.py:140).
-        if os.environ.get("HOROVOD_NATIVE", "1") == "0" or \
-                os.environ.get("HOROVOD_TPU_NATIVE", "1") in ("0", "false"):
+        # HOROVOD_TPU_NATIVE (Config.native_core, common/config.py).
+        # Exact legacy truthiness on purpose (only these values
+        # disable) — env_bool's narrower truthy set would silently
+        # drop the C++ core for e.g. HOROVOD_NATIVE=ON deployments.
+        if hconfig.env_str("HOROVOD_NATIVE", "1") == "0" or \
+                hconfig.env_str("HOROVOD_TPU_NATIVE", "1") \
+                in ("0", "false"):
             return None
         stale = (os.path.exists(_SO_PATH)
                  and os.path.exists(_SRC_PATH)

@@ -15,12 +15,12 @@ in the same order — same contract as the reference's
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Optional
 
 import numpy as np
 
 from horovod_tpu.common import basics
+from horovod_tpu.common import lockdep
 from horovod_tpu.common.message import (
     RequestType, numpy_dtype_to_datatype,
 )
@@ -34,7 +34,7 @@ from horovod_tpu.common.tensor_table import TensorTableEntry
 Average = 0
 Sum = 1
 
-_counter_lock = threading.Lock()
+_counter_lock = lockdep.lock("ops._counter_lock")
 _counters = {}
 
 

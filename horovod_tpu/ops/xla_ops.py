@@ -26,11 +26,11 @@ SPMD path (horovod_tpu/spmd) or the local backend instead.
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, Tuple
 
 import numpy as np
 
+from horovod_tpu.common import lockdep
 from horovod_tpu.common import logging as hlog
 from horovod_tpu.common.message import Response
 from horovod_tpu.common.status import Status
@@ -71,7 +71,7 @@ class XlaMeshBackend(CollectiveBackend):
     def __init__(self, controller, config=None):
         self._ctl = controller
         self._config = config
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("xla_ops.XlaMeshBackend._lock")
         self._mesh = None
         self._mesh2d = None   # (cross, local) factored mesh, see below
         self._my_device = None

@@ -20,6 +20,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from horovod_tpu.common import config as hconfig
 from horovod_tpu.run.services import DriverService, local_addresses
 
 
@@ -31,7 +32,7 @@ class HostCheckCache:
     failures are always re-probed."""
 
     def __init__(self, path: Optional[str] = None, ttl_s: float = 3600.0):
-        base = os.environ.get("HOROVOD_TPU_CACHE_DIR", "~/.horovod_tpu")
+        base = hconfig.env_str("HOROVOD_TPU_CACHE_DIR", "~/.horovod_tpu")
         self._path = path or os.path.join(
             os.path.expanduser(base), "hostcheck.json")
         self._ttl = ttl_s
@@ -169,10 +170,7 @@ def abort_grace_seconds() -> float:
     a clean Python-level WorldAbortedError in every surviving rank's
     training script; the kill stays as the backstop for survivors too
     wedged to run the protocol."""
-    try:
-        return float(os.environ.get("HOROVOD_TPU_ABORT_GRACE", "5"))
-    except ValueError:
-        return 5.0
+    return hconfig.env_float("HOROVOD_TPU_ABORT_GRACE", 5.0)
 
 
 def reap_with_grace(procs) -> int:
@@ -279,7 +277,7 @@ def run_multihost(hosts: List[Tuple[str, int]], command: List[str],
     check_hosts_reachable(
         hosts, ssh_port=ssh_port, check_fn=host_check_fn,
         cache=HostCheckCache() if use_cache else None)
-    secret = os.environ.get("HOROVOD_SECRET_KEY") or \
+    secret = hconfig.env_str("HOROVOD_SECRET_KEY") or \
         _secrets.token_hex(16)
     driver = DriverService(len(hosts), secret=secret.encode())
     driver_addr = local_addresses()[0]
@@ -364,8 +362,8 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     if args.verbose:
         os.environ.setdefault("HOROVOD_LOG_LEVEL", "debug")
-    start_timeout = args.start_timeout or float(
-        os.environ.get("HOROVOD_START_TIMEOUT", "30"))
+    start_timeout = args.start_timeout or \
+        hconfig.env_float("HOROVOD_START_TIMEOUT", 30.0)
 
     # Metrics-plane knobs, plumbed to every spawned rank (workers read
     # them through Config.from_env; the flags win over inherited env).

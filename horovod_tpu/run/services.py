@@ -24,6 +24,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
+from horovod_tpu.common import config as hconfig
+from horovod_tpu.common import lockdep
 from horovod_tpu.common import logging as hlog
 from horovod_tpu.common import network
 
@@ -95,7 +97,7 @@ class DriverService:
         self.port = self._server.getsockname()[1]
         self._tasks: Dict[int, _JsonChannel] = {}
         self._task_info: Dict[int, dict] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("services.DriverService._lock")
 
     def wait_for_registration(self, timeout: float = 60.0) -> None:
         """Accept one connection per host; each sends
@@ -206,7 +208,10 @@ class DriverService:
                 ch.send({"cmd": "shutdown"})
             except OSError:
                 pass
-            ch.close()
+            try:
+                ch.close()
+            except OSError:
+                pass  # stage-guarded: the listener below must still close
         self._server.close()
 
 
@@ -314,7 +319,7 @@ def task_main() -> None:
     host_index = int(sys.argv[1])
     driver_addr = sys.argv[2]
     driver_port = int(sys.argv[3])
-    secret = os.environ.get("HOROVOD_SECRET_KEY", "").encode()
+    secret = hconfig.env_str("HOROVOD_SECRET_KEY", "").encode()
     server = TaskServer(host_index, driver_addr, driver_port, secret)
     sys.exit(server.serve_forever())
 

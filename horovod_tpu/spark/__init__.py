@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Any, Callable, List, Optional
 
+from horovod_tpu.common import config as hconfig
 from horovod_tpu.common import logging as hlog
 from horovod_tpu.common import network
 
@@ -160,7 +161,7 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
 
     from horovod_tpu.run.services import local_addresses
 
-    secret_str = os.environ.get("HOROVOD_SECRET_KEY", "")
+    secret_str = hconfig.env_str("HOROVOD_SECRET_KEY", "")
     secret = secret_str.encode()
     rendezvous = _Rendezvous(num_proc, secret)
     driver_addr = local_addresses()[0]

@@ -14,7 +14,8 @@ from __future__ import annotations
 
 import os
 import re
-import threading
+
+from horovod_tpu.common import lockdep
 from typing import Any, Optional
 
 from horovod_tpu.common import basics
@@ -81,7 +82,7 @@ def _load_tree(path: str, target: Optional[Any]) -> Any:
 # failure only at atexit, after a restore already read around it).
 _writer = None
 _pending = []
-_pending_lock = threading.Lock()
+_pending_lock = lockdep.lock("checkpoint._pending_lock")
 
 
 def _writer_pool():

@@ -46,6 +46,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "fast: in-process unit tier (alias: -m fast == "
         "-m 'not mp')")
+    config.addinivalue_line(
+        "markers", "lint: pure-static hvdlint analyzer checks + "
+        "lockdep units (no world spawn; subset of the fast tier — "
+        "run alone with -m lint)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -2715,6 +2715,49 @@ def scenario_xla_hierarchical_allgather(hvd_mod, rank, size):
     assert "allgather" not in kinds, kinds
 
 
+def scenario_lockcheck_inversion(hvd, rank, size):
+    """HOROVOD_TPU_LOCKCHECK armed world (the mp default): the
+    runtime's instrumented locks must survive a real collective with
+    zero false inversions, and a deliberately inverted synthetic pair
+    must raise LockInversionError naming both orders — every rank."""
+    from horovod_tpu.common import lockdep
+
+    assert lockdep.enabled(), "mp worlds must arm HOROVOD_TPU_LOCKCHECK"
+    before = lockdep.inversion_count()
+
+    # real work first: the armed instrumentation must be invisible
+    x = np.full(64, float(rank + 1), np.float64)
+    out = hvd.allreduce(x, average=False, name="lc.warm")
+    np.testing.assert_allclose(out, sum(range(1, size + 1)))
+    assert lockdep.inversion_count() == before, \
+        "healthy collective produced a lock inversion"
+
+    # the runtime's core locks really are checked locks in this world
+    from horovod_tpu.common import basics as _b
+    tt_lock = _b.runtime().tensor_table._lock
+    assert type(tt_lock).__name__ == "_CheckedLock", type(tt_lock)
+
+    a = lockdep.lock("mp.sync.A")
+    b = lockdep.lock("mp.sync.B")
+    with a:
+        with b:
+            pass
+    raised = False
+    try:
+        with b:
+            with a:
+                pass
+    except lockdep.LockInversionError as e:
+        raised = True
+        assert "mp.sync.A" in str(e) and "mp.sync.B" in str(e), e
+    assert raised, "inverted acquisition did not raise"
+    assert lockdep.inversion_count() == before + 1
+
+    # the world is still healthy after the caught inversion
+    out = hvd.allreduce(x, average=False, name="lc.after")
+    np.testing.assert_allclose(out, sum(range(1, size + 1)))
+
+
 def main():
     scenario, rank, size, port = (sys.argv[1], int(sys.argv[2]),
                                   int(sys.argv[3]), int(sys.argv[4]))

@@ -30,6 +30,11 @@ def _base_env(extra_env=None):
     # Keep the TPU plugin's sitecustomize from overriding jax_platforms
     # back to the tunneled TPU inside worker processes.
     base.pop("PALLAS_AXON_POOL_IPS", None)
+    # Arm the runtime lockdep (common/lockdep.py) in every spawned
+    # world: all mp scenarios double as lock-inversion regression tests
+    # — an acquisition-order inversion anywhere in the runtime raises
+    # LockInversionError instead of someday deadlocking a real job.
+    base.setdefault("HOROVOD_TPU_LOCKCHECK", "1")
     if extra_env:
         base.update(extra_env)
     return base
@@ -559,6 +564,14 @@ def test_edge_shapes(plane):
     host data planes."""
     extra = {} if plane == "shm" else {"HOROVOD_TPU_SHM": "0"}
     run_scenario("edge_shapes", 3, extra_env=extra)
+
+
+def test_lockcheck_catches_synthetic_inversion():
+    """Every mp world runs with HOROVOD_TPU_LOCKCHECK=1 (see
+    _base_env); this scenario additionally PROVOKES an inversion and
+    asserts the armed lockdep raises it on every rank while real
+    collectives stay inversion-free before and after."""
+    run_scenario("lockcheck_inversion", 2)
 
 
 def test_rank_death_fails_survivors_cleanly():

@@ -1,0 +1,27 @@
+"""hvdlint — project-invariant static analysis for horovod_tpu.
+
+Five analyzers, each encoding an invariant this codebase has already
+paid a review-found bug for (see docs/static_analysis.md):
+
+=================  ========================================================
+lock-order         inter-procedural lock-acquisition graph: order cycles,
+                   self-deadlock, blocking calls under a held lock
+wire-protocol      codec coherence: serialize/parse pairing, discriminator
+                   byte collisions (the PACKED bug class), length guards
+                   on every unpack
+world-coherence    world-replicated state (response cache, steady
+                   predictor) mutates only behind @world_coherent sites
+teardown           multi-step cleanup in finally blocks / close functions
+                   is stage-guarded
+knobs              HOROVOD_* env reads route through common/config.py and
+                   every knob is documented
+=================  ========================================================
+
+Run ``python -m tools.hvdlint horovod_tpu`` (add ``--json`` for machine
+output). The runtime counterpart — the lockdep mode armed by
+``HOROVOD_TPU_LOCKCHECK=1`` — lives in ``horovod_tpu/common/lockdep.py``.
+"""
+
+from tools.hvdlint.core import Finding, get_analyzers, lint_paths
+
+__all__ = ["Finding", "get_analyzers", "lint_paths"]

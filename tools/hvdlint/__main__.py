@@ -1,0 +1,52 @@
+"""CLI: ``python -m tools.hvdlint [paths...] [--analyzer a,b] [--json]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.hvdlint.core import get_analyzers, lint_paths
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.hvdlint",
+        description="horovod_tpu project-invariant static analysis")
+    parser.add_argument("paths", nargs="*", default=["horovod_tpu"],
+                        help="packages/files to analyze "
+                             "(default: horovod_tpu)")
+    parser.add_argument("--analyzer", "-a", default="",
+                        help="comma-separated subset of analyzers")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable findings on stdout")
+    parser.add_argument("--list", action="store_true",
+                        help="list available analyzers and exit")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for name in sorted(get_analyzers()):
+            print(name)
+        return 0
+    analyzers = [a.strip() for a in args.analyzer.split(",") if a.strip()] \
+        or None
+    try:
+        findings = lint_paths(args.paths or ["horovod_tpu"], analyzers)
+    except (ValueError, OSError, SyntaxError) as e:
+        print(f"hvdlint: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps({"findings": [f.to_dict() for f in findings],
+                          "count": len(findings)}, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"hvdlint: {len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

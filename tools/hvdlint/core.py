@@ -1,0 +1,640 @@
+"""hvdlint core: project model shared by every analyzer.
+
+hvdlint encodes THIS codebase's own invariants — the bug classes the
+last three PRs each had to fix by hand in review (the PACKED envelope
+collision, the truncated-frame IndexError, the skipped teardown stage)
+— as machine checks that run in tier-1. It is stdlib-only (ast +
+tokenize) on purpose: the lint tier must run anywhere the tests run.
+
+The model here is deliberately *unsound but precise*: calls that
+cannot be resolved with high confidence (arbitrary callbacks, duck-
+typed receivers) are ignored rather than guessed at, because a static
+gate that cries wolf gets deleted. Each analyzer documents the
+residual blind spots it accepts.
+
+Suppressions: a finding may be silenced with a pragma on the flagged
+line or the line directly above it::
+
+    something_flagged()  # hvdlint: disable=lock-order -- why it is safe
+
+The justification after ``--`` is mandatory; a bare pragma is itself
+reported (analyzer id ``pragma``).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# findings + suppression pragmas
+
+_PRAGMA_RE = re.compile(
+    r"#\s*hvdlint:\s*(?:disable=)?([\w,-]+)"
+    r"(?:\s*--\s*(\S.*))?")
+_MARKER_RE = re.compile(r"#\s*hvdlint:\s*world-replicated\b")
+
+
+@dataclass(frozen=True)
+class Finding:
+    analyzer: str
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.analyzer}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return {"analyzer": self.analyzer, "path": self.path,
+                "line": self.line, "message": self.message}
+
+
+class SourceFile:
+    """One parsed module: AST + pragma/marker line indexes."""
+
+    def __init__(self, path: str, modname: str, text: str):
+        self.path = path
+        self.modname = modname          # dotted, e.g. horovod_tpu.common.wire
+        self.shortname = modname.rsplit(".", 1)[-1]
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        # line -> set of analyzer ids silenced on that line and the next
+        self.suppressions: Dict[int, set] = {}
+        self.bad_pragmas: List[int] = []    # pragma without justification
+        self.replicated_lines: set = set()  # '# hvdlint: world-replicated'
+        self._scan_comments()
+
+    def _scan_comments(self) -> None:
+        try:
+            toks = tokenize.generate_tokens(io.StringIO(self.text).readline)
+            for tok in toks:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                line = tok.start[0]
+                if _MARKER_RE.search(tok.string):
+                    self.replicated_lines.add(line)
+                    continue
+                m = _PRAGMA_RE.search(tok.string)
+                if not m or "disable" not in tok.string:
+                    continue
+                names = {n.strip() for n in m.group(1).split(",") if n.strip()}
+                if not m.group(2):
+                    self.bad_pragmas.append(line)
+                self.suppressions.setdefault(line, set()).update(names)
+        except tokenize.TokenError:
+            pass
+
+    def suppressed(self, analyzer: str, line: int) -> bool:
+        for pragma_line in (line, line - 1):
+            names = self.suppressions.get(pragma_line)
+            if names and (analyzer in names or "all" in names):
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# attribute / local type tags
+#
+# Tags: ("lock", id, reentrant) | ("cond", id) | ("thread",) | ("event",)
+#       ("queue",) | ("socket",) | ("class", qualname)
+
+_LOCK_FACTORIES = {"threading.Lock": False, "threading.RLock": True,
+                   "lockdep.lock": False, "lockdep.rlock": True}
+_COND_FACTORIES = ("threading.Condition", "lockdep.condition")
+_SIMPLE_FACTORIES = {
+    "threading.Thread": ("thread",),
+    "threading.Event": ("event",),
+    "queue.Queue": ("queue",), "queue.LifoQueue": ("queue",),
+    "queue.PriorityQueue": ("queue",), "queue.SimpleQueue": ("queue",),
+    "socket.socket": ("socket",), "network.listen": ("socket",),
+}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ClassIndex:
+    def __init__(self, module: "ModuleIndex", node: ast.ClassDef):
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.qualname = f"{module.modname}.{node.name}"
+        self.bases = [dotted_name(b) for b in node.bases]
+        self.methods: Dict[str, ast.FunctionDef] = {}
+        self.attr_types: Dict[str, tuple] = {}
+        # attr -> line of the assignment that declared it world-replicated
+        self.replicated_attrs: Dict[str, int] = {}
+
+
+class ModuleIndex:
+    def __init__(self, src: SourceFile):
+        self.src = src
+        self.modname = src.modname
+        self.classes: Dict[str, ClassIndex] = {}
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        # import alias -> dotted module name ("hlog" -> "...common.logging")
+        self.imports: Dict[str, str] = {}
+        # from-import: local name -> (module, symbol)
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+        self.attr_types: Dict[str, tuple] = {}  # module-level vars
+        self.constants: Dict[str, ast.AST] = {}  # module-level assignments
+
+
+class FuncInfo:
+    """Per-function facts gathered by the indexer."""
+
+    def __init__(self, qualname: str, module: ModuleIndex,
+                 cls: Optional[ClassIndex], node: ast.FunctionDef):
+        self.qualname = qualname
+        self.module = module
+        self.cls = cls
+        self.node = node
+        self.decorators = {dotted_name(d) or "" for d in node.decorator_list}
+        self.local_types: Dict[str, tuple] = {}
+
+
+class ProjectIndex:
+    def __init__(self):
+        self.modules: Dict[str, ModuleIndex] = {}
+        self.functions: Dict[str, FuncInfo] = {}   # qualname -> info
+        # short module name -> ModuleIndex (for import resolution against
+        # scanned files regardless of package prefix)
+        self.by_short: Dict[str, ModuleIndex] = {}
+
+    def class_by_name(self, name: str) -> Optional[ClassIndex]:
+        for mod in self.modules.values():
+            ci = mod.classes.get(name)
+            if ci is not None:
+                return ci
+        return None
+
+
+class Project:
+    """The file set under analysis plus its cross-module index."""
+
+    def __init__(self, roots: List[str]):
+        self.roots = [os.path.abspath(r) for r in roots]
+        self.files: List[SourceFile] = []
+        for root in self.roots:
+            if os.path.isfile(root):
+                self._add(root, os.path.splitext(os.path.basename(root))[0])
+                continue
+            base = os.path.basename(root.rstrip(os.sep))
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__")
+                for fn in sorted(filenames):
+                    if not fn.endswith(".py"):
+                        continue
+                    path = os.path.join(dirpath, fn)
+                    rel = os.path.relpath(path, root)
+                    mod = rel[:-3].replace(os.sep, ".")
+                    if mod.endswith(".__init__"):
+                        mod = mod[:-len(".__init__")]
+                    modname = base if mod == "__init__" else f"{base}.{mod}"
+                    self._add(path, modname)
+        self.index = _build_index(self)
+        self.resolver = Resolver(self.index)
+
+    def _add(self, path: str, modname: str) -> None:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        self.files.append(SourceFile(path, modname, text))
+
+    def doc_root(self) -> Optional[str]:
+        """Directory holding docs/ + README.md: the parent of the first
+        scanned root (repo layout), if it actually has either."""
+        parent = os.path.dirname(self.roots[0].rstrip(os.sep))
+        if os.path.isdir(os.path.join(parent, "docs")) or \
+                os.path.isfile(os.path.join(parent, "README.md")):
+            return parent
+        return None
+
+
+# ---------------------------------------------------------------------------
+# indexing
+
+def _expand(dotted: Optional[str], mod: ModuleIndex) -> Optional[str]:
+    """Resolve the leading component of a dotted name through the
+    module's imports ("hlog.warning" -> "...common.logging.warning")."""
+    if not dotted:
+        return None
+    head, _, rest = dotted.partition(".")
+    if head in mod.from_imports:
+        fmod, sym = mod.from_imports[head]
+        head = f"{fmod}.{sym}"
+    elif head in mod.imports:
+        head = mod.imports[head]
+    return f"{head}.{rest}" if rest else head
+
+
+def _type_of_value(expr: ast.AST, mod: ModuleIndex, index: ProjectIndex,
+                   owner: Optional[str] = None,
+                   attrs: Optional[Dict[str, tuple]] = None
+                   ) -> Optional[tuple]:
+    """Type tag for the right-hand side of an assignment."""
+    if not isinstance(expr, ast.Call):
+        return None
+    raw = dotted_name(expr.func)
+    if raw is None:
+        return None
+    tail = raw.rsplit(".", 1)[-1]
+    full = _expand(raw, mod) or raw
+    # normalize "horovod_tpu.common.lockdep.lock" -> "lockdep.lock" etc.
+    short2 = ".".join(full.split(".")[-2:])
+    for key in (raw, short2):
+        if key in _LOCK_FACTORIES:
+            name = None
+            if key.startswith("lockdep.") and expr.args and \
+                    isinstance(expr.args[0], ast.Constant) and \
+                    isinstance(expr.args[0].value, str):
+                name = expr.args[0].value
+            return ("lock", name, _LOCK_FACTORIES[key])
+        if key in _COND_FACTORIES:
+            # Condition(existing_lock) shares that lock; no-arg owns one.
+            for arg in expr.args:
+                d = dotted_name(arg)
+                if d and d.startswith("self.") and attrs is not None:
+                    return ("cond_alias", d.split(".", 1)[1])
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str):
+                    return ("cond", arg.value)
+            return ("cond", None)
+        if key in _SIMPLE_FACTORIES:
+            return _SIMPLE_FACTORIES[key]
+    # project class constructor?
+    cls = _resolve_class_name(raw, mod, index)
+    if cls is not None:
+        return ("class", cls.qualname)
+    if tail in ("Thread",):
+        return ("thread",)
+    return None
+
+
+def _resolve_class_name(raw: str, mod: ModuleIndex,
+                        index: ProjectIndex) -> Optional[ClassIndex]:
+    head, _, rest = raw.partition(".")
+    if not rest:
+        if head in mod.classes:
+            return mod.classes[head]
+        if head in mod.from_imports:
+            fmod, sym = mod.from_imports[head]
+            target = index.modules.get(fmod) or index.by_short.get(
+                fmod.rsplit(".", 1)[-1])
+            if target is not None:
+                return target.classes.get(sym)
+        return None
+    if "." in rest:
+        return None
+    target = None
+    if head in mod.imports:
+        full = mod.imports[head]
+        target = index.modules.get(full) or index.by_short.get(
+            full.rsplit(".", 1)[-1])
+    if target is not None:
+        return target.classes.get(rest)
+    return None
+
+
+def _type_from_annotation(ann: ast.AST, mod: ModuleIndex,
+                          index: ProjectIndex) -> Optional[tuple]:
+    """('class', qualname) from an annotation like Optional[ResponseCache]
+    or a string annotation."""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value, mode="eval").body
+        except SyntaxError:
+            return None
+    for node in ast.walk(ann):
+        if isinstance(node, ast.Name):
+            cls = _resolve_class_name(node.id, mod, index)
+            if cls is not None:
+                return ("class", cls.qualname)
+    return None
+
+
+def _collect_attr_types(ci: ClassIndex, index: ProjectIndex) -> None:
+    mod = ci.module
+    src = mod.src
+    for meth in ci.methods.values():
+        for node in ast.walk(meth):
+            target = None
+            value = None
+            ann = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target, value = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign):
+                target, value, ann = node.target, node.value, node.annotation
+            if not (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                continue
+            attr = target.attr
+            if node.lineno in src.replicated_lines or \
+                    (node.end_lineno or node.lineno) in src.replicated_lines:
+                ci.replicated_attrs.setdefault(attr, node.lineno)
+            tag = _type_of_value(value, mod, index, attrs=ci.attr_types) \
+                if value is not None else None
+            if tag is None and ann is not None:
+                tag = _type_from_annotation(ann, mod, index)
+            if tag is not None and attr not in ci.attr_types:
+                ci.attr_types[attr] = tag
+    # second pass: name anonymous locks/conditions + resolve aliases
+    short = mod.src.shortname
+    for attr, tag in list(ci.attr_types.items()):
+        if tag[0] == "lock" and tag[1] is None:
+            ci.attr_types[attr] = ("lock", f"{short}.{ci.name}.{attr}",
+                                   tag[2])
+        elif tag[0] == "cond" and tag[1] is None:
+            ci.attr_types[attr] = ("cond", f"{short}.{ci.name}.{attr}")
+    for attr, tag in list(ci.attr_types.items()):
+        if tag[0] == "cond_alias":
+            base = ci.attr_types.get(tag[1])
+            if base is not None and base[0] == "lock":
+                ci.attr_types[attr] = ("cond", base[1])
+            else:
+                ci.attr_types[attr] = ("cond", f"{short}.{ci.name}.{attr}")
+
+
+def _build_index(project: Project) -> ProjectIndex:
+    index = ProjectIndex()
+    for src in project.files:
+        mod = ModuleIndex(src)
+        index.modules[src.modname] = mod
+        index.by_short[src.shortname] = mod
+        for node in src.tree.body:
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    mod.imports[alias.asname or
+                                alias.name.split(".")[0]] = alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    # a from-import may name a module or a symbol; record
+                    # both interpretations and let resolution decide
+                    mod.from_imports[local] = (node.module, alias.name)
+                    mod.imports.setdefault(
+                        local, f"{node.module}.{alias.name}")
+            elif isinstance(node, ast.ClassDef):
+                ci = ClassIndex(mod, node)
+                mod.classes[node.name] = ci
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        ci.methods[item.name] = item
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mod.functions[node.name] = node
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                mod.constants[name] = node.value
+                tag = _type_of_value(node.value, mod, index)
+                if tag is not None:
+                    if tag[0] == "lock" and tag[1] is None:
+                        tag = ("lock", f"{src.shortname}.{name}", tag[2])
+                    elif tag[0] == "cond" and tag[1] is None:
+                        tag = ("cond", f"{src.shortname}.{name}")
+                    mod.attr_types[name] = tag
+    # second pass: class attribute types (needs the class table complete)
+    for mod in index.modules.values():
+        for ci in mod.classes.values():
+            _collect_attr_types(ci, index)
+    # function registry
+    for mod in index.modules.values():
+        for name, node in mod.functions.items():
+            qn = f"{mod.modname}.{name}"
+            index.functions[qn] = FuncInfo(qn, mod, None, node)
+        for ci in mod.classes.values():
+            for name, node in ci.methods.items():
+                qn = f"{ci.qualname}.{name}"
+                index.functions[qn] = FuncInfo(qn, mod, ci, node)
+    for info in index.functions.values():
+        info.local_types = _infer_local_types(info, index)
+    return index
+
+
+def _infer_local_types(info: FuncInfo, index: ProjectIndex
+                       ) -> Dict[str, tuple]:
+    """var -> type tag for locals assigned from typed self attributes or
+    project-class constructors (one flow-insensitive pass)."""
+    out: Dict[str, tuple] = {}
+    mod = info.module
+    for node in iter_executed(info.node):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        name = node.targets[0].id
+        tag = _type_of_value(node.value, mod, index)
+        if tag is None:
+            d = dotted_name(node.value)
+            if d and d.startswith("self.") and info.cls is not None:
+                attr = d.split(".", 1)[1]
+                if "." not in attr:
+                    tag = info.cls.attr_types.get(attr)
+        if tag is not None and name not in out:
+            out[name] = tag
+    return out
+
+
+def iter_executed(func: ast.AST):
+    """Walk a function body WITHOUT descending into nested function /
+    class definitions or lambdas: their bodies run later, not here —
+    statements inside them are not executed under this function's
+    locks, and treating them as such manufactures false positives."""
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# call resolution
+
+class Resolver:
+    def __init__(self, index: ProjectIndex):
+        self.index = index
+
+    def _module_of(self, dotted_mod: str) -> Optional[ModuleIndex]:
+        return (self.index.modules.get(dotted_mod)
+                or self.index.by_short.get(dotted_mod.rsplit(".", 1)[-1]))
+
+    def _method(self, cls: ClassIndex, name: str) -> Optional[str]:
+        seen = set()
+        queue = [cls]
+        while queue:
+            c = queue.pop(0)
+            if c.qualname in seen:
+                continue
+            seen.add(c.qualname)
+            if name in c.methods:
+                return f"{c.qualname}.{name}"
+            for b in c.bases:
+                if not b:
+                    continue
+                bc = _resolve_class_name(b, c.module, self.index)
+                if bc is not None:
+                    queue.append(bc)
+        return None
+
+    def resolve_call(self, call: ast.Call, info: FuncInfo) -> Optional[str]:
+        """Qualname of the called project function, or None. A resolved
+        class returns its __init__ when defined."""
+        func = call.func
+        mod = info.module
+        if isinstance(func, ast.Name):
+            name = func.id
+            if name in mod.functions:
+                return f"{mod.modname}.{name}"
+            cls = _resolve_class_name(name, mod, self.index)
+            if cls is not None:
+                return self._method(cls, "__init__")
+            if name in mod.from_imports:
+                fmod, sym = mod.from_imports[name]
+                target = self._module_of(fmod)
+                if target is not None and sym in target.functions:
+                    return f"{target.modname}.{sym}"
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        recv, meth = func.value, func.attr
+        # self.method() / self.attr.method()
+        d = dotted_name(recv)
+        if d == "self" and info.cls is not None:
+            return self._method(info.cls, meth)
+        if d and d.startswith("self.") and info.cls is not None:
+            attr = d.split(".", 1)[1]
+            if "." not in attr:
+                tag = info.cls.attr_types.get(attr)
+                if tag and tag[0] == "class":
+                    cls = self._class_by_qualname(tag[1])
+                    if cls is not None:
+                        return self._method(cls, meth)
+            return None
+        if isinstance(recv, ast.Name):
+            tag = info.local_types.get(recv.id)
+            if tag and tag[0] == "class":
+                cls = self._class_by_qualname(tag[1])
+                if cls is not None:
+                    return self._method(cls, meth)
+            # imported module function: hlog.warning(...)
+            if recv.id in mod.imports:
+                target = self._module_of(mod.imports[recv.id])
+                if target is not None:
+                    if meth in target.functions:
+                        return f"{target.modname}.{meth}"
+                    cls = target.classes.get(meth)
+                    if cls is not None:
+                        return self._method(cls, "__init__")
+        return None
+
+    def _class_by_qualname(self, qualname: str) -> Optional[ClassIndex]:
+        modname, _, cname = qualname.rpartition(".")
+        mod = self._module_of(modname)
+        if mod is not None:
+            return mod.classes.get(cname)
+        return None
+
+    def lock_of_expr(self, expr: ast.AST, info: FuncInfo
+                     ) -> Optional[tuple]:
+        """('lock'|'cond', id, reentrant) when the expression denotes a
+        known lock/condition object."""
+        d = dotted_name(expr)
+        if d is None:
+            return None
+        tag = None
+        if d.startswith("self.") and info.cls is not None:
+            attr = d.split(".", 1)[1]
+            if "." not in attr:
+                tag = info.cls.attr_types.get(attr)
+        elif "." not in d:
+            tag = info.local_types.get(d) or \
+                info.module.attr_types.get(d)
+        if tag is None:
+            return None
+        if tag[0] == "lock":
+            return ("lock", tag[1], tag[2])
+        if tag[0] == "cond":
+            return ("cond", tag[1], False)
+        return None
+
+    def type_of_expr(self, expr: ast.AST, info: FuncInfo
+                     ) -> Optional[tuple]:
+        """Full type tag (thread/event/queue/socket/class/lock/cond)."""
+        d = dotted_name(expr)
+        if d is None:
+            return None
+        if d.startswith("self.") and info.cls is not None:
+            attr = d.split(".", 1)[1]
+            if "." not in attr:
+                return info.cls.attr_types.get(attr)
+            return None
+        if "." not in d:
+            return info.local_types.get(d) or info.module.attr_types.get(d)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# runner
+
+def get_analyzers() -> Dict[str, object]:
+    from tools.hvdlint import (knobs, lock_order, teardown, wire_protocol,
+                               world_coherence)
+    mods = (lock_order, wire_protocol, world_coherence, teardown, knobs)
+    return {m.NAME: m for m in mods}
+
+
+def lint_paths(paths: List[str],
+               analyzers: Optional[List[str]] = None) -> List[Finding]:
+    project = Project(paths)
+    registry = get_analyzers()
+    names = analyzers or list(registry)
+    unknown = [n for n in names if n not in registry]
+    if unknown:
+        raise ValueError(f"unknown analyzer(s) {unknown}; "
+                         f"available: {sorted(registry)}")
+    findings: List[Finding] = []
+    for name in names:
+        findings.extend(registry[name].run(project))
+    by_path = {src.path: src for src in project.files}
+    kept = []
+    for f in findings:
+        src = by_path.get(f.path)
+        if src is not None and src.suppressed(f.analyzer, f.line):
+            continue
+        kept.append(f)
+    for src in project.files:
+        for line in src.bad_pragmas:
+            kept.append(Finding(
+                "pragma", src.path, line,
+                "hvdlint suppression without a justification — append "
+                "'-- <why this is safe>'"))
+    kept.sort(key=lambda f: (f.path, f.line, f.analyzer))
+    # de-dup identical findings from overlapping passes
+    seen = set()
+    out = []
+    for f in kept:
+        k = (f.path, f.line, f.analyzer, f.message)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out

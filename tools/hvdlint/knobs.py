@@ -1,0 +1,147 @@
+"""knobs: HOROVOD_* environment reads go through common/config.py and
+every knob is documented.
+
+The config surface is a contract — scripts tuned for upstream Horovod
+carry over because the names and semantics live in ONE place
+(``Config.from_env`` + the ``env_*`` helpers). A stray
+``os.environ.get("HOROVOD_...")`` elsewhere silently forks that
+contract: different default, different truthiness rules, invisible to
+the docs and to ``Config`` snapshots. Two checks:
+
+1. **Routing.** Any *read* of a ``HOROVOD``-prefixed environment
+   variable (``os.environ.get``/``[...]``/``os.getenv``/``in
+   os.environ`` with a literal key) outside ``common/config.py`` is a
+   finding. Writes (``os.environ[k] = v``, ``setdefault``, ``pop``)
+   are launcher business and stay legal — the launcher *sets* child
+   env; it must not *read* knobs around Config.
+
+2. **Documentation.** Every ``HOROVOD*`` knob name passed to an env
+   accessor anywhere in the tree (``env_str``/``env_int``/
+   ``env_float``/``env_bool``/``os.environ.get``) must appear in
+   ``docs/**/*.md`` or ``README.md``. An undocumented knob is a
+   support ticket with extra steps.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+from typing import Dict, List, Set, Tuple
+
+from tools.hvdlint.core import Finding, Project, dotted_name
+
+NAME = "knobs"
+
+_ENV_HELPERS = {"env_str", "env_int", "env_float", "env_bool",
+                "_env_int", "_env_float", "_env_bool"}
+
+
+def _literal_key(node: ast.AST) -> str:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return ""
+
+
+def _is_config_module(modname: str) -> bool:
+    return modname.endswith(".config") or modname == "config"
+
+
+def _env_reads(tree: ast.AST) -> List[Tuple[str, int]]:
+    """(knob, line) for literal-keyed environment READS."""
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func)
+            if d in ("os.environ.get", "os.getenv") and node.args:
+                key = _literal_key(node.args[0])
+                if key.startswith("HOROVOD"):
+                    out.append((key, node.lineno))
+        elif isinstance(node, ast.Subscript) and \
+                isinstance(node.ctx, ast.Load):
+            if dotted_name(node.value) == "os.environ":
+                key = _literal_key(node.slice)
+                if key.startswith("HOROVOD"):
+                    out.append((key, node.lineno))
+        elif isinstance(node, ast.Compare) and \
+                len(node.ops) == 1 and \
+                isinstance(node.ops[0], (ast.In, ast.NotIn)):
+            if dotted_name(node.comparators[0]) == "os.environ":
+                key = _literal_key(node.left)
+                if key.startswith("HOROVOD"):
+                    out.append((key, node.lineno))
+    return out
+
+
+def _knob_names(tree: ast.AST) -> Dict[str, int]:
+    """Every HOROVOD* literal passed to an env accessor (the documented
+    contract surface), knob -> first line."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        d = dotted_name(node.func) or ""
+        tail = d.rsplit(".", 1)[-1]
+        if d in ("os.environ.get", "os.getenv") or tail in _ENV_HELPERS:
+            key = _literal_key(node.args[0])
+            if key.startswith("HOROVOD"):
+                out.setdefault(key, node.lineno)
+    return out
+
+
+def _documented_knobs(doc_root: str) -> Set[str]:
+    docs: Set[str] = set()
+    paths = [os.path.join(doc_root, "README.md")]
+    paths += glob.glob(os.path.join(doc_root, "docs", "**", "*.md"),
+                       recursive=True)
+    for p in paths:
+        try:
+            with open(p, encoding="utf-8") as f:
+                docs.add(f.read())
+        except OSError:
+            pass
+    blob = "\n".join(docs)
+    return {w for w in _words(blob) if w.startswith("HOROVOD")}
+
+
+def _words(text: str) -> Set[str]:
+    out: Set[str] = set()
+    word = []
+    for ch in text:
+        if ch.isalnum() or ch == "_":
+            word.append(ch)
+        elif word:
+            out.add("".join(word))
+            word = []
+    if word:
+        out.add("".join(word))
+    return out
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    all_knobs: Dict[str, Tuple[str, int]] = {}
+    for src in project.files:
+        for knob, line in _knob_names(src.tree).items():
+            all_knobs.setdefault(knob, (src.path, line))
+        if _is_config_module(src.modname):
+            continue
+        for knob, line in _env_reads(src.tree):
+            findings.append(Finding(
+                NAME, src.path, line,
+                f"direct environment read of {knob} outside "
+                f"common/config.py — route it through Config.from_env "
+                f"or the config.env_* helpers so defaults, truthiness "
+                f"and docs stay in one place"))
+
+    doc_root = project.doc_root()
+    if doc_root is not None:
+        documented = _documented_knobs(doc_root)
+        for knob, (path, line) in sorted(all_knobs.items()):
+            if knob not in documented:
+                findings.append(Finding(
+                    NAME, path, line,
+                    f"knob {knob} is read from the environment but "
+                    f"appears nowhere in README.md or docs/ — document "
+                    f"it or drop it"))
+    return findings

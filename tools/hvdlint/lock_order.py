@@ -1,0 +1,367 @@
+"""lock-order: inter-procedural lock-acquisition graph + hold-blocking.
+
+Two invariants over every ``threading.Lock/RLock/Condition`` (and
+``lockdep.*``) site in the tree:
+
+1. **No acquisition-order cycles.** Every ``with <lock>:`` nested
+   inside another — directly or through a resolvable call chain — adds
+   a directed edge between the two locks' identities. A cycle in that
+   graph is a latent deadlock: two threads entering it from different
+   corners wedge forever. Acquiring the same non-reentrant lock again
+   on the same path is the degenerate one-node cycle and is flagged
+   too (self-deadlock).
+
+2. **No blocking calls while holding a lock** that is not on the
+   allowlist. Blocking primitives: socket recv/send/accept/connect,
+   ``Condition.wait``/``wait_for`` (except on the held condition
+   itself, which releases it), ``Event.wait``, ``Thread.join``,
+   ``queue.Queue`` get/put (the ``_nowait`` variants are fine),
+   ``time.sleep`` and ``subprocess``. A blocking call under a lock
+   stalls every thread that touches that lock — the background loop's
+   cardinal sin.
+
+Lock identity is the *allocation site* (``module.Class.attr``), not
+the instance: two instances of the same class share an identity, the
+same grouping runtime lockdep (common/lockdep.py) uses, so a static
+finding and a runtime inversion report name the same thing.
+Same-identity nesting across *distinct instances* cannot be told from
+true self-deadlock statically, so same-identity edges are only flagged
+when acquired via ``self``/module globals (provably the same object).
+
+Known blind spots (accepted): calls through unresolvable receivers
+(callbacks, duck-typed parameters) are ignored; explicit
+``.acquire()``/``.release()`` pairs are not tracked (the codebase uses
+``with`` exclusively).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from tools.hvdlint.core import (
+    Finding, FuncInfo, Project, dotted_name, iter_executed,
+)
+
+NAME = "lock-order"
+
+# Locks that may legitimately be held across blocking calls, with the
+# justification a reviewer needs. Keyed by lock identity.
+HOLD_BLOCKING_ALLOWLIST = {
+    # init()/shutdown() serialize the whole world lifecycle; blocking on
+    # the TCP rendezvous / loop join while holding it is the point — no
+    # other lock nests inside it and user threads must wait.
+    "basics._lock": "init/shutdown serialization; rendezvous blocks by "
+                    "design",
+    # One-time native-library build: compiles with subprocess under the
+    # lock so concurrent local ranks build exactly once.
+    "native._lock": "one-shot build serialization across local ranks",
+}
+
+_SOCKET_METHODS = {"recv", "recv_into", "recvfrom", "accept", "sendall"}
+_SOCKETISH_NAMES = {"sock", "_sock", "conn", "_conn", "server", "_server",
+                    "ch", "channel", "_ch", "client"}
+_QUEUEISH = {"queue", "_queue", "q"}
+
+
+class _Blocking:
+    """One blocking operation inside a function."""
+
+    __slots__ = ("reason", "line", "exempt_lock")
+
+    def __init__(self, reason: str, line: int,
+                 exempt_lock: Optional[str] = None):
+        self.reason = reason
+        self.line = line
+        # a cv.wait() releases (only) its own lock — holding exactly
+        # that lock across it is the cv's designed use
+        self.exempt_lock = exempt_lock
+
+
+class _FuncFacts:
+    def __init__(self):
+        self.acquires: List[Tuple[str, bool, int, bool]] = []
+        #   (lock_id, reentrant, line, via_self_or_global)
+        self.blocking: List[_Blocking] = []
+        self.calls: List[Tuple[str, int]] = []        # anywhere
+        # per innermost-held-lock records: (held_stack, node)
+        self.under_lock_calls: List[Tuple[tuple, str, int, bool]] = []
+        #   last element: call receiver is `self` (same instance proven)
+        self.under_lock_blocking: List[Tuple[tuple, _Blocking]] = []
+        self.under_lock_acquires: List[Tuple[tuple, str, bool, int, bool]] \
+            = []
+
+
+def _blocking_of_call(call: ast.Call, info: FuncInfo,
+                      project: Project) -> Optional[_Blocking]:
+    """Classify one Call node as a direct blocking primitive."""
+    resolver = project.resolver
+    raw = dotted_name(call.func)
+    line = call.lineno
+    if raw is not None:
+        head = raw.split(".")[0]
+        if raw in ("time.sleep", "os.system", "os.waitpid"):
+            return _Blocking(raw, line)
+        if head == "subprocess":
+            return _Blocking(raw, line)
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    meth = call.func.attr
+    recv = call.func.value
+    recv_tag = resolver.type_of_expr(recv, info)
+    recv_last = (dotted_name(recv) or "").rsplit(".", 1)[-1]
+    if meth in _SOCKET_METHODS:
+        return _Blocking(f"socket .{meth}()", line)
+    if meth in ("send", "connect"):
+        if (recv_tag and recv_tag[0] == "socket") \
+                or recv_last in _SOCKETISH_NAMES:
+            return _Blocking(f"socket .{meth}()", line)
+        return None
+    if meth in ("wait", "wait_for"):
+        lk = resolver.lock_of_expr(recv, info)
+        if lk is not None and lk[0] == "cond":
+            return _Blocking(f"Condition.{meth}()", line,
+                             exempt_lock=lk[1])
+        if recv_tag and recv_tag[0] == "event":
+            return _Blocking("Event.wait()", line)
+        if recv_tag is None and recv_last.startswith(("_cv", "cv")):
+            return _Blocking(f"Condition.{meth}()", line)
+        return None
+    if meth == "join":
+        if recv_tag and recv_tag[0] == "thread":
+            return _Blocking("Thread.join()", line)
+        return None
+    if meth in ("get", "put"):
+        if (recv_tag and recv_tag[0] == "queue") \
+                or recv_last in _QUEUEISH:
+            for kw in call.keywords:
+                if kw.arg == "block" and \
+                        isinstance(kw.value, ast.Constant) and \
+                        kw.value.value is False:
+                    return None
+            return _Blocking(f"queue .{meth}()", line)
+    return None
+
+
+def _walk_with_locks(stmts, held: tuple, info: FuncInfo,
+                     project: Project, facts: _FuncFacts) -> None:
+    """Recursive statement walk tracking the stack of held locks."""
+    resolver = project.resolver
+    for stmt in stmts:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in stmt.items:
+                lk = resolver.lock_of_expr(item.context_expr, info)
+                if lk is None:
+                    continue
+                kind, lock_id, reentrant = lk
+                via_self = True  # self attr / module global by lookup
+                facts.acquires.append((lock_id, reentrant,
+                                       stmt.lineno, via_self))
+                if new_held:
+                    facts.under_lock_acquires.append(
+                        (new_held, lock_id, reentrant, stmt.lineno,
+                         via_self))
+                new_held = new_held + (lock_id,)
+            # expressions inside the with-items themselves run unheld-ish;
+            # conservatively analyze them under the OUTER held set
+            for item in stmt.items:
+                _scan_expr(item.context_expr, held, info, project, facts)
+            _walk_with_locks(stmt.body, new_held, info, project, facts)
+            continue
+        # non-with statements: scan expressions, then recurse into
+        # child statement blocks with the same held set
+        for field in ast.iter_fields(stmt):
+            _, value = field
+            values = value if isinstance(value, list) else [value]
+            for v in values:
+                if isinstance(v, ast.stmt):
+                    _walk_with_locks([v], held, info, project, facts)
+                elif isinstance(v, ast.AST):
+                    _scan_expr(v, held, info, project, facts)
+
+
+def _scan_expr(expr: ast.AST, held: tuple, info: FuncInfo,
+               project: Project, facts: _FuncFacts) -> None:
+    for node in ast.walk(expr):
+        if isinstance(node, (ast.Lambda,)):
+            # a lambda body runs when called, not here — but the common
+            # factory-under-lock pattern DOES call it in place; we keep
+            # scanning (calls inside resolve or are ignored anyway)
+            pass
+        if not isinstance(node, ast.Call):
+            continue
+        blocking = _blocking_of_call(node, info, project)
+        if blocking is not None:
+            facts.blocking.append(blocking)
+            if held:
+                facts.under_lock_blocking.append((held, blocking))
+            continue
+        target = project.resolver.resolve_call(node, info)
+        if target is not None:
+            facts.calls.append((target, node.lineno))
+            if held:
+                is_self = (isinstance(node.func, ast.Attribute)
+                           and isinstance(node.func.value, ast.Name)
+                           and node.func.value.id == "self")
+                facts.under_lock_calls.append(
+                    (held, target, node.lineno, is_self))
+
+
+def _gather_facts(project: Project) -> Dict[str, _FuncFacts]:
+    facts: Dict[str, _FuncFacts] = {}
+    for qn, info in project.index.functions.items():
+        f = _FuncFacts()
+        _walk_with_locks(info.node.body, (), info, project, f)
+        facts[qn] = f
+    return facts
+
+
+def _closure(facts: Dict[str, _FuncFacts]):
+    """Fixpoint: per function, the locks it may acquire transitively and
+    whether it may block, each with a sample call-chain witness."""
+    trans_locks: Dict[str, Dict[str, tuple]] = {}
+    trans_block: Dict[str, Optional[tuple]] = {}
+    for qn, f in facts.items():
+        trans_locks[qn] = {lid: (qn,) for lid, _, _, _ in f.acquires}
+        trans_block[qn] = (f.blocking[0].reason, (qn,)) \
+            if f.blocking else None
+    changed = True
+    rounds = 0
+    while changed and rounds < 50:
+        changed = False
+        rounds += 1
+        for qn, f in facts.items():
+            for callee, _line in f.calls:
+                if callee not in facts:
+                    continue
+                for lid, chain in trans_locks[callee].items():
+                    if lid not in trans_locks[qn]:
+                        trans_locks[qn][lid] = (qn,) + chain
+                        changed = True
+                if trans_block[qn] is None and \
+                        trans_block[callee] is not None:
+                    reason, chain = trans_block[callee]
+                    trans_block[qn] = (reason, (qn,) + chain)
+                    changed = True
+    return trans_locks, trans_block
+
+
+def _short(qn: str) -> str:
+    return ".".join(qn.split(".")[-2:])
+
+
+def _reentrant(facts: Dict[str, _FuncFacts], lock_id: str) -> bool:
+    for f in facts.values():
+        for lid, reentrant, _line, _vs in f.acquires:
+            if lid == lock_id:
+                return reentrant
+    return False
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    facts = _gather_facts(project)
+    trans_locks, trans_block = _closure(facts)
+    path_of = {qn: info.module.src.path
+               for qn, info in project.index.functions.items()}
+
+    # -- build the lock-order graph --------------------------------------
+    edges: Dict[Tuple[str, str], Tuple[str, int, tuple]] = {}
+    for qn, f in facts.items():
+        for held, lid, reentrant, line, via_self in f.under_lock_acquires:
+            inner = held[-1]
+            for h in held:
+                if h == lid:
+                    if not reentrant and via_self:
+                        findings.append(Finding(
+                            NAME, path_of[qn], line,
+                            f"recursive acquisition of non-reentrant "
+                            f"lock '{lid}' in {_short(qn)} — "
+                            f"self-deadlock"))
+                    continue
+                edges.setdefault((h, lid), (qn, line, (qn,)))
+        for held, callee, line, is_self in f.under_lock_calls:
+            if callee not in facts:
+                continue
+            direct_callee = {lid for lid, _, _, _ in facts[callee].acquires}
+            for lid, chain in trans_locks.get(callee, {}).items():
+                for h in held:
+                    if h == lid:
+                        # Same identity via a call chain: two INSTANCES
+                        # of one class are indistinguishable statically,
+                        # so only flag when the same object is proven —
+                        # a direct self.method() call acquiring a self
+                        # attribute lock of the same class.
+                        if is_self and lid in direct_callee and \
+                                not _reentrant(facts, lid):
+                            findings.append(Finding(
+                                NAME, path_of[qn], line,
+                                f"{_short(qn)} calls {_short(callee)} "
+                                f"while holding '{lid}', which "
+                                f"{_short(callee)} acquires again — "
+                                f"self-deadlock on a non-reentrant "
+                                f"lock"))
+                        continue
+                    edges.setdefault((h, lid), (qn, line, (qn,) + chain))
+
+    # -- cycle detection -------------------------------------------------
+    graph: Dict[str, List[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, []).append(b)
+    seen_cycles = set()
+
+    def dfs(start: str):
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in graph.get(node, ()):
+                if nxt == start:
+                    cyc = tuple(path)
+                    rot = min(range(len(cyc)),
+                              key=lambda i: cyc[i:] + cyc[:i])
+                    canon = cyc[rot:] + cyc[:rot]
+                    if canon in seen_cycles:
+                        continue
+                    seen_cycles.add(canon)
+                    qn, line, chain = edges[(path[-1], start)]
+                    order = " -> ".join(canon + (canon[0],))
+                    findings.append(Finding(
+                        NAME, path_of[qn], line,
+                        f"lock acquisition-order cycle: {order} "
+                        f"(edge witnessed in {_short(qn)} via "
+                        f"{' -> '.join(_short(c) for c in chain)})"))
+                elif nxt not in path:
+                    stack.append((nxt, path + [nxt]))
+
+    for node in list(graph):
+        dfs(node)
+
+    # -- blocking while holding a lock -----------------------------------
+    for qn, f in facts.items():
+        for held, blocking in f.under_lock_blocking:
+            bad = [h for h in held
+                   if h != blocking.exempt_lock
+                   and h not in HOLD_BLOCKING_ALLOWLIST]
+            if bad:
+                findings.append(Finding(
+                    NAME, path_of[qn], blocking.line,
+                    f"blocking call ({blocking.reason}) while holding "
+                    f"lock(s) {sorted(bad)} in {_short(qn)} — a stalled "
+                    f"peer wedges every thread contending on them"))
+        for held, callee, line, _is_self in f.under_lock_calls:
+            tb = trans_block.get(callee)
+            if tb is None:
+                continue
+            reason, chain = tb
+            bad = [h for h in held if h not in HOLD_BLOCKING_ALLOWLIST]
+            if bad:
+                findings.append(Finding(
+                    NAME, path_of[qn], line,
+                    f"call chain {' -> '.join(_short(c) for c in (qn,) + chain)} "
+                    f"may block ({reason}) while {_short(qn)} holds "
+                    f"lock(s) {sorted(bad)}"))
+    return findings

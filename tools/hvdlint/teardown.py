@@ -1,0 +1,136 @@
+"""teardown: multi-step cleanup must be stage-guarded.
+
+The PR 4 bug class: ``Runtime._background_loop``'s ``finally`` ran the
+finalizer drain, the shutdown fan-out callbacks and the timeline flush
+in sequence — and a raising drain or user callback silently skipped
+``Timeline.shutdown``, leaving the trace of exactly the aborted run you
+most wanted to inspect as an unterminated JSON fragment. The rule:
+
+* In any ``finally`` block — and in any function named ``close`` /
+  ``shutdown`` / ``teardown`` / ``__exit__`` (the shutdown paths) —
+  with **two or more** cleanup stages, every stage must be
+  individually guarded (wrapped in its own ``try``), because a raise
+  in one stage must not skip the ones after it.
+
+* A *cleanup stage* is a top-level statement invoking a cleanup-shaped
+  call: ``.close() .shutdown() .join() .drain() .stop() .terminate()
+  .kill() .cancel() .release() .unlink() .callback()``. Bookkeeping
+  (assignments, ``.set()``, logging) does not count as a stage.
+
+* In a named cleanup *function* the last stage may propagate (raising
+  from the final step of ``close()`` is legitimate API behavior); in a
+  ``finally`` block every stage must be guarded — an exception escaping
+  a ``finally`` also clobbers whatever exception was already in
+  flight, which is how the original failure disappears from logs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from tools.hvdlint.core import Finding, Project, dotted_name
+
+NAME = "teardown"
+
+CLEANUP_CALL_NAMES = {
+    "close", "shutdown", "join", "drain", "stop", "terminate", "kill",
+    "cancel", "disconnect", "unlink", "cleanup", "callback",
+}
+CLEANUP_FUNC_NAMES = {"close", "shutdown", "teardown", "__exit__"}
+
+
+def _cleanup_calls(stmt: ast.stmt) -> List[ast.Call]:
+    """Cleanup-shaped calls in a statement, NOT descending into nested
+    try-guards (those are already staged) or nested defs."""
+    out: List[ast.Call] = []
+    stack: List[ast.AST] = [stmt]
+    while stack:
+        node = stack.pop()
+        if node is not stmt and isinstance(node, ast.Try):
+            continue
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Call):
+            name = None
+            if isinstance(node.func, ast.Attribute):
+                name = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                name = node.func.id
+            if name in CLEANUP_CALL_NAMES:
+                d = dotted_name(node.func) or ""
+                # str.join / os.path.join style false friends
+                if not d.startswith(("os.", "str.", '"', "'")):
+                    out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _stages(body: List[ast.stmt]) -> List[Tuple[ast.stmt, bool,
+                                                Optional[ast.Call]]]:
+    """(statement, guarded, first unguarded cleanup call) per top-level
+    statement that contains at least one cleanup call."""
+    stages = []
+    for stmt in body:
+        if isinstance(stmt, ast.Try):
+            # guarded stage if it has handlers; its own finally/else are
+            # the statement's business
+            inner = []
+            for s in stmt.body:
+                inner.extend(_cleanup_calls(s))
+            if inner or any(_cleanup_calls(s) for h in stmt.handlers
+                            for s in h.body):
+                stages.append((stmt, bool(stmt.handlers),
+                               inner[0] if inner else None))
+            continue
+        calls = _cleanup_calls(stmt)
+        if calls:
+            stages.append((stmt, False, calls[0]))
+    return stages
+
+
+def _check_block(body: List[ast.stmt], path: str, where: str,
+                 allow_last_unguarded: bool,
+                 findings: List[Finding]) -> None:
+    stages = _stages(body)
+    if len(stages) < 2:
+        return
+    last_stmt = stages[-1][0]
+    for stmt, guarded, call in stages:
+        if guarded:
+            continue
+        if allow_last_unguarded and stmt is last_stmt:
+            continue
+        name = ""
+        if call is not None and isinstance(call.func, ast.Attribute):
+            name = f".{call.func.attr}()"
+        elif call is not None and isinstance(call.func, ast.Name):
+            name = f"{call.func.id}()"
+        line = call.lineno if call is not None else stmt.lineno
+        findings.append(Finding(
+            NAME, path, line,
+            f"unguarded cleanup stage {name} in {where}: a raise here "
+            f"skips the {len(stages)}-stage teardown's remaining "
+            f"steps — wrap each stage in its own try/except"))
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for qn, info in project.index.functions.items():
+        fn = info.node
+        short = ".".join(qn.split(".")[-2:])
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                continue
+            if isinstance(node, ast.Try) and node.finalbody:
+                _check_block(node.finalbody, info.module.src.path,
+                             f"{short} finally-block",
+                             allow_last_unguarded=False,
+                             findings=findings)
+        if fn.name in CLEANUP_FUNC_NAMES:
+            _check_block(fn.body, info.module.src.path,
+                         f"{short}()", allow_last_unguarded=True,
+                         findings=findings)
+    return findings

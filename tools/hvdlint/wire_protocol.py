@@ -1,0 +1,226 @@
+"""wire-protocol: coherence checks over the control-plane codec.
+
+Scope: modules named ``wire`` (the project's binary codec). Three bug
+classes, each one this repo has actually shipped and review-fixed:
+
+1. **Orphan codec halves.** Every ``serialize_<x>`` must have a
+   ``parse_<x>`` and vice versa — a tag you can encode but not decode
+   (or the reverse) is a wire protocol only half the world speaks.
+
+2. **Discriminator collisions** (the PR 3 PACKED bug class). All
+   one-byte frame discriminators — ``FRAME_*`` integer constants plus
+   raw single-byte ``*_PREFIX`` envelope literals — must be pairwise
+   distinct, and a raw envelope prefix must sit in the reserved high
+   band (>= 0xF0): the first byte of a packed aggregate is a little-
+   endian u32 *count*, and a small prefix value is indistinguishable
+   from the count byte of a small pack (2 ranks pack to a leading
+   0x02, which was exactly FRAME_CACHED_AGG).
+
+3. **Unguarded ``struct.unpack_from``** (the PR 3 truncated-frame bug
+   class). Every unpack of network bytes must be dominated by a
+   buffer-length guard so a truncated frame raises a transport error
+   (ConnectionError) instead of ``struct.error``/IndexError deep in a
+   parse. A guard is a preceding call to a ``_need``/``require``-style
+   helper or an explicit ``len(...)`` comparison that raises. The same
+   applies to raw mask/segment slices: ``int.from_bytes`` over a short
+   slice silently yields a WRONG mask, which is worse than a crash.
+
+4. **Kind coverage.** Each ``FRAME_*`` constant must appear in at
+   least one ``serialize_*`` and one ``parse_*`` function — a kind
+   only one direction knows is an orphan discriminator.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from tools.hvdlint.core import Finding, Project, SourceFile, dotted_name
+
+NAME = "wire-protocol"
+
+GUARD_CALL_NAMES = {"_need", "need", "_require", "require", "_ensure",
+                    "ensure", "_check_len", "check_len"}
+PREFIX_RESERVED_MIN = 0xF0
+
+
+def _is_wire_module(src: SourceFile) -> bool:
+    return src.shortname == "wire" or src.shortname.startswith("wire_")
+
+
+def _const_int(node: ast.AST, consts: Dict[str, int]) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+def _collect_discriminators(src: SourceFile):
+    """(frame_consts {name: value}, prefixes {name: (value, line, raw)})
+    where raw=True means a literal byte not derived from a FRAME_*."""
+    frames: Dict[str, int] = {}
+    prefixes: Dict[str, tuple] = {}
+    for node in src.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        name = node.targets[0].id
+        val = node.value
+        if name.startswith("FRAME_") and isinstance(val, ast.Constant) \
+                and isinstance(val.value, int):
+            frames[name] = val.value
+        elif name.endswith("_PREFIX"):
+            if isinstance(val, ast.Constant) and \
+                    isinstance(val.value, bytes) and len(val.value) == 1:
+                prefixes[name] = (val.value[0], node.lineno, True)
+            elif isinstance(val, ast.Call) and \
+                    dotted_name(val.func) == "bytes" and val.args:
+                # bytes((FRAME_X,)) — derived from a frame constant
+                arg = val.args[0]
+                elts = arg.elts if isinstance(arg, (ast.Tuple, ast.List)) \
+                    else []
+                if len(elts) == 1:
+                    v = _const_int(elts[0], frames)
+                    if v is not None:
+                        prefixes[name] = (v, node.lineno, False)
+    return frames, prefixes
+
+
+def _has_guard_before(func: ast.FunctionDef, line: int) -> bool:
+    """True when a length guard lexically precedes ``line`` inside
+    ``func``: a call to a guard-named helper, or a test (If/Assert/
+    While/comparison) that mentions ``len(``."""
+    for node in ast.walk(func):
+        if getattr(node, "lineno", line) >= line:
+            continue
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func) or ""
+            if d.rsplit(".", 1)[-1] in GUARD_CALL_NAMES:
+                return True
+        if isinstance(node, (ast.If, ast.Assert, ast.While)):
+            for sub in ast.walk(node.test):
+                if isinstance(sub, ast.Call) and \
+                        dotted_name(sub.func) == "len":
+                    if isinstance(node, ast.Assert):
+                        return True
+                    # an If/While guard must actually bail out
+                    if any(isinstance(s, (ast.Raise, ast.Return,
+                                          ast.Continue, ast.Break))
+                           for s in node.body):
+                        return True
+    return False
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in project.files:
+        if not _is_wire_module(src):
+            continue
+        findings.extend(_check_module(src))
+    return findings
+
+
+def _check_module(src: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    serialize: Dict[str, ast.FunctionDef] = {}
+    parse: Dict[str, ast.FunctionDef] = {}
+    functions: List[ast.FunctionDef] = []
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.FunctionDef):
+            functions.append(node)
+            if node.name.startswith("serialize_"):
+                serialize[node.name[len("serialize_"):]] = node
+            elif node.name.startswith("parse_"):
+                parse[node.name[len("parse_"):]] = node
+
+    # 1 — encode/decode pairing
+    for suffix, node in sorted(serialize.items()):
+        if suffix not in parse:
+            findings.append(Finding(
+                NAME, src.path, node.lineno,
+                f"serialize_{suffix} has no matching parse_{suffix} — "
+                f"a frame the world can emit but never decode"))
+    for suffix, node in sorted(parse.items()):
+        if suffix not in serialize:
+            findings.append(Finding(
+                NAME, src.path, node.lineno,
+                f"parse_{suffix} has no matching serialize_{suffix} — "
+                f"a frame the world expects but never produces"))
+
+    # 2 — discriminator collisions
+    frames, prefixes = _collect_discriminators(src)
+    seen: Dict[int, str] = {}
+    for fname, v in sorted(frames.items()):
+        if v in seen:
+            findings.append(Finding(
+                NAME, src.path, 1,
+                f"frame discriminators {seen[v]} and {fname} share "
+                f"byte value {v:#04x}"))
+        else:
+            seen[v] = fname
+    for pname, (v, line, raw) in sorted(prefixes.items()):
+        if raw:
+            if v in seen:
+                findings.append(Finding(
+                    NAME, src.path, line,
+                    f"envelope prefix {pname} ({v:#04x}) collides with "
+                    f"frame discriminator {seen[v]} on the same tag"))
+            if v < PREFIX_RESERVED_MIN:
+                findings.append(Finding(
+                    NAME, src.path, line,
+                    f"envelope prefix {pname} ({v:#04x}) is below the "
+                    f"reserved band (>= {PREFIX_RESERVED_MIN:#04x}): a "
+                    f"packed aggregate's leading u32 count byte can "
+                    f"alias it (the PACKED relay bug class)"))
+
+    # 3 — unpack/slice guards
+    for fn in functions:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func) or ""
+            if d.rsplit(".", 1)[-1] == "unpack_from":
+                if not _has_guard_before(fn, node.lineno):
+                    findings.append(Finding(
+                        NAME, src.path, node.lineno,
+                        f"struct.unpack_from in {fn.name} is not "
+                        f"dominated by a buffer-length guard — a "
+                        f"truncated frame raises struct.error instead "
+                        f"of a transport error"))
+            elif d == "int.from_bytes":
+                arg = node.args[0] if node.args else None
+                if isinstance(arg, ast.Subscript) and \
+                        isinstance(arg.slice, ast.Slice) and \
+                        not _has_guard_before(fn, node.lineno):
+                    findings.append(Finding(
+                        NAME, src.path, node.lineno,
+                        f"int.from_bytes over a raw slice in {fn.name} "
+                        f"without a length guard — a short buffer "
+                        f"silently decodes a WRONG value"))
+
+    # 4 — kind coverage: every FRAME_* referenced by both directions
+    refs: Dict[str, set] = {name: set() for name in frames}
+    for direction, table in (("serialize", serialize), ("parse", parse)):
+        for fn in table.values():
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Name) and node.id in refs:
+                    refs[node.id].add(direction)
+    for fname in sorted(frames):
+        used = refs[fname]
+        # a constant may legitimately ride through shared helpers; only
+        # flag when a direction NEVER sees it
+        node_line = 1
+        for node in src.tree.body:
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.targets[0], ast.Name) and \
+                    node.targets[0].id == fname:
+                node_line = node.lineno
+        for direction in ("serialize", "parse"):
+            if direction not in used:
+                findings.append(Finding(
+                    NAME, src.path, node_line,
+                    f"frame kind {fname} never appears in any "
+                    f"{direction}_* function — encode/decode halves "
+                    f"disagree about the protocol"))
+    return findings

@@ -1,0 +1,218 @@
+"""world-coherence: world-replicated state mutates only behind
+``@world_coherent`` sites.
+
+PR 3's response cache works because every structural mutation (slot
+assignment, LRU order, eviction, epoch) is driven ONLY by world-
+identical events — the broadcast response stream and the coordinator's
+grant/invalidate masks — applied in one canonical order on every rank.
+That invariant lived in prose; this analyzer makes it a check:
+
+* An attribute is declared world-replicated by a trailing
+  ``# hvdlint: world-replicated`` comment on its initializing
+  assignment (ResponseCache ``epoch``/``_lru``/``_slots``/``_free``,
+  the runtime's steady predictor).
+
+* Any function that mutates such an attribute — assignment, augmented
+  assignment, subscript store/delete, a mutating method call
+  (``append``/``pop``/``move_to_end``/...), or passing it to
+  ``heapq.heappush``/``heappop`` — or that calls a *mutator method* of
+  the owning class on a typed receiver, must be **coverage-reachable**:
+  it carries ``@world_coherent`` itself, or every one of its in-project
+  callers does (transitively). The decorator (exported by
+  ``horovod_tpu.common.invariants``) marks exactly the functions whose
+  inputs are world-identical by construction; anything else reaching a
+  mutation is a latent divergence — one rank's cache marching to a
+  different drummer.
+
+The owning class's ``__init__`` (construction) is exempt; so is the
+declaring assignment itself. Reads are always fine — divergence needs
+a write.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.hvdlint.core import (
+    Finding, FuncInfo, Project, dotted_name, iter_executed,
+)
+
+NAME = "world-coherence"
+
+MUTATING_METHODS = {
+    "append", "extend", "insert", "pop", "popitem", "popleft",
+    "appendleft", "clear", "remove", "discard", "add", "update",
+    "setdefault", "move_to_end", "push",
+}
+_HEAP_FUNCS = {"heapq.heappush", "heapq.heappop", "heapq.heapreplace",
+               "heapq.heappushpop"}
+
+
+def _declared(project: Project) -> Dict[str, Set[str]]:
+    """class qualname -> set of world-replicated attr names."""
+    out: Dict[str, Set[str]] = {}
+    for mod in project.index.modules.values():
+        for ci in mod.classes.values():
+            if ci.replicated_attrs:
+                out[ci.qualname] = set(ci.replicated_attrs)
+    return out
+
+
+def _is_world_coherent(info: FuncInfo) -> bool:
+    return any(d.rsplit(".", 1)[-1] == "world_coherent"
+               for d in info.decorators)
+
+
+def _attr_of(node: ast.AST) -> Optional[str]:
+    """'X' for a plain ``self.X`` expression."""
+    d = dotted_name(node)
+    if d and d.startswith("self.") and d.count(".") == 1:
+        return d.split(".", 1)[1]
+    return None
+
+
+def _direct_mutations(info: FuncInfo, attrs: Set[str]
+                      ) -> List[Tuple[str, int]]:
+    """(attr, line) for every mutation of a declared attr of the
+    function's own class."""
+    out: List[Tuple[str, int]] = []
+    for node in iter_executed(info.node):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                a = _attr_of(t)
+                if a in attrs:
+                    out.append((a, node.lineno))
+                if isinstance(t, ast.Subscript):
+                    a = _attr_of(t.value)
+                    if a in attrs:
+                        out.append((a, node.lineno))
+        elif isinstance(node, ast.AugAssign):
+            a = _attr_of(node.target)
+            if a in attrs:
+                out.append((a, node.lineno))
+            if isinstance(node.target, ast.Subscript):
+                a = _attr_of(node.target.value)
+                if a in attrs:
+                    out.append((a, node.lineno))
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                a = _attr_of(t)
+                if a in attrs:
+                    out.append((a, node.lineno))
+                if isinstance(t, ast.Subscript):
+                    a = _attr_of(t.value)
+                    if a in attrs:
+                        out.append((a, node.lineno))
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in MUTATING_METHODS:
+                a = _attr_of(f.value)
+                if a in attrs:
+                    out.append((a, node.lineno))
+            d = dotted_name(f)
+            if d in _HEAP_FUNCS:
+                for arg in node.args[:1]:
+                    a = _attr_of(arg)
+                    if a in attrs:
+                        out.append((a, node.lineno))
+    return out
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    declared = _declared(project)
+    if not declared:
+        return findings
+    index = project.index
+    resolver = project.resolver
+
+    # mutator functions: qualname -> (owner class, attr, line)
+    mutators: Dict[str, Tuple[str, str, int]] = {}
+    for qn, info in index.functions.items():
+        if info.cls is None or info.cls.qualname not in declared:
+            continue
+        if info.node.name == "__init__":
+            continue  # construction precedes replication
+        hits = _direct_mutations(info, declared[info.cls.qualname])
+        if hits:
+            attr, line = hits[0]
+            mutators[qn] = (info.cls.qualname, attr, line)
+
+    # functions calling a mutator METHOD of an owning class on a typed
+    # receiver also count as mutation sites
+    mutator_methods: Dict[str, Set[str]] = {}
+    for qn in mutators:
+        cls_qual, _, mname = qn.rpartition(".")
+        mutator_methods.setdefault(cls_qual, set()).add(mname)
+
+    # reverse call graph over resolvable calls
+    callers: Dict[str, Set[str]] = {}
+    calls_of: Dict[str, Set[str]] = {}
+    for qn, info in index.functions.items():
+        targets: Set[str] = set()
+        for node in iter_executed(info.node):
+            if isinstance(node, ast.Call):
+                t = resolver.resolve_call(node, info)
+                if t is not None:
+                    targets.add(t)
+                    callers.setdefault(t, set()).add(qn)
+        calls_of[qn] = targets
+
+    # coverage: a function is covered when annotated, or when it HAS
+    # callers and every caller is covered.
+    memo: Dict[str, Optional[bool]] = {}
+
+    def covered(qn: str) -> bool:
+        state = memo.get(qn)
+        if state is not None:
+            return state
+        memo[qn] = False  # cycle guard: a caller loop is not coverage
+        info = index.functions.get(qn)
+        if info is not None and _is_world_coherent(info):
+            memo[qn] = True
+            return True
+        cs = callers.get(qn, set())
+        # coverage flows down the call graph; an uncalled, unannotated
+        # function is uncovered by definition (tests and external API
+        # consumers are outside the scanned set on purpose).
+        result = bool(cs) and all(covered(c) for c in cs)
+        memo[qn] = result
+        return result
+
+    reported: Set[str] = set()
+
+    def report(qn: str, why: str, line: int) -> None:
+        if qn in reported:
+            return
+        reported.add(qn)
+        info = index.functions[qn]
+        findings.append(Finding(
+            NAME, info.module.src.path, line,
+            f"{qn.split('.', 2)[-1]} {why}, but is reachable outside "
+            f"@world_coherent call chains — a rank-local caller could "
+            f"diverge world-replicated state"))
+
+    for qn, (cls_qual, attr, line) in mutators.items():
+        if not covered(qn):
+            report(qn, f"mutates world-replicated "
+                       f"'{cls_qual.rsplit('.', 1)[-1]}.{attr}'", line)
+
+    for qn, info in index.functions.items():
+        if qn in mutators:
+            continue
+        for node in iter_executed(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            t = resolver.resolve_call(node, info)
+            if t is None:
+                continue
+            cls_qual, _, mname = t.rpartition(".")
+            if cls_qual in mutator_methods and \
+                    mname in mutator_methods[cls_qual] and \
+                    (info.cls is None or info.cls.qualname != cls_qual):
+                if not covered(qn):
+                    report(qn, f"calls world-replicated mutator "
+                               f"{cls_qual.rsplit('.', 1)[-1]}.{mname}",
+                           node.lineno)
+    return findings
