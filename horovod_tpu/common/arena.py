@@ -1,0 +1,113 @@
+"""Persistent fusion arenas: grow-only pack buffers for the data plane.
+
+The reference keeps ONE long-lived fusion buffer per device and packs
+every fused batch into it (reference: horovod/common/fusion_buffer_
+manager.cc — allocated once, reused for the job's lifetime); this
+module is that idea for the host data planes. An arena is a page-
+aligned, grow-only numpy byte buffer: steady-state steps pack into the
+same memory every cycle, so the per-step cost is one memcpy instead of
+an allocation + memcpy, and the send-side iovec plans built over arena
+pointers stay valid for the life of the plan (grown arenas re-allocate,
+but numpy views keep the old base alive, so existing plans keep
+working and new plans bind the new memory).
+
+Aliasing contract (the rule the aliasing-correctness tests pin down):
+arena memory only ever holds SEND-side packed bytes and coordinator
+peer scratch. Receive destinations that user-visible outputs may alias
+are always fresh per-op arrays — never arena memory — so a tensor
+handed back by a collective is never clobbered by a later step.
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import List, Optional
+
+import numpy as np
+
+_PAGE = 4096
+
+# Live arenas (weak), for the hvd_arena_bytes gauge: observability
+# only — the metrics collector sums capacities once per snapshot.
+_ARENAS: List["weakref.ref"] = []
+
+
+def _pad(nbytes: int) -> int:
+    return -(-max(nbytes, 1) // _PAGE) * _PAGE
+
+
+class FusionArena:
+    """One grow-only pack buffer.
+
+    ``generation`` bumps on every re-allocation so memoized pointer
+    plans (ctypes iovec bundles) know when their views bind an old
+    allocation — old views stay VALID (numpy keeps the base alive),
+    new plans should rebind via :meth:`view`.
+    """
+
+    __slots__ = ("_buf", "generation", "__weakref__")
+
+    def __init__(self):
+        self._buf: Optional[np.ndarray] = None
+        self.generation = 0
+        _ARENAS.append(weakref.ref(self))
+
+    @property
+    def nbytes(self) -> int:
+        return 0 if self._buf is None else self._buf.nbytes
+
+    def ensure(self, nbytes: int) -> None:
+        """Grow (never shrink) to hold ``nbytes``. Doubling growth so
+        a stream of slightly-increasing payloads re-allocates O(log)
+        times, like the shm segment stride policy."""
+        if self._buf is not None and self._buf.nbytes >= nbytes:
+            return
+        new = _pad(max(nbytes, 2 * self.nbytes))
+        self._buf = np.empty(new, np.uint8)
+        self.generation += 1
+
+    def view(self, offset: int, nbytes: int) -> np.ndarray:
+        """Writable uint8 view of [offset, offset+nbytes) — grows the
+        arena if needed."""
+        self.ensure(offset + nbytes)
+        return self._buf[offset:offset + nbytes]
+
+    def typed(self, offset: int, dtype, count: int) -> np.ndarray:
+        """Writable typed view (zero-copy reinterpret of :meth:`view`;
+        numpy extension dtypes like bfloat16 ride through .view)."""
+        dtype = np.dtype(dtype)
+        raw = self.view(offset, count * dtype.itemsize)
+        return raw.view(dtype)
+
+
+def concat_into(flats, dst) -> None:
+    """Pack same-dtype flat arrays into ``dst`` (len == total size):
+    one C-level gather-copy. Measurably cheaper than marshalling
+    ctypes pointer arrays into the native pack at gradient-bucket
+    sizes — building two 64-slot ctypes arrays costs more than the
+    memcpys themselves. The element-wise fallback covers numpy builds
+    whose ``concatenate(out=)`` rejects the destination view. THE one
+    pack idiom both the classic host planes and the steady plans
+    share."""
+    try:
+        np.concatenate(flats, out=dst)
+    except (TypeError, ValueError):
+        pos = 0
+        for a in flats:
+            dst[pos:pos + a.size] = a
+            pos += a.size
+
+
+def total_bytes() -> int:
+    """Sum of live arena capacities (the hvd_arena_bytes gauge)."""
+    total = 0
+    dead = False
+    for ref in _ARENAS:
+        a = ref()
+        if a is None:
+            dead = True
+            continue
+        total += a.nbytes
+    if dead:
+        _ARENAS[:] = [r for r in _ARENAS if r() is not None]
+    return total

@@ -95,6 +95,17 @@ class Config:
     # batch anyway (shm/ring/XLA-bound batches keep their plane).
     cache_speculative: bool = True
 
+    # Zero-copy native data plane (docs/performance.md): steady-state
+    # payloads move straight between sockets and numpy memory — the
+    # persistent fusion arena feeds vectored sendmsg/recvmsg
+    # (hvd_sendv/hvd_recv_into), receive sides land in preallocated
+    # arrays, and the fused speculative cycle runs as ONE native call
+    # per step (hvd_steady_cycle family). HOROVOD_TPU_ZERO_COPY=0
+    # restores the PR 3 byte-copy paths (A/B lever for
+    # collective_bench --steady-only; heterogeneous worlds are safe —
+    # the wire format is identical either way).
+    zero_copy: bool = True
+
     # Ring data plane for the socket backend (TPU-native extension): host
     # payloads at or above this size ride the bandwidth-optimal 2-phase
     # ring (ops/ring.py) instead of the star through rank 0 — the TCP
@@ -241,6 +252,7 @@ class Config:
                                     c.cache_capacity)
         c.cache_speculative = _env_bool("HOROVOD_CACHE_SPECULATIVE",
                                         c.cache_speculative)
+        c.zero_copy = _env_bool("HOROVOD_TPU_ZERO_COPY", c.zero_copy)
         c.ring_threshold_bytes = _env_int(
             "HOROVOD_TPU_RING_THRESHOLD", c.ring_threshold_bytes)
         c.shm_enabled = _env_bool("HOROVOD_TPU_SHM", c.shm_enabled)

@@ -336,9 +336,12 @@ class _NativeFanout:
 
     def _as_u8(self, data):
         """bytes/buffer → ctypes u8 array at memcpy speed (never a
-        per-byte Python loop — these sit on the per-cycle hot path)."""
-        return (self._ct.c_uint8 * max(1, len(data))).from_buffer_copy(
-            data or b"\x00")
+        per-byte Python loop — these sit on the per-cycle hot path).
+        Empty-vs-nonempty is decided by len(), never truthiness — a
+        numpy payload's __bool__ raises on multi-element arrays."""
+        if not len(data):
+            return (self._ct.c_uint8 * 1)(0)
+        return (self._ct.c_uint8 * len(data)).from_buffer_copy(data)
 
     def gather(self, expect_tag: int) -> Dict[int, bytes]:
         """One frame from every peer; returns {rank: payload}. With a
@@ -615,6 +618,52 @@ class Controller:
         its own."""
         raise NotImplementedError
 
+    # -- zero-copy data plane (recv-into variants) -----------------------
+    # The *_into primitives move payloads straight between sockets and
+    # caller-owned writable buffers (numpy arrays, arena views): no
+    # bytes object is materialized on the receive side. Callers must
+    # invoke them at the same negotiated response position on every
+    # rank, exactly like their bytes-returning counterparts.
+
+    def gather_data_into(self, payload, outs) -> Optional[List[int]]:
+        """Data gather with preallocated receive buffers: workers send
+        ``payload`` (``outs`` ignored; returns None); the coordinator
+        receives rank r's payload straight into ``outs[r]`` (writable;
+        ``outs[0]`` untouched — its own payload is already local) and
+        returns per-rank byte counts."""
+        raise NotImplementedError
+
+    def broadcast_data_into(self, payload, out, root_rank: int = 0) -> int:
+        """Broadcast with the receive side landing in ``out``: the
+        root sends ``payload`` (its result is its own buffer); every
+        other rank fills ``out`` and gets the byte count back."""
+        raise NotImplementedError
+
+    def scatter_data_into(self, payloads, out) -> int:
+        """Scatter with the receive side landing in ``out``. The
+        coordinator passes one payload per rank and only sends (its
+        own slice is already local; returns its byte count); workers
+        pass None and receive into ``out``."""
+        raise NotImplementedError
+
+    # -- native steady cycle (common/steady.py) --------------------------
+    def steady_native_ready(self) -> bool:
+        """True when this controller can run the one-call native
+        steady fused cycle (flat topology tier + native core loaded).
+        Stable after startup — the runtime probes once."""
+        return False
+
+    def steady_spec_cycle(self, plan, bufs):
+        """Run one steady fused cycle natively (see common/steady.py).
+        Returns None when unsupported (caller serializes classically),
+        ('done', result_segments) on a completed single-round cycle,
+        ('frame', payload) on a worker-side deviation (the broadcast
+        frame to parse classically), or ('fallback', gathered) on a
+        coordinator-side deviation (rank-indexed request frames for
+        the classic negotiation). Transport failures raise the same
+        WorldAbortedError family as the classic primitives."""
+        return None
+
     def agree(self, local_flag: bool) -> bool:
         """World-wide AND of a per-rank boolean over the data channel.
 
@@ -679,6 +728,24 @@ class LocalController(Controller):
         assert payloads is not None and len(payloads) == 1
         return payloads[0]
 
+    def gather_data_into(self, payload, outs) -> Optional[List[int]]:
+        return [len(_as_buffer(payload))]
+
+    def broadcast_data_into(self, payload, out, root_rank: int = 0) -> int:
+        view = _as_buffer(payload)
+        if out is not None and view is not None:
+            mv = memoryview(network.as_byte_view(out))
+            mv[:len(view)] = view
+        return 0 if view is None else len(view)
+
+    def scatter_data_into(self, payloads, out) -> int:
+        assert payloads is not None and len(payloads) == 1
+        view = _as_buffer(payloads[0])
+        if out is not None:
+            mv = memoryview(network.as_byte_view(out))
+            mv[:len(view)] = view
+        return len(view)
+
 
 class TcpCoordinator(Controller):
     """Rank 0: accepts one persistent connection per worker.
@@ -733,6 +800,11 @@ class TcpCoordinator(Controller):
         # (maintained only when metrics are attached; feeds the
         # per-peer heartbeat-age gauges).
         self._last_seen: Dict[int, float] = {}
+        # Native steady-cycle state (common/steady.py): per-peer
+        # scratch arena + the ctypes PING callback, built lazily on
+        # the first steady cycle.
+        self._steady_scratch = None
+        self._steady_on_idle = None
 
     def accept_workers(self) -> None:
         deadline = time.monotonic() + self._start_timeout
@@ -1065,7 +1137,7 @@ class TcpCoordinator(Controller):
     def scatter_data(self, payloads: Optional[List[bytes]]) -> bytes:
         assert payloads is not None and len(payloads) == self._size
         per_owner: Dict[int, bytes] = {
-            owner: (payloads[owner] if len(ms) == 1
+            owner: (_as_buffer(payloads[owner]) if len(ms) == 1
                     else pack_frames([_as_buffer(payloads[m])
                                       for m in ms]))
             for owner, ms in self._members.items()}
@@ -1078,6 +1150,200 @@ class TcpCoordinator(Controller):
             return payloads[0]
         except (ConnectionError, OSError) as e:
             self._raise_transport(e)
+
+    def _recv_data_into(self, r: int, ch: network.Channel, out) -> int:
+        """One TAG_DATA frame from rank ``r`` straight into ``out``
+        (the recv-into mirror of _recv_ctrl): out-of-band frames are
+        absorbed — from the spill when they exceed ``out`` (a METRICS
+        or ABORT frame may well be bigger than a small data payload),
+        overwritten in place otherwise."""
+        view = memoryview(network.as_byte_view(out))
+        while True:
+            try:
+                tag, n, spill = ch.recv_into_spill(view)
+            except WorldAbortedError:
+                raise
+            except (ConnectionError, OSError) as e:
+                raise _abort_error(
+                    r, f"control channel to {ch.peer} failed: {e}") \
+                    from e
+            if tag == TAG_PING:
+                continue
+            if tag == TAG_METRICS:
+                self._on_metrics(r, spill if spill is not None
+                                 else bytes(view[:n]))
+                continue
+            if tag == TAG_ABORT:
+                origin, cause = heartbeat.decode_abort(
+                    spill if spill is not None else bytes(view[:n]))
+                raise _abort_error(origin, cause, resolved=True)
+            if tag != TAG_DATA:
+                raise ConnectionError(
+                    f"expected tag {TAG_DATA} from rank {r}, got {tag}")
+            if spill is not None:
+                raise ConnectionError(
+                    f"data frame of {n} bytes from rank {r} overflows "
+                    f"{len(view)}-byte buffer")
+            if self._metrics_on:
+                self._last_seen[r] = time.monotonic()
+                self._m_ctrl_rx.inc(n)
+            return n
+
+    def gather_data_into(self, payload, outs) -> Optional[List[int]]:
+        if self._has_aggregates:
+            # Hierarchical owners deliver pack_frames aggregates —
+            # per-rank payloads interleave inside one frame, so this
+            # tier takes the classic gather and one copy per rank.
+            gathered = self.gather_data(payload)
+            lens = [0] * self._size
+            for r in range(1, self._size):
+                data = gathered[r]
+                mv = memoryview(network.as_byte_view(outs[r]))
+                mv[:len(data)] = data
+                lens[r] = len(data)
+            return lens
+        lens = [0] * self._size
+        try:
+            for r, ch in self._channels.items():
+                lens[r] = self._recv_data_into(r, ch, outs[r])
+        except WorldAbortedError:
+            raise
+        except (ConnectionError, OSError) as e:
+            self._raise_transport(e)
+        return lens
+
+    def broadcast_data_into(self, payload, out,
+                            root_rank: int = 0) -> int:
+        try:
+            if root_rank == 0:
+                payload = _as_buffer(payload)
+                assert payload is not None
+                if self._metrics_on:
+                    self._m_ctrl_tx.inc(
+                        len(payload) * len(self._channels))
+                if self._fanout is not None:
+                    self._fanout.send_all(payload, TAG_DATA)
+                else:
+                    for ch in self._channels.values():
+                        ch.send(payload, TAG_DATA)
+                return len(payload)
+            owner = self._owner_of[root_rank]
+            n = self._recv_data_into(owner, self._channels[owner], out)
+            view = memoryview(network.as_byte_view(out))[:n]
+            if self._fanout is not None:
+                self._fanout.send_all(view, TAG_DATA,
+                                      exclude_rank=owner)
+            else:
+                for r, ch in self._channels.items():
+                    if r != owner:
+                        ch.send(view, TAG_DATA)
+            return n
+        except WorldAbortedError:
+            raise
+        except (ConnectionError, OSError) as e:
+            self._raise_transport(e)
+
+    def scatter_data_into(self, payloads, out) -> int:
+        assert payloads is not None and len(payloads) == self._size
+        self.scatter_data(payloads)  # send-only for the coordinator
+        return len(_as_buffer(payloads[0]))
+
+    # -- native steady cycle ---------------------------------------------
+    def steady_native_ready(self) -> bool:
+        if self._has_aggregates or not self._channels:
+            return False
+        from horovod_tpu import native as _native
+        return _native.get() is not None
+
+    def steady_spec_cycle(self, plan, bufs):
+        from horovod_tpu import native as _native
+        from horovod_tpu.common import steady as _steady
+        lib = _native.get()
+        if lib is None or self._has_aggregates or not plan.native_ok \
+                or not self._channels:
+            return None
+        ranks = sorted(self._channels)
+        fds = []
+        for r in ranks:
+            try:
+                fd = self._channels[r].sock.fileno()
+            except OSError:
+                fd = -1
+            if fd < 0:
+                raise _abort_error(
+                    r, f"connection to rank {r} lost before the "
+                       f"steady cycle")
+            fds.append(fd)
+        hb = None
+        if self._hb_timeout and self._hb_timeout > 0:
+            hb = _hb_normalized(self._hb_timeout, self._hb_interval)
+            if self._steady_on_idle is None:
+                self._steady_on_idle = _native.ON_IDLE_FUNC(
+                    self._ping_peers)
+        if self._steady_scratch is None:
+            from horovod_tpu.common.arena import FusionArena
+            self._steady_scratch = FusionArena()
+
+        def on_oob(idx: int, tag: int, payload: bytes) -> bool:
+            if tag == TAG_METRICS:
+                self._on_metrics(ranks[idx], payload)
+                return True
+            return False
+
+        kind, val = _steady.run_coord_cycle(
+            lib, plan, fds, self._secret, bufs, bytes((TAG_PING,)),
+            TAG_REQUESTS, TAG_RESPONSES, hb,
+            self._steady_on_idle if hb is not None else None,
+            self._steady_scratch, on_oob)
+        if kind == _steady.DONE:
+            if self._metrics_on:
+                now = time.monotonic()
+                nbytes = plan.payload_nbytes
+                for r in ranks:
+                    self._last_seen[r] = now
+                self._m_ctrl_rx.inc(nbytes * len(ranks))
+                self._m_ctrl_tx.inc(nbytes * len(ranks))
+            return ("done", val)
+        if kind == _steady.DEV:
+            idx, tag, payload, done, peer_views = val
+            if tag == TAG_ABORT:
+                origin, cause = heartbeat.decode_abort(payload)
+                raise _abort_error(origin, cause, resolved=True)
+            if tag != TAG_REQUESTS:
+                raise ConnectionError(
+                    f"expected tag {TAG_REQUESTS} from rank "
+                    f"{ranks[idx]}, got {tag}")
+            # Classic fallback: rank-indexed frames — absorbed steady
+            # frames re-serialize from scratch, the deviant frame rides
+            # as-is, everyone still owed delivers classically.
+            out = [b""] * self._size
+            out[0] = plan.frame_bytes(bufs)
+            out[ranks[idx]] = payload
+            try:
+                for i, r in enumerate(ranks):
+                    if done[i]:
+                        out[r] = _steady.peer_frame_bytes(
+                            plan, peer_views[i])
+                    elif i != idx:
+                        out[r] = self._recv_ctrl(r, self._channels[r],
+                                                 TAG_REQUESTS)
+            except WorldAbortedError:
+                raise
+            except (ConnectionError, OSError) as e:
+                self._raise_transport(e)
+            return ("fallback", out)
+        rc, done = val
+        if rc == _steady.ETIMEDOUT:
+            waiting = [ranks[i] for i in range(len(ranks))
+                       if not done[i]]
+            raise _abort_error(
+                waiting[0] if waiting else -1,
+                f"no control frame from rank(s) {waiting} for "
+                f"{self._hb_timeout:g}s — peer presumed dead "
+                f"(heartbeat timeout; raise HOROVOD_HEARTBEAT_TIMEOUT "
+                f"if peers legitimately stall longer)")
+        self._raise_transport(ConnectionError(
+            f"native steady cycle failed: errno {-rc}"))
 
     def worker_peer_ip(self, rank: int) -> str:
         """IP of worker ``rank`` as seen from this coordinator — the
@@ -1486,6 +1752,141 @@ class TcpWorker(Controller):
             assert mine is not None
             return mine
         return data
+
+    def _recv_up_into(self, out, expect_tag: int) -> int:
+        """Recv-into mirror of _recv_up: the payload lands straight in
+        ``out``; PINGs relay downward and ABORT raises, exactly like
+        the bytes path. Out-of-band frames bigger than ``out`` arrive
+        via the spill (a PING can exceed a 0-byte scatter slice), so
+        liveness and abort semantics hold at ANY destination size."""
+        view = memoryview(network.as_byte_view(out))
+        while True:
+            try:
+                tag, n, spill = self._ch.recv_into_spill(view)
+            except WorldAbortedError:
+                raise
+            except (ConnectionError, OSError) as e:
+                raise _abort_error(
+                    self._up_rank,
+                    f"control channel to {self._ch.peer} failed: {e}") \
+                    from e
+            if self._metrics_on:
+                self._up_seen = time.monotonic()
+            if tag == TAG_PING:
+                self._relay_children_safe(
+                    spill if spill is not None else bytes(view[:n]),
+                    TAG_PING)
+                continue
+            if tag == TAG_METRICS:
+                continue  # metrics only flow upward; tolerate strays
+            if tag == TAG_ABORT:
+                data = spill if spill is not None else bytes(view[:n])
+                origin, cause = heartbeat.decode_abort(data)
+                self._relay_children_safe(data, TAG_ABORT)
+                raise _abort_error(origin, cause, resolved=True)
+            if tag != expect_tag:
+                raise ConnectionError(
+                    f"expected tag {expect_tag} from {self._ch.peer}, "
+                    f"got {tag}")
+            if spill is not None:
+                raise ConnectionError(
+                    f"frame of {n} bytes from {self._ch.peer} "
+                    f"overflows {len(view)}-byte buffer")
+            if self._metrics_on:
+                self._m_ctrl_rx.inc(n)
+            return n
+
+    def gather_data_into(self, payload, outs) -> Optional[List[int]]:
+        self._gather_up(_as_buffer(payload), TAG_DATA)
+        return None
+
+    def broadcast_data_into(self, payload, out,
+                            root_rank: int = 0) -> int:
+        if payload is not None and self.rank == root_rank:
+            payload = _as_buffer(payload)
+            self._send_up(payload, TAG_DATA)
+            self._send_children(payload, TAG_DATA)
+            return len(payload)
+        if root_rank in self._children:
+            data = self._recv_child(root_rank, TAG_DATA)
+            self._send_up(data, TAG_DATA)
+            self._send_children(data, TAG_DATA, exclude_rank=root_rank)
+            mv = memoryview(network.as_byte_view(out))
+            mv[:len(data)] = data
+            return len(data)
+        n = self._recv_up_into(out, TAG_DATA)
+        if self._children:
+            self._send_children(
+                memoryview(network.as_byte_view(out))[:n], TAG_DATA)
+        return n
+
+    def scatter_data_into(self, payloads, out) -> int:
+        if self._children:
+            # A local root must unpack the aggregate to relay each
+            # leaf's slice — the classic path with one copy out.
+            data = self.scatter_data(payloads)
+            mv = memoryview(network.as_byte_view(out))
+            mv[:len(data)] = data
+            return len(data)
+        return self._recv_up_into(out, TAG_DATA)
+
+    # -- native steady cycle ---------------------------------------------
+    def steady_native_ready(self) -> bool:
+        if self._children:
+            return False
+        from horovod_tpu import native as _native
+        return _native.get() is not None
+
+    def steady_spec_cycle(self, plan, bufs):
+        from horovod_tpu import native as _native
+        from horovod_tpu.common import steady as _steady
+        lib = _native.get()
+        if lib is None or self._children or not plan.native_ok:
+            return None
+        try:
+            fd = self._ch.sock.fileno()
+        except OSError:
+            fd = -1
+        if fd < 0:
+            raise _abort_error(
+                self._up_rank,
+                f"control channel to {self._ch.peer} closed before "
+                f"the steady cycle")
+        kind, val = _steady.run_worker_cycle(
+            lib, plan, fd, self._ch.secret, bufs,
+            bytes((TAG_PING, TAG_METRICS)), TAG_REQUESTS,
+            TAG_RESPONSES, self._ch._hb)
+        if self._metrics_on:
+            self._up_seen = time.monotonic()
+        if kind == _steady.DONE:
+            if self._metrics_on:
+                self._m_ctrl_tx.inc(plan.payload_nbytes)
+                self._m_ctrl_rx.inc(plan.payload_nbytes)
+            return ("done", val)
+        if kind == _steady.FRAME:
+            tag, payload = val
+            if tag == TAG_ABORT:
+                origin, cause = heartbeat.decode_abort(payload)
+                raise _abort_error(origin, cause, resolved=True)
+            if tag != TAG_RESPONSES:
+                raise ConnectionError(
+                    f"expected tag {TAG_RESPONSES} from "
+                    f"{self._ch.peer}, got {tag}")
+            if self._metrics_on:
+                self._m_ctrl_rx.inc(len(payload))
+            return ("frame", payload)
+        rc = val
+        if rc == _steady.ETIMEDOUT:
+            raise _abort_error(
+                self._up_rank,
+                f"no data from {self._ch.peer} for "
+                f"{self._hb_timeout:g}s — peer presumed dead "
+                f"(heartbeat timeout; raise HOROVOD_HEARTBEAT_TIMEOUT "
+                f"if peers legitimately stall longer)")
+        raise _abort_error(
+            self._up_rank,
+            f"control channel to {self._ch.peer} failed during the "
+            f"steady cycle: errno {-rc}")
 
     def abort(self, origin_rank: int, cause: str) -> None:
         payload = heartbeat.encode_abort(origin_rank, cause)

@@ -139,6 +139,9 @@ class Channel:
                 peer = "peer"
         self.peer = peer
         self._hb = None
+        # ctypes copy of the (immutable) secret for the native wire
+        # paths, built once — not per frame.
+        self._c_secret = None
         # Don't batch small frames; collectives are latency-sensitive.
         # (No-op on non-TCP sockets, e.g. AF_UNIX socketpairs in tests.)
         try:
@@ -199,24 +202,68 @@ class Channel:
     def send(self, payload, tag: int = 0) -> None:
         """``payload`` is any C-contiguous buffer (bytes, bytearray,
         memoryview, numpy array) — large buffers are written straight
-        from their memory, never copied into a bytes object."""
-        payload = as_byte_view(payload)
-        n = len(payload)
-        hdr = _HDR.pack(n, tag)
+        from their memory, never copied into a bytes object. One
+        framing implementation: a single-part vectored send."""
+        self.sendv((payload,), tag)
+
+    def sendv(self, parts, tag: int = 0) -> None:
+        """Vectored framed send — THE framing implementation every
+        outbound frame uses: ``parts`` (C-contiguous buffers) ship as
+        ONE frame without ever being concatenated. Above the inline
+        threshold the native core sends header + HMAC + all parts in
+        ONE sendmsg(2) with the GIL released (hvd_sendv); below it the
+        whole frame goes out as one small sendall. The bytes on the
+        wire are identical on every path."""
+        views = [as_byte_view(p) for p in parts]
+        total = sum(len(v) for v in views)
+        if total > _INLINE_SEND and self._sendv_native(views, total,
+                                                      tag):
+            return
+        hdr = _HDR.pack(total, tag)
         if self.secret:
             h = hmac.new(self.secret, bytes((tag,)), hashlib.sha256)
-            h.update(payload)
-            digest = h.digest()
-            if n <= _INLINE_SEND:
-                self.sock.sendall(b"".join((hdr, digest, payload)))
-            else:
-                self.sock.sendall(hdr + digest)
-                self.sock.sendall(payload)
-        elif n <= _INLINE_SEND:
-            self.sock.sendall(b"".join((hdr, payload)))
+            for v in views:
+                h.update(v)
+            head = hdr + h.digest()
         else:
-            self.sock.sendall(hdr)
-            self.sock.sendall(payload)
+            head = hdr
+        if total <= _INLINE_SEND:
+            # Small frames (control plane) in one packet-sized write.
+            self.sock.sendall(b"".join([head, *views]))
+            return
+        self.sock.sendall(head)
+        for v in views:
+            if len(v):
+                self.sock.sendall(v)
+
+    def _sendv_native(self, views, total: int, tag: int) -> bool:
+        """One-sendmsg frame write via hvd_sendv; False => caller uses
+        the Python path (no native core, or an exotic buffer)."""
+        from horovod_tpu import native as _native
+        lib = _native.get()
+        if lib is None:
+            return False
+        import ctypes
+        import numpy as np
+        n = len(views)
+        ptrs = (ctypes.c_void_p * n)()
+        lens = (ctypes.c_int64 * n)()
+        keep = []  # hold the zero-copy wrappers behind the pointers
+        for i, v in enumerate(views):
+            ln = len(v)
+            lens[i] = ln
+            if ln == 0:
+                ptrs[i] = None
+                continue
+            arr = np.frombuffer(v, np.uint8)  # zero-copy address probe
+            keep.append(arr)
+            ptrs[i] = arr.ctypes.data
+        rc = lib.hvd_sendv(self.sock.fileno(), tag, ptrs, lens, n,
+                           self._secret_buf(), len(self.secret or b""))
+        if rc != 0:
+            raise ConnectionError(
+                f"send to {self.peer} failed: errno {-rc}")
+        return True
 
     def recv(self) -> Tuple[int, bytes]:
         who, hb = self.peer, self._hb
@@ -236,16 +283,51 @@ class Channel:
 
     def recv_into(self, buf) -> Tuple[int, int]:
         """Receive one frame directly into a writable buffer (zero-copy
-        data-plane path; ops/ring.py). The frame must fit exactly or be
-        smaller. Returns (tag, payload_nbytes)."""
+        data-plane path; ops/ring.py, the controller *_into
+        primitives). The frame must fit exactly or be smaller. Returns
+        (tag, payload_nbytes)."""
+        tag, n, spill = self.recv_into_spill(buf)
+        if spill is not None:
+            raise ConnectionError(
+                f"frame of {n} bytes from {self.peer} overflows "
+                f"{len(as_byte_view(buf))}-byte buffer")
+        return tag, n
+
+    def recv_into_spill(self, buf):
+        """Like :meth:`recv_into`, but a frame LARGER than ``buf``
+        comes back whole as bytes instead of raising: returns
+        (tag, payload_nbytes, spill) with ``spill`` None when the
+        payload landed in ``buf``. The controller *_into primitives
+        need this: out-of-band frames (PING/METRICS/ABORT) share the
+        channel with data payloads and may exceed the preallocated
+        destination — an ABORT notice in particular must survive to
+        be decoded, not die as an overflow error."""
         who, hb = self.peer, self._hb
+        if hb is None or hb[2] is None:
+            # No idle beacon to run from Python: the whole recv
+            # (header, digest, payload, HMAC check) can run in ONE
+            # native call with the GIL released. With an on_idle
+            # callback armed (coordinator channels PING per idle
+            # slice), stay on the sliced Python path.
+            res = self._recv_into_native(buf, who, hb)
+            if res is not None:
+                return res
         hdr = _recv_exact(self.sock, _HDR.size, who, hb)
         n, tag = _HDR.unpack(hdr)
         view = memoryview(as_byte_view(buf))
         if n > len(view):
-            raise ConnectionError(
-                f"frame of {n} bytes from {who} overflows "
-                f"{len(view)}-byte buffer")
+            if self.secret:
+                digest = _recv_exact(self.sock, _DIGEST_LEN, who, hb)
+                payload = _recv_exact(self.sock, n, who, hb)
+                h = hmac.new(self.secret, bytes((tag,)) + payload,
+                             hashlib.sha256)
+                if not hmac.compare_digest(digest, h.digest()):
+                    raise ConnectionError(
+                        f"HMAC authentication failed for frame from "
+                        f"{who}")
+            else:
+                payload = _recv_exact(self.sock, n, who, hb)
+            return tag, n, payload
         if self.secret:
             digest = _recv_exact(self.sock, _DIGEST_LEN, who, hb)
             _recv_exact_into(self.sock, view[:n], who, hb)
@@ -256,7 +338,70 @@ class Channel:
                     f"HMAC authentication failed for frame from {who}")
         else:
             _recv_exact_into(self.sock, view[:n], who, hb)
-        return tag, n
+        return tag, n, None
+
+    def _secret_buf(self):
+        """ctypes u8 buffer of the channel secret, built once (the
+        secret is immutable for the channel's lifetime)."""
+        if self._c_secret is None:
+            import ctypes
+            secret = self.secret or b""
+            self._c_secret = (
+                ctypes.c_uint8 * max(1, len(secret))).from_buffer_copy(
+                secret or b"\x00")
+        return self._c_secret
+
+    def _recv_into_native(self, buf, who: str, hb):
+        """hvd_recv_into fast path for :meth:`recv_into`; None =>
+        caller runs the Python path. Error messages mirror the Python
+        path's so failure handling stays uniform."""
+        from horovod_tpu import native as _native
+        lib = _native.get()
+        if lib is None:
+            return None
+        import ctypes
+        import numpy as np
+        view = as_byte_view(buf)
+        cap = len(view)
+        arr = np.frombuffer(view, np.uint8) if cap else None
+        secret = self.secret or b""
+        sec = self._secret_buf()
+        if hb is None:
+            timeout_ms = interval_ms = -1
+        else:
+            timeout_ms = max(1, int(hb[0] * 1000))
+            interval_ms = max(1, int(hb[1] * 1000))
+        out_len = ctypes.c_int64()
+        out_tag = ctypes.c_uint8()
+        spill = ctypes.POINTER(ctypes.c_uint8)()
+        rc = lib.hvd_recv_into(
+            self.sock.fileno(), sec, len(secret),
+            arr.ctypes.data if arr is not None else None, cap,
+            None, 0, ctypes.byref(out_len), ctypes.byref(out_tag),
+            timeout_ms, interval_ms, ctypes.byref(spill))
+        if rc == 0:
+            return out_tag.value, out_len.value, None
+        if rc == 1:
+            try:
+                payload = ctypes.string_at(spill, out_len.value)
+            finally:
+                lib.hvd_free(spill)
+            return out_tag.value, out_len.value, payload
+        import errno as _errno
+        if rc == -_errno.ETIMEDOUT:
+            raise ConnectionError(
+                f"no data from {who} for {hb[0]:.0f}s — peer presumed "
+                f"dead (heartbeat timeout {hb[0]:g}s; raise "
+                f"HOROVOD_HEARTBEAT_TIMEOUT if peers legitimately "
+                f"stall longer)")
+        if rc == -_errno.EBADMSG:
+            raise ConnectionError(
+                f"HMAC authentication failed for frame from {who}")
+        if rc == -_errno.ECONNRESET:
+            raise ConnectionError(
+                f"connection to {who} closed while reading")
+        raise ConnectionError(
+            f"recv from {who} failed: errno {-rc}")
 
     def close(self) -> None:
         try:

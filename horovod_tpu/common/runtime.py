@@ -25,10 +25,12 @@ import time
 from collections import OrderedDict
 from typing import Dict, List, Optional
 
+from horovod_tpu.common import arena as harena
 from horovod_tpu.common import faults
 from horovod_tpu.common import lockdep
 from horovod_tpu.common import logging as hlog
 from horovod_tpu.common import metrics as hmetrics
+from horovod_tpu.common import steady as hsteady
 from horovod_tpu.common import wire
 from horovod_tpu.common.config import Config
 from horovod_tpu.common.controller import Controller
@@ -198,6 +200,27 @@ class Runtime:
         # the spec frame in flight this cycle (build->apply, bg thread
         # only); None when the current cycle is not speculative.
         self._spec_inflight = None
+        # Zero-copy native data plane (HOROVOD_TPU_ZERO_COPY,
+        # common/steady.py): steady speculative cycles run as ONE
+        # native call — pack into the persistent fusion arena, send
+        # mask + fused payload via sendmsg, reduce in C, receive the
+        # world result straight into a fresh per-step buffer. Only
+        # engaged when the controller sits on a flat tier of the
+        # control tree AND the native core is loaded; every deviation
+        # falls back to the classic PR 3 path for that cycle, and the
+        # wire format is byte-identical either way, so mixed
+        # native/pure-Python worlds interoperate frame-for-frame.
+        self._steady_native = (config.zero_copy
+                               and self._spec_enabled
+                               and controller.steady_native_ready())
+        self._send_arena = harena.FusionArena()
+        # (mask, threshold) -> SteadyPlan, valid for one cache epoch.
+        self._steady_plans: Dict[tuple, hsteady.SteadyPlan] = {}
+        self._steady_plan_epoch = -1
+        # (plan, packed buffers) for the native cycle in flight this
+        # step (build->cycle, bg thread only).
+        self._spec_steady = None
+        self._native_steady_cycles = 0
         self._spec_cycles = 0  # cycles completed via the fused round
         self._spec_bids = 0    # speculative frames sent (observability)
         # Hits the last cycle bid but the world did not grant, now
@@ -231,6 +254,16 @@ class Runtime:
             "single-round fused speculative cycles completed")
         self._m_spec_bids = reg.counter("hvd_spec_bids_total")
         self._m_spec_denials = reg.counter("hvd_spec_denials_total")
+        self._m_native_steady = reg.counter(
+            "hvd_native_steady_cycles_total",
+            "steady steps completed by the one-call native data plane")
+        self._m_arena_bytes = reg.gauge(
+            "hvd_arena_bytes",
+            "capacity of the persistent fusion arenas on this rank")
+        self._m_data_copies = reg.counter(
+            "hvd_data_copies_total",
+            "payload byte-object copies on fallback data paths "
+            "(0 while the zero-copy plane is engaged)")
         self._m_cache_hits = reg.counter("hvd_cache_hits_total")
         self._m_cache_misses = reg.counter("hvd_cache_misses_total")
         self._m_cache_evictions = reg.counter(
@@ -673,17 +706,23 @@ class Runtime:
             self._wake.wait(remaining)
 
     def _build_spec_frame(self, hit_mask: int):
-        """Serialize a fused speculative cycle frame: the pure-hit
-        bitmask PLUS this rank's pre-packed fused allreduce buffers in
+        """Build a fused speculative cycle frame: the pure-hit bitmask
+        PLUS this rank's pre-packed fused allreduce buffers in
         replay-plan order, or None when the batch is not speculation-
         eligible (non-allreduce entries in the steady set, a data
         plane of its own — shm/ring/XLA — would carry it, or an entry
         vanished). Entries are only PEEKED: the world may still deny
-        the grant, in which case the classic path pops them later."""
+        the grant, in which case the classic path pops them later.
+
+        With the zero-copy plane engaged, the return value is a
+        SteadyPlan (packed into the persistent fusion arena; the
+        cycle then runs as one native call) instead of serialized
+        bytes — _run_loop_once dispatches on the type."""
         from horovod_tpu.ops.socket_ops import _pack_fused, _to_numpy
         cache = self._cache
         plan = self._replay_plan(hit_mask, self._world_fusion_threshold)
-        segments = []
+        seg_arrays = []
+        prescales = []
         inflight = []
         for resp in plan:
             if resp.response_type != ResponseType.ALLREDUCE:
@@ -699,15 +738,96 @@ class Runtime:
             if not backend.fused_cycle_reducible(
                     sum(a.nbytes for a in arrays)):
                 return None
+            seg_arrays.append(arrays)
+            prescales.append(resp.prescale_factor)
+            inflight.append((resp, entries, arrays))
+        if self._steady_native:
+            splan = self._steady_plan_for(hit_mask, seg_arrays)
+            if splan is not None:
+                # Coordinator accumulators double as the broadcast
+                # result its outputs will alias — fresh, never arena.
+                bufs = splan.pack(
+                    seg_arrays, prescales,
+                    use_arena=not self.controller.is_coordinator)
+                self._spec_inflight = inflight
+                self._spec_steady = (splan, bufs)
+                self._spec_bids += 1
+                return splan
+        segments = []
+        for (resp, _, arrays) in inflight:
             fused, _ = _pack_fused(arrays, resp)  # applies prescale
             segments.append((numpy_dtype_to_datatype(fused.dtype),
                              fused))
-            inflight.append((resp, entries, arrays))
         self._spec_inflight = inflight
         self._spec_bids += 1
         return wire.serialize_cycle_request(CacheCycleRequest(
             epoch=cache.epoch, nslots=cache.nslots, hit_mask=hit_mask,
             spec_payload=segments))
+
+    def _steady_plan_for(self, hit_mask: int, seg_arrays):
+        """Memoized SteadyPlan for (mask, threshold) at the current
+        cache epoch; None when a segment's dtype has no native reduce
+        kernel (the classic path carries it)."""
+        cache = self._cache
+        if self._steady_plan_epoch != cache.epoch:
+            self._steady_plans.clear()
+            self._steady_plan_epoch = cache.epoch
+        key = (hit_mask, self._world_fusion_threshold)
+        splan = self._steady_plans.get(key)
+        if splan is None:
+            segments = []
+            for arrays in seg_arrays:
+                dtype = arrays[0].dtype
+                if any(a.dtype != dtype for a in arrays):
+                    return None
+                segments.append((numpy_dtype_to_datatype(dtype), dtype,
+                                 sum(a.nbytes for a in arrays)))
+            splan = hsteady.SteadyPlan(cache.epoch, cache.nslots,
+                                       hit_mask, segments,
+                                       self._send_arena)
+            if len(self._steady_plans) >= 64:
+                self._steady_plans.clear()
+            self._steady_plans[key] = splan
+        return splan if splan.native_ok else None
+
+    def _native_steady_cycle(self, splan) -> CacheCycleResponse:
+        """Drive one zero-copy steady cycle and normalize every
+        outcome to the CacheCycleResponse the classic apply path
+        consumes. Deviations resume the classic protocol mid-flight:
+        the request frame is already on the wire (byte-identical to
+        the serialized classic frame), so only the response half
+        replays."""
+        ctl = self.controller
+        _, bufs = self._spec_steady
+        self._spec_steady = None
+        outcome = ctl.steady_spec_cycle(splan, bufs)
+        if outcome is None:
+            # Support probe raced (e.g. library refused at call time):
+            # run the cycle classically from the serialized frame.
+            payload = splan.frame_bytes(bufs)
+            gathered = ctl.gather_requests(payload)
+            if ctl.is_coordinator:
+                reply, meta = self._coordinate_cycle(gathered)
+                ctl.broadcast_responses(reply)
+            else:
+                meta = wire.parse_cycle_response(
+                    ctl.broadcast_responses(None))
+            return meta
+        kind, val = outcome
+        if kind == "done":
+            self._native_steady_cycles += 1
+            if ctl.is_coordinator:
+                self.timeline.negotiate_cached(fused=True)
+                self._check_stall(self._message_table, ctl.size)
+            return CacheCycleResponse(
+                epoch=splan.epoch, nslots=splan.nslots,
+                grant_mask=splan.mask, spec_payload=val)
+        if kind == "frame":
+            return wire.parse_cycle_response(val)
+        assert kind == "fallback"
+        reply, meta = self._coordinate_cycle(val)
+        ctl.broadcast_responses(reply)
+        return meta
 
     def _record_signature(self, req: Request) -> None:
         if req.request_type not in CACHEABLE_REQUESTS:
@@ -748,13 +868,18 @@ class Runtime:
 
         if self._metrics_on:
             tn = time.monotonic()
-        gathered = self.controller.gather_requests(payload)
-        if self.controller.is_coordinator:
-            reply, meta = self._coordinate_cycle(gathered)
-            self.controller.broadcast_responses(reply)
+        if isinstance(payload, hsteady.SteadyPlan):
+            # Zero-copy steady step: negotiation + data plane in ONE
+            # native call (deviations rejoin the classic path inside).
+            meta = self._native_steady_cycle(payload)
         else:
-            data = self.controller.broadcast_responses(None)
-            meta = wire.parse_cycle_response(data)
+            gathered = self.controller.gather_requests(payload)
+            if self.controller.is_coordinator:
+                reply, meta = self._coordinate_cycle(gathered)
+                self.controller.broadcast_responses(reply)
+            else:
+                data = self.controller.broadcast_responses(None)
+                meta = wire.parse_cycle_response(data)
         if self._metrics_on:
             self._m_negotiation_s.observe(time.monotonic() - tn)
 
@@ -1158,10 +1283,19 @@ class Runtime:
                     sum(a.nbytes for a in arrays))
             names = resp.tensor_names
             popped = self.tensor_table.pop_entries(names)
-            # bytearray: callers receive writable tensors, never views
-            # over the recv buffer (same contract as the star plane).
-            result = np.frombuffer(bytearray(buf),
-                                   dtype=datatype_to_numpy_dtype(dt))
+            if isinstance(buf, np.ndarray):
+                # Zero-copy plane: the native cycle received the world
+                # result into a FRESH writable per-step buffer (never
+                # arena memory), so outputs may alias it directly.
+                result = buf
+            else:
+                # Classic frame: a memoryview over the immutable recv
+                # bytes — one defensive copy buys writable outputs
+                # (the contract of the star plane), and the counter
+                # records that the fallback path is carrying traffic.
+                self._m_data_copies.inc()
+                result = np.frombuffer(bytearray(buf),
+                                       dtype=datatype_to_numpy_dtype(dt))
             op_name = resp.response_type.name
             if timeline_on:
                 for n in names:
@@ -1256,6 +1390,8 @@ class Runtime:
         self._m_spec_cycles.set_total(self._spec_cycles)
         self._m_spec_bids.set_total(self._spec_bids)
         self._m_spec_denials.set_total(self._spec_denials_total)
+        self._m_native_steady.set_total(self._native_steady_cycles)
+        self._m_arena_bytes.set(harena.total_bytes())
         self._m_queue_depth.set(len(self.tensor_table))
         self._m_lock_inversions.set_total(lockdep.inversion_count())
         for r, age in self.controller.peer_heartbeat_ages().items():
@@ -1331,6 +1467,7 @@ class Runtime:
                 "cached_cycles": self._cached_cycles,
                 "spec_cycles": self._spec_cycles,
                 "spec_bids": self._spec_bids,
+                "native_steady_cycles": self._native_steady_cycles,
                 "epoch": c.epoch}
 
     def _cache_stats_line(self) -> str:
@@ -1340,7 +1477,8 @@ class Runtime:
         return (f"cache: {s['hits']} hits / {s['misses']} misses "
                 f"({s['hit_rate']:.1%} hit rate), "
                 f"{s['cached_cycles']} fully cached cycles "
-                f"({s['spec_cycles']} fused single-round), "
+                f"({s['spec_cycles']} fused single-round, "
+                f"{s['native_steady_cycles']} native zero-copy), "
                 f"{s['entries']}/{s['capacity']} slots")
 
     def _check_stall(self, table: MessageTable, size: int) -> None:

@@ -339,14 +339,42 @@ def _read_mask(r: _Reader, nslots: int) -> int:
     return mask
 
 
+def _seg_hdr(dt, nbytes: int) -> bytes:
+    """The constant 9-byte header in front of one raw segment."""
+    return _U8.pack(int(dt)) + _I64.pack(nbytes)
+
+
+def spec_frame_parts(epoch: int, nslots: int, mask: int, seg_meta):
+    """(prefix, [seg_hdr, ...]): the CONSTANT byte regions of a
+    CACHED_SPEC cycle frame — everything except the raw segment data.
+    ``seg_meta`` is [(DataType, nbytes), ...]. This is THE single
+    source of the speculative layout: serialize_cycle_request/response
+    build their spec frames from these parts, and the native steady
+    cycle (native/hvdtpu.cc hvd_steady_worker/coord) sends and
+    byte-compares exactly these regions around fusion-arena pointers —
+    so a native rank and a pure-Python rank can never drift apart on
+    the wire. Request and response share one shape because a granted
+    steady cycle's grant_mask IS the bid's hit_mask."""
+    w = _Writer()
+    w.u8(FRAME_CACHED_SPEC)
+    w.i64(epoch)
+    w.u32(nslots)
+    _write_mask(w, mask, nslots)
+    w.u32(len(seg_meta))
+    return w.bytes(), [_seg_hdr(dt, nbytes) for dt, nbytes in seg_meta]
+
+
 def _write_segments(w: _Writer, segments) -> None:
     """[(DataType, buffer), ...] — buffers are any contiguous
-    bytes-like (numpy arrays ride as zero-copy memoryviews)."""
+    bytes-like (numpy arrays ride as zero-copy byte views; extension
+    dtypes such as bfloat16 are handled by as_byte_view)."""
+    from horovod_tpu.common.network import as_byte_view
     w.u32(len(segments))
     for dt, buf in segments:
-        view = memoryview(buf).cast("B")
-        w.u8(int(dt))
-        w.i64(view.nbytes)
+        view = as_byte_view(buf)
+        n = len(view) if isinstance(view, (bytes, bytearray)) \
+            else view.nbytes
+        w.parts.append(_seg_hdr(dt, n))
         w.parts.append(view)
 
 

@@ -29,6 +29,26 @@ _NATIVE_DIR = os.path.join(
 _SO_PATH = os.path.join(_NATIVE_DIR, "libhvdtpu.so")
 _SRC_PATH = os.path.join(_NATIVE_DIR, "hvdtpu.cc")
 
+# Idle-slice callback type for hvd_steady_coord (the coordinator's
+# PING fan-out re-enters Python once per idle poll slice). Module
+# level so _configure and callers share one ctypes identity — a
+# per-call CFUNCTYPE would defeat argtype checking AND risk the
+# callback being garbage-collected mid-call.
+ON_IDLE_FUNC = ctypes.CFUNCTYPE(None)
+
+
+def disabled_via_env() -> bool:
+    """The one definition of 'native core disabled by the operator'.
+    Two spellings for compatibility: HOROVOD_NATIVE (docs) and
+    HOROVOD_TPU_NATIVE (Config.native_core, common/config.py). Exact
+    legacy truthiness on purpose (only these values disable) —
+    env_bool's narrower truthy set would silently drop the C++ core
+    for e.g. HOROVOD_NATIVE=ON deployments. Shared by get() and the
+    CI gate (tests/conftest.py), so the two can never drift."""
+    return (hconfig.env_str("HOROVOD_NATIVE", "1") == "0"
+            or hconfig.env_str("HOROVOD_TPU_NATIVE", "1")
+            in ("0", "false"))
+
 
 def _build() -> bool:
     if not os.path.isdir(_NATIVE_DIR):
@@ -87,6 +107,46 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.hvd_hmac_sha256.restype = None
     lib.hvd_hmac_sha256.argtypes = [
         u8p, ctypes.c_int, ctypes.c_uint8, u8p, ctypes.c_int64, u8p]
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    vpp = ctypes.POINTER(ctypes.c_void_p)
+    u8pp = ctypes.POINTER(u8p)
+    lib.hvd_sendv.restype = ctypes.c_int
+    lib.hvd_sendv.argtypes = [
+        ctypes.c_int, ctypes.c_uint8, vpp, i64p, ctypes.c_int,
+        u8p, ctypes.c_int]
+    lib.hvd_recv_into.restype = ctypes.c_int
+    lib.hvd_recv_into.argtypes = [
+        ctypes.c_int, u8p, ctypes.c_int,
+        ctypes.c_void_p, ctypes.c_int64,
+        u8p, ctypes.c_int,
+        i64p, u8p,
+        ctypes.c_int, ctypes.c_int,
+        u8pp]
+    lib.hvd_steady_worker.restype = ctypes.c_int
+    lib.hvd_steady_worker.argtypes = [
+        ctypes.c_int, ctypes.c_uint8, ctypes.c_uint8,
+        u8p, ctypes.c_int64,
+        u8pp, i64p,
+        vpp, vpp,
+        i64p, ctypes.c_int,
+        u8p, ctypes.c_int,
+        u8p, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int,
+        u8pp, i64p, u8p]
+    lib.hvd_steady_coord.restype = ctypes.c_int
+    lib.hvd_steady_coord.argtypes = [
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ctypes.c_uint8, ctypes.c_uint8,
+        u8p, ctypes.c_int64,
+        u8pp, i64p,
+        i64p, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        u8pp, vpp,
+        u8p, ctypes.c_int,
+        u8p, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int,
+        ON_IDLE_FUNC,
+        u8p,
+        ctypes.POINTER(ctypes.c_int), u8pp, i64p, u8p]
 
 
 def get() -> Optional[ctypes.CDLL]:
@@ -98,14 +158,7 @@ def get() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        # Two spellings for compatibility: HOROVOD_NATIVE (docs) and
-        # HOROVOD_TPU_NATIVE (Config.native_core, common/config.py).
-        # Exact legacy truthiness on purpose (only these values
-        # disable) — env_bool's narrower truthy set would silently
-        # drop the C++ core for e.g. HOROVOD_NATIVE=ON deployments.
-        if hconfig.env_str("HOROVOD_NATIVE", "1") == "0" or \
-                hconfig.env_str("HOROVOD_TPU_NATIVE", "1") \
-                in ("0", "false"):
+        if disabled_via_env():
             return None
         stale = (os.path.exists(_SO_PATH)
                  and os.path.exists(_SRC_PATH)
@@ -157,6 +210,76 @@ def pack(arrays):
     out = np.empty(total, dtype)
     lib.hvd_pack(srcs, sizes, n, out.ctypes.data_as(ctypes.c_void_p))
     return out
+
+
+def pack_into(arrays, out) -> bool:
+    """Concatenate same-dtype C-contiguous flat arrays into ``out``
+    (a preallocated writable array/view of exactly the packed size)
+    with ONE native call — the zero-allocation fusion-arena pack of
+    the steady data plane. Returns False when the native path cannot
+    serve this batch (caller falls back to per-entry numpy copies)."""
+    lib = get()
+    if lib is None or not arrays:
+        return False
+    dtype = arrays[0].dtype
+    total = 0
+    for a in arrays:
+        if a.dtype != dtype or not a.flags["C_CONTIGUOUS"]:
+            return False
+        total += a.nbytes
+    if total != out.nbytes or not out.flags["C_CONTIGUOUS"]:
+        return False
+    n = len(arrays)
+    srcs = (ctypes.c_void_p * n)(*[a.ctypes.data for a in arrays])
+    sizes = (ctypes.c_int64 * n)(*[a.nbytes for a in arrays])
+    lib.hvd_pack(srcs, sizes, n, out.ctypes.data_as(ctypes.c_void_p))
+    return True
+
+
+def unpack_into(src, outs) -> bool:
+    """Scatter a packed buffer into preallocated per-entry arrays with
+    one native call (the fusion-buffer MemcpyOut without intermediate
+    byte objects). ``src`` must be C-contiguous and exactly the
+    concatenation of ``outs``. Returns False on fallback."""
+    lib = get()
+    if lib is None or not outs:
+        return False
+    total = 0
+    for o in outs:
+        if not o.flags["C_CONTIGUOUS"] or not o.flags["WRITEABLE"]:
+            return False
+        total += o.nbytes
+    if total != src.nbytes or not src.flags["C_CONTIGUOUS"]:
+        return False
+    n = len(outs)
+    dsts = (ctypes.c_void_p * n)(*[o.ctypes.data for o in outs])
+    sizes = (ctypes.c_int64 * n)(*[o.nbytes for o in outs])
+    lib.hvd_unpack(src.ctypes.data_as(ctypes.c_void_p), sizes, n, dsts)
+    return True
+
+
+def compiler_available() -> bool:
+    """True when a C++ compiler the Makefile can drive is on PATH —
+    the tier-1 gate between 'fail the build loudly' and 'skip native
+    tests with a reason'."""
+    import shutil
+    return any(shutil.which(c) for c in ("g++", "c++", "clang++"))
+
+
+def build_status():
+    """(loaded, reason) for CI plumbing: attempt the normal get() path
+    and explain a None result. Used by tests/conftest.py to build the
+    library once up front and fail LOUDLY when a compiler exists but
+    the build is broken (a silent skip would unhook every native test
+    from CI forever)."""
+    lib = get()
+    if lib is not None:
+        return True, ""
+    if disabled_via_env():
+        return False, "disabled via HOROVOD_NATIVE/HOROVOD_TPU_NATIVE"
+    if not compiler_available():
+        return False, "no C++ compiler on PATH"
+    return False, "build or load failed with a compiler present"
 
 
 def sum_into(acc, src) -> bool:

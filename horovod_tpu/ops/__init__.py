@@ -187,6 +187,11 @@ def grouped_allreduce_async(tensors, average: Optional[bool] = None,
     if resolved_op == Average:
         post = post / basics.size()
 
+    # Scaling only matters under Average/non-unit factors — hoisting
+    # the gate keeps the steady Sum path (DDP-style gradient buckets)
+    # from paying a per-tensor dtype probe.
+    check_scale = (resolved_op == Average or prescale_factor != 1.0
+                   or postscale_factor != 1.0)
     inspected = []
     for t in tensors:
         # Unsupported payloads AND unsupported dtypes must raise before
@@ -194,8 +199,9 @@ def grouped_allreduce_async(tensors, average: Optional[bool] = None,
         # would reject later, so run it here too (e.g. complex64).
         payload, ctx, device, np_dtype, shape, ready_fn = _inspect(t)
         dtype = numpy_dtype_to_datatype(np_dtype)
-        _check_scalable_dtype(t, resolved_op, prescale_factor,
-                              postscale_factor, "grouped_allreduce")
+        if check_scale:
+            _check_scalable_dtype(t, resolved_op, prescale_factor,
+                                  postscale_factor, "grouped_allreduce")
         inspected.append((payload, ctx, device, dtype, shape, ready_fn))
 
     rt = basics.runtime()

@@ -58,8 +58,8 @@ from horovod_tpu.common.timeline import (
 )
 from horovod_tpu.ops.backend import CollectiveBackend
 from horovod_tpu.ops.socket_ops import (
-    _allgather_layout, _pack_flat, _pack_fused, _restore,
-    _to_numpy, _unpack_allgather, _unpack_fused,
+    _allgather_layout, _np_from_bytes, _pack_flat, _pack_fused,
+    _restore, _to_numpy, _unpack_allgather, _unpack_fused,
 )
 
 _PAGE = 4096
@@ -86,6 +86,13 @@ class ShmBackend(CollectiveBackend):
         self._gen = 0
         self._dead = False
         self._opt_in = True if config is None else config.shm_enabled
+        self._zero_copy = True if config is None else config.zero_copy
+        # Persistent pack buffer (common/arena.py): fused steady steps
+        # re-pack into the same memory instead of allocating per step.
+        # Safe here because every shm result is copied OUT of the
+        # segment/accumulators before entries see it.
+        from horovod_tpu.common.arena import FusionArena
+        self._arena = FusionArena() if self._zero_copy else None
         self._m_regrows = None  # set by attach_metrics
 
     def attach_metrics(self, registry) -> None:
@@ -261,7 +268,10 @@ class ShmBackend(CollectiveBackend):
         names = [e.tensor_name for e in entries]
         multi = len(entries) > 1  # single-tensor pack is a view
         with self.activity(names, ACT_MEMCPY_IN_FUSION_BUFFER, multi):
-            fused, _ = _pack_fused(arrays, response)
+            # Arena-safe: every shm result is copied out of the
+            # segment before entries see it, so outputs never alias
+            # the pack buffer.
+            fused, _ = _pack_fused(arrays, response, self._arena)
         if fused.size == 0:
             # Nothing to move; every rank short-circuits identically
             # (sizes are negotiated), so no control rounds are owed.
@@ -373,9 +383,15 @@ class ShmBackend(CollectiveBackend):
             payloads[0] = b""  # our own copy is ``total`` already
             ctl.scatter_data(payloads)  # round 2b
             result = total
+        elif self._zero_copy:
+            # Roots receive the world sum straight into a fresh array;
+            # non-roots' empty slice costs nothing.
+            flat = np.empty(fused.size if lr == 0 else 0, dtype)
+            ctl.scatter_data_into(None, flat)  # round 2b
+            result = flat if lr == 0 else None
         else:
             data = ctl.scatter_data(None)  # round 2b
-            result = (np.frombuffer(bytearray(data), dtype=dtype)
+            result = (_np_from_bytes(data, dtype)
                       if lr == 0 else None)
 
         if lr == 0 and ls > 1:
@@ -403,7 +419,7 @@ class ShmBackend(CollectiveBackend):
         total_elems = sum(rank_counts)
         multi = len(entries) > 1
         with self.activity(names, ACT_MEMCPY_IN_FUSION_BUFFER, multi):
-            packed = _pack_flat(arrays)
+            packed = _pack_flat(arrays, self._arena)
         dtype = packed.dtype
         if ctl.is_coordinator:
             ctl.gather_data(b"")

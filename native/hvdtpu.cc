@@ -18,6 +18,7 @@
 #include <cstring>
 #include <poll.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 #include <vector>
@@ -115,26 +116,54 @@ struct Sha256 {
   }
 };
 
+// Incremental HMAC-SHA256 so scatter-gather payloads (vectored sends,
+// segment-wise receives) can be authenticated without assembling one
+// contiguous buffer first.
+struct Hmac {
+  Sha256 inner;
+  uint8_t opad[64];
+
+  Hmac(const uint8_t* key, size_t key_len) {
+    uint8_t k[64] = {0};
+    if (key_len > 64) {
+      Sha256 kh; kh.update(key, key_len); kh.final(k);  // k[32..] zero
+    } else if (key_len) {
+      memcpy(k, key, key_len);
+    }
+    uint8_t ipad[64];
+    for (int i = 0; i < 64; i++) {
+      ipad[i] = k[i] ^ 0x36; opad[i] = k[i] ^ 0x5c;
+    }
+    inner.update(ipad, 64);
+  }
+
+  void update(const void* p, size_t n) {
+    inner.update(static_cast<const uint8_t*>(p), n);
+  }
+
+  void final(uint8_t out[32]) {
+    uint8_t ih[32];
+    inner.final(ih);
+    Sha256 ho;
+    ho.update(opad, 64);
+    ho.update(ih, 32);
+    ho.final(out);
+  }
+};
+
 void hmac_sha256(const uint8_t* key, size_t key_len, const uint8_t* tag1,
                  const uint8_t* msg, size_t msg_len, uint8_t out[32]) {
-  uint8_t k[64] = {0};
-  if (key_len > 64) {
-    Sha256 kh; kh.update(key, key_len); kh.final(k);  // k[32..] zero
-  } else {
-    memcpy(k, key, key_len);
-  }
-  uint8_t ipad[64], opad[64];
-  for (int i = 0; i < 64; i++) { ipad[i] = k[i] ^ 0x36; opad[i] = k[i] ^ 0x5c; }
-  uint8_t inner[32];
-  Sha256 hi;
-  hi.update(ipad, 64);
-  if (tag1) hi.update(tag1, 1);
-  hi.update(msg, msg_len);
-  hi.final(inner);
-  Sha256 ho;
-  ho.update(opad, 64);
-  ho.update(inner, 32);
-  ho.final(out);
+  Hmac h(key, key_len);
+  if (tag1) h.update(tag1, 1);
+  h.update(msg, msg_len);
+  h.final(out);
+}
+
+// constant-time digest compare
+bool digest_eq(const uint8_t a[32], const uint8_t b[32]) {
+  uint8_t diff = 0;
+  for (int i = 0; i < 32; i++) diff |= uint8_t(a[i] ^ b[i]);
+  return diff == 0;
 }
 
 // ---------------------------------------------------------------------
@@ -163,6 +192,143 @@ int read_all(int fd, uint8_t* p, size_t n) {
     if (r == 0) return -ECONNRESET;
     p += r; n -= size_t(r);
   }
+  return 0;
+}
+
+// Total-silence deadline shared by the zero-copy receive paths: the
+// wait is sliced into interval_ms polls (on_idle fires per idle slice
+// — the coordinator's PING fan-out), idle_ms accumulates across reads
+// within ONE logical wait, and any received byte resets it — the same
+// semantics as network.Channel.arm, so a big frame trickling in over
+// a slow link never false-positives.
+struct Deadline {
+  int timeout_ms;   // < 0: wait forever
+  int interval_ms;  // poll slice (clamped >= 1 when armed)
+  void (*on_idle)();
+  int idle_ms = 0;
+};
+
+int dl_read(int fd, uint8_t* p, size_t n, Deadline* dl) {
+  while (n) {
+    if (dl != nullptr && dl->timeout_ms >= 0) {
+      struct pollfd pf;
+      pf.fd = fd; pf.events = POLLIN; pf.revents = 0;
+      int rc = ::poll(&pf, 1, dl->interval_ms);
+      if (rc < 0) {
+        if (errno == EINTR) continue;
+        return -errno;
+      }
+      if (rc == 0) {
+        if (dl->on_idle) dl->on_idle();
+        dl->idle_ms += dl->interval_ms;
+        if (dl->idle_ms >= dl->timeout_ms) return -ETIMEDOUT;
+        continue;
+      }
+    }
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      if ((errno == EAGAIN || errno == EWOULDBLOCK) && dl != nullptr &&
+          dl->timeout_ms >= 0) {
+        // SO_RCVTIMEO (armed by Channel.arm on this fd) fired under
+        // the poll's feet: count it as one idle slice.
+        if (dl->on_idle) dl->on_idle();
+        dl->idle_ms += dl->interval_ms;
+        if (dl->idle_ms >= dl->timeout_ms) return -ETIMEDOUT;
+        continue;
+      }
+      return -errno;
+    }
+    if (r == 0) return -ECONNRESET;
+    p += r; n -= size_t(r);
+    if (dl != nullptr) dl->idle_ms = 0;
+  }
+  return 0;
+}
+
+// Looped sendmsg over an iovec array, adjusting bases on partial
+// writes; mutates iov in place.
+int sendv_all(int fd, struct iovec* iov, int niov) {
+  while (niov > 0) {
+    struct msghdr msg;
+    memset(&msg, 0, sizeof(msg));
+    msg.msg_iov = iov;
+    msg.msg_iovlen = size_t(niov);
+    ssize_t w = ::sendmsg(fd, &msg, MSG_NOSIGNAL);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    size_t left = size_t(w);
+    while (niov > 0 && left >= iov->iov_len) {
+      left -= iov->iov_len;
+      iov++; niov--;
+    }
+    if (niov > 0 && left) {
+      iov->iov_base = static_cast<char*>(iov->iov_base) + left;
+      iov->iov_len -= left;
+    }
+  }
+  return 0;
+}
+
+// Frame a scatter-gather payload: header + optional digest + parts.
+int send_frame_iov(int fd, uint8_t tag, const void* const* bufs,
+                   const int64_t* lens, int niov,
+                   const uint8_t* secret, int secret_len) {
+  int64_t total = 0;
+  for (int i = 0; i < niov; i++) {
+    if (lens[i] < 0) return -EINVAL;
+    total += lens[i];
+  }
+  if (uint64_t(total) > 0xffffffffull) return -EMSGSIZE;
+  uint8_t hdr[5];
+  uint32_t n32 = uint32_t(total);
+  memcpy(hdr, &n32, 4);  // little-endian hosts only (x86/arm64)
+  hdr[4] = tag;
+  uint8_t digest[32];
+  std::vector<struct iovec> iov;
+  iov.reserve(size_t(niov) + 2);
+  iov.push_back({hdr, 5});
+  if (secret_len > 0) {
+    Hmac h(secret, size_t(secret_len));
+    h.update(&tag, 1);
+    for (int i = 0; i < niov; i++)
+      if (lens[i]) h.update(bufs[i], size_t(lens[i]));
+    h.final(digest);
+    iov.push_back({digest, 32});
+  }
+  for (int i = 0; i < niov; i++)
+    if (lens[i])
+      iov.push_back({const_cast<void*>(bufs[i]), size_t(lens[i])});
+  return sendv_all(fd, iov.data(), int(iov.size()));
+}
+
+bool tag_in(uint8_t tag, const uint8_t* tags, int n) {
+  for (int i = 0; i < n; i++)
+    if (tags[i] == tag) return true;
+  return false;
+}
+
+// Drain + authenticate one frame body of length n into a malloc'd
+// buffer (deviation/skip paths). *out receives the payload (caller
+// frees) unless out == nullptr, in which case it is freed here.
+// ``pre``/``pre_len`` is an already-read head of the payload to
+// stitch back on (deviations detected after a partial read).
+int drain_frame(int fd, uint32_t n, const uint8_t* pre, size_t pre_len,
+                uint8_t tag, const uint8_t* secret, int secret_len,
+                const uint8_t* digest, Deadline* dl, uint8_t** out) {
+  uint8_t* buf = static_cast<uint8_t*>(malloc(n ? n : 1));
+  if (!buf) return -ENOMEM;
+  if (pre_len) memcpy(buf, pre, pre_len);
+  int rc = dl_read(fd, buf + pre_len, n - pre_len, dl);
+  if (rc) { free(buf); return rc; }
+  if (secret_len > 0) {
+    uint8_t expect[32];
+    hmac_sha256(secret, size_t(secret_len), &tag, buf, n, expect);
+    if (!digest_eq(digest, expect)) { free(buf); return -EBADMSG; }
+  }
+  if (out) *out = buf; else free(buf);
   return 0;
 }
 
@@ -215,6 +381,121 @@ int recv_frame(int fd, const uint8_t* secret, int secret_len,
   *out_tag = tag;
   return 0;
 }
+
+// Outcomes of recv_expected (non-negative; errors stay negative).
+enum { RX_MATCH = 0, RX_DEV = 1, RX_SKIP = 2 };
+
+// Receive one frame that SHOULD be the steady-cycle layout
+// (want_tag, prefix, per-segment headers, segment data into
+// data_ptrs). Anything else is drained whole and either discarded
+// (skip_tags) or handed back as a deviation for the Python classic
+// path. Authentication covers every byte exactly as Channel framing
+// does, including deviations.
+int recv_expected(int fd, uint8_t want_tag,
+                  const uint8_t* prefix, int64_t prefix_len,
+                  const uint8_t* const* seg_hdrs,
+                  const int64_t* seg_hdr_lens,
+                  void* const* data_ptrs, const int64_t* seg_lens,
+                  int nseg, const uint8_t* secret, int secret_len,
+                  const uint8_t* skip_tags, int nskip, Deadline* dl,
+                  uint8_t** dev_buf, int64_t* dev_len,
+                  uint8_t* dev_tag) {
+  int64_t expected = prefix_len;
+  for (int i = 0; i < nseg; i++)
+    expected += seg_hdr_lens[i] + seg_lens[i];
+  uint8_t hdr[5];
+  int rc = dl_read(fd, hdr, 5, dl);
+  if (rc) return rc;
+  uint32_t n32;
+  memcpy(&n32, hdr, 4);
+  uint8_t tag = hdr[4];
+  uint8_t digest[32];
+  if (secret_len > 0) {
+    rc = dl_read(fd, digest, 32, dl);
+    if (rc) return rc;
+  }
+  if (tag_in(tag, skip_tags, nskip)) {
+    rc = drain_frame(fd, n32, nullptr, 0, tag, secret, secret_len,
+                     digest, dl, nullptr);
+    return rc ? rc : RX_SKIP;
+  }
+  if (tag != want_tag || int64_t(n32) != expected) {
+    rc = drain_frame(fd, n32, nullptr, 0, tag, secret, secret_len,
+                     digest, dl, dev_buf);
+    if (rc) return rc;
+    *dev_len = n32;
+    *dev_tag = tag;
+    return RX_DEV;
+  }
+  std::vector<uint8_t> scratch(static_cast<size_t>(prefix_len));
+  rc = dl_read(fd, scratch.data(), size_t(prefix_len), dl);
+  if (rc) return rc;
+  Hmac h(secret, size_t(secret_len > 0 ? secret_len : 0));
+  if (secret_len > 0) {
+    h.update(&tag, 1);
+    h.update(scratch.data(), size_t(prefix_len));
+  }
+  if (memcmp(scratch.data(), prefix, size_t(prefix_len)) != 0) {
+    rc = drain_frame(fd, n32, scratch.data(), size_t(prefix_len), tag,
+                     secret, secret_len, digest, dl, dev_buf);
+    if (rc) return rc;
+    *dev_len = n32;
+    *dev_tag = tag;
+    return RX_DEV;
+  }
+  std::vector<uint8_t> hscratch;
+  for (int i = 0; i < nseg; i++) {
+    hscratch.resize(size_t(seg_hdr_lens[i]));
+    rc = dl_read(fd, hscratch.data(), size_t(seg_hdr_lens[i]), dl);
+    if (rc) return rc;
+    if (memcmp(hscratch.data(), seg_hdrs[i],
+               size_t(seg_hdr_lens[i])) != 0) {
+      // Reassemble everything already consumed (prefix + earlier
+      // segments + this header), then drain the rest — a rare
+      // transition cycle pays a copy; steady cycles never land here.
+      uint8_t* buf = static_cast<uint8_t*>(malloc(n32 ? n32 : 1));
+      if (!buf) return -ENOMEM;
+      size_t off = 0;
+      memcpy(buf, prefix, size_t(prefix_len));
+      off += size_t(prefix_len);
+      for (int k = 0; k < i; k++) {
+        memcpy(buf + off, seg_hdrs[k], size_t(seg_hdr_lens[k]));
+        off += size_t(seg_hdr_lens[k]);
+        memcpy(buf + off, data_ptrs[k], size_t(seg_lens[k]));
+        off += size_t(seg_lens[k]);
+      }
+      memcpy(buf + off, hscratch.data(), size_t(seg_hdr_lens[i]));
+      off += size_t(seg_hdr_lens[i]);
+      rc = dl_read(fd, buf + off, size_t(n32) - off, dl);
+      if (rc) { free(buf); return rc; }
+      if (secret_len > 0) {
+        uint8_t expect[32];
+        hmac_sha256(secret, size_t(secret_len), &tag, buf, n32,
+                    expect);
+        if (!digest_eq(digest, expect)) { free(buf); return -EBADMSG; }
+      }
+      *dev_buf = buf;
+      *dev_len = n32;
+      *dev_tag = tag;
+      return RX_DEV;
+    }
+    if (secret_len > 0)
+      h.update(hscratch.data(), size_t(seg_hdr_lens[i]));
+    rc = dl_read(fd, static_cast<uint8_t*>(data_ptrs[i]),
+                 size_t(seg_lens[i]), dl);
+    if (rc) return rc;
+    if (secret_len > 0) h.update(data_ptrs[i], size_t(seg_lens[i]));
+  }
+  if (secret_len > 0) {
+    uint8_t expect[32];
+    h.final(expect);
+    if (!digest_eq(digest, expect)) return -EBADMSG;
+  }
+  return RX_MATCH;
+}
+
+// dtype code -> element size (codes as for hvd_sum_into).
+const int kDtypeSize[] = {4, 8, 4, 8, 1, 2, 2};
 
 }  // namespace
 
@@ -436,6 +717,213 @@ int hvd_sum_into(void* acc, const void* src, int64_t count, int dtype) {
 void hvd_hmac_sha256(const uint8_t* key, int key_len, uint8_t tag,
                      const uint8_t* payload, int64_t len, uint8_t* out) {
   hmac_sha256(key, size_t(key_len), &tag, payload, size_t(len), out);
+}
+
+int hvd_sendv(int fd, uint8_t tag, const void* const* bufs,
+              const int64_t* lens, int niov,
+              const uint8_t* secret, int secret_len) {
+  return send_frame_iov(fd, tag, bufs, lens, niov, secret, secret_len);
+}
+
+int hvd_recv_into(int fd, const uint8_t* secret, int secret_len,
+                  void* buf, int64_t cap,
+                  const uint8_t* skip_tags, int nskip,
+                  int64_t* out_len, uint8_t* out_tag,
+                  int timeout_ms, int interval_ms,
+                  uint8_t** spill) {
+  Deadline dl{timeout_ms, interval_ms > 0 ? interval_ms : 1, nullptr};
+  while (true) {
+    uint8_t hdr[5];
+    int rc = dl_read(fd, hdr, 5, &dl);
+    if (rc) return rc;
+    uint32_t n32;
+    memcpy(&n32, hdr, 4);
+    uint8_t tag = hdr[4];
+    uint8_t digest[32];
+    if (secret_len > 0) {
+      rc = dl_read(fd, digest, 32, &dl);
+      if (rc) return rc;
+    }
+    if (tag_in(tag, skip_tags, nskip)) {
+      rc = drain_frame(fd, n32, nullptr, 0, tag, secret, secret_len,
+                       digest, &dl, nullptr);
+      if (rc) return rc;
+      continue;
+    }
+    *out_tag = tag;
+    *out_len = n32;
+    if (int64_t(n32) > cap) {
+      rc = drain_frame(fd, n32, nullptr, 0, tag, secret, secret_len,
+                       digest, &dl, spill);
+      return rc ? rc : 1;
+    }
+    rc = dl_read(fd, static_cast<uint8_t*>(buf), n32, &dl);
+    if (rc) return rc;
+    if (secret_len > 0) {
+      uint8_t expect[32];
+      hmac_sha256(secret, size_t(secret_len), &tag,
+                  static_cast<const uint8_t*>(buf), n32, expect);
+      if (!digest_eq(digest, expect)) return -EBADMSG;
+    }
+    return 0;
+  }
+}
+
+int hvd_steady_worker(int fd, uint8_t req_tag, uint8_t resp_tag,
+                      const uint8_t* prefix, int64_t prefix_len,
+                      const uint8_t* const* seg_hdrs,
+                      const int64_t* seg_hdr_lens,
+                      const void* const* send_ptrs,
+                      void* const* recv_ptrs,
+                      const int64_t* seg_lens, int nseg,
+                      const uint8_t* secret, int secret_len,
+                      const uint8_t* skip_tags, int nskip,
+                      int timeout_ms, int interval_ms,
+                      uint8_t** dev_buf, int64_t* dev_len,
+                      uint8_t* dev_tag) {
+  // 1. the speculative request frame, straight from the fusion arena
+  std::vector<const void*> bufs;
+  std::vector<int64_t> lens;
+  bufs.reserve(size_t(2 * nseg) + 1);
+  lens.reserve(size_t(2 * nseg) + 1);
+  bufs.push_back(prefix);
+  lens.push_back(prefix_len);
+  for (int i = 0; i < nseg; i++) {
+    bufs.push_back(seg_hdrs[i]);
+    lens.push_back(seg_hdr_lens[i]);
+    bufs.push_back(send_ptrs[i]);
+    lens.push_back(seg_lens[i]);
+  }
+  int rc = send_frame_iov(fd, req_tag, bufs.data(), lens.data(),
+                          int(bufs.size()), secret, secret_len);
+  if (rc) return rc;
+  // 2. the world-reduced response, straight into the result buffers
+  Deadline dl{timeout_ms, interval_ms > 0 ? interval_ms : 1, nullptr};
+  while (true) {
+    rc = recv_expected(fd, resp_tag, prefix, prefix_len, seg_hdrs,
+                       seg_hdr_lens, recv_ptrs, seg_lens, nseg,
+                       secret, secret_len, skip_tags, nskip, &dl,
+                       dev_buf, dev_len, dev_tag);
+    if (rc == RX_SKIP) continue;
+    return rc;  // RX_MATCH (0), RX_DEV (1) or negative errno
+  }
+}
+
+int hvd_steady_coord(const int* fds, int n, uint8_t req_tag,
+                     uint8_t resp_tag,
+                     const uint8_t* prefix, int64_t prefix_len,
+                     const uint8_t* const* seg_hdrs,
+                     const int64_t* seg_hdr_lens,
+                     const int64_t* seg_lens, const int* seg_dtypes,
+                     int nseg,
+                     uint8_t* const* peer_seg_ptrs,
+                     void* const* acc_ptrs,
+                     const uint8_t* secret, int secret_len,
+                     const uint8_t* skip_tags, int nskip,
+                     int timeout_ms, int interval_ms,
+                     void (*on_idle)(void),
+                     uint8_t* done,
+                     int* dev_idx, uint8_t** dev_buf,
+                     int64_t* dev_len, uint8_t* dev_tag) {
+  // --- gather: one speculative frame per pending peer -----------------
+  Deadline dl{timeout_ms, interval_ms > 0 ? interval_ms : 1, on_idle};
+  std::vector<struct pollfd> pfds(static_cast<size_t>(n));
+  int remaining = 0;
+  for (int i = 0; i < n; i++)
+    if (!done[i]) remaining++;
+  while (remaining > 0) {
+    int active = 0;
+    for (int i = 0; i < n; i++) {
+      if (!done[i]) {
+        pfds[size_t(active)].fd = fds[i];
+        pfds[size_t(active)].events = POLLIN;
+        pfds[size_t(active)].revents = 0;
+        active++;
+      }
+    }
+    int rc = ::poll(pfds.data(), nfds_t(active),
+                    dl.timeout_ms >= 0 ? dl.interval_ms : -1);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    if (rc == 0) {
+      if (dl.on_idle) dl.on_idle();
+      dl.idle_ms += dl.interval_ms;
+      if (dl.idle_ms >= dl.timeout_ms) return -ETIMEDOUT;
+      continue;
+    }
+    for (int j = 0; j < active && remaining > 0; j++) {
+      if (!(pfds[size_t(j)].revents & (POLLIN | POLLHUP | POLLERR)))
+        continue;
+      int idx = -1;
+      for (int i = 0; i < n; i++) {
+        if (!done[i] && fds[i] == pfds[size_t(j)].fd) { idx = i; break; }
+      }
+      if (idx < 0) continue;
+      std::vector<void*> data(static_cast<size_t>(nseg));
+      for (int s = 0; s < nseg; s++)
+        data[size_t(s)] = peer_seg_ptrs[idx * nseg + s];
+      rc = recv_expected(fds[idx], req_tag, prefix, prefix_len,
+                         seg_hdrs, seg_hdr_lens, data.data(), seg_lens,
+                         nseg, secret, secret_len, skip_tags, nskip,
+                         &dl, dev_buf, dev_len, dev_tag);
+      if (rc == RX_SKIP) continue;  // liveness/stray: peer stays owed
+      if (rc == RX_DEV) { *dev_idx = idx; return 1; }
+      if (rc < 0) return rc;
+      done[idx] = 1;
+      remaining--;
+      dl.idle_ms = 0;
+    }
+  }
+  // --- reduce: acc[s] += every peer's segment s -----------------------
+  for (int s = 0; s < nseg; s++) {
+    int code = seg_dtypes[s];
+    if (code < 0 || size_t(code) >= sizeof(kDtypeSize) / sizeof(int))
+      return -EINVAL;
+    int64_t count = seg_lens[s] / kDtypeSize[code];
+    for (int i = 0; i < n; i++) {
+      int rc = hvd_sum_into(acc_ptrs[s], peer_seg_ptrs[i * nseg + s],
+                            count, code);
+      if (rc) return rc;
+    }
+  }
+  // --- broadcast the reduced response (digest computed ONCE) ----------
+  int64_t total = prefix_len;
+  for (int s = 0; s < nseg; s++) total += seg_hdr_lens[s] + seg_lens[s];
+  if (uint64_t(total) > 0xffffffffull) return -EMSGSIZE;
+  uint8_t hdr[5];
+  uint32_t n32 = uint32_t(total);
+  memcpy(hdr, &n32, 4);
+  hdr[4] = resp_tag;
+  uint8_t digest[32];
+  if (secret_len > 0) {
+    Hmac h(secret, size_t(secret_len));
+    h.update(&resp_tag, 1);
+    h.update(prefix, size_t(prefix_len));
+    for (int s = 0; s < nseg; s++) {
+      h.update(seg_hdrs[s], size_t(seg_hdr_lens[s]));
+      h.update(acc_ptrs[s], size_t(seg_lens[s]));
+    }
+    h.final(digest);
+  }
+  std::vector<struct iovec> proto;
+  proto.reserve(size_t(2 * nseg) + 3);
+  proto.push_back({hdr, 5});
+  if (secret_len > 0) proto.push_back({digest, 32});
+  proto.push_back({const_cast<uint8_t*>(prefix), size_t(prefix_len)});
+  for (int s = 0; s < nseg; s++) {
+    proto.push_back({const_cast<uint8_t*>(seg_hdrs[s]),
+                     size_t(seg_hdr_lens[s])});
+    proto.push_back({acc_ptrs[s], size_t(seg_lens[s])});
+  }
+  std::vector<struct iovec> iov(proto.size());
+  for (int i = 0; i < n; i++) {
+    iov = proto;  // sendv_all mutates its iovecs on partial writes
+    int rc = sendv_all(fds[i], iov.data(), int(iov.size()));
+    if (rc) return rc;
+  }
+  return 0;
 }
 
 }  // extern "C"

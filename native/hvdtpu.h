@@ -41,6 +41,91 @@ int hvd_scatter_frames(const int* fds, int n, uint8_t tag,
 
 void hvd_free(uint8_t* buf);
 
+// ---- zero-copy data plane (vectored wire I/O) ------------------------
+// One framed send assembled from scatter-gather parts: the header,
+// optional HMAC digest and every payload iovec go out through looped
+// sendmsg(2) straight from caller memory (numpy buffer pointers) —
+// no intermediate bytes object is ever materialized. Payload length
+// is sum(lens).
+int hvd_sendv(int fd, uint8_t tag, const void* const* bufs,
+              const int64_t* lens, int niov,
+              const uint8_t* secret, int secret_len);
+
+// Receive one frame with the payload landing directly in caller
+// memory. Frames whose tag appears in skip_tags (liveness beacons,
+// stray metrics) are drained, authenticated and discarded without
+// touching buf. Returns 0 with payload in buf (len/tag out-params);
+// 1 when the payload did not fit cap — it is then returned complete
+// via *spill (malloc'd; caller frees with hvd_free) so no frame is
+// ever lost; negative errno on transport failure. timeout_ms >= 0
+// arms a total-silence deadline sliced into interval_ms polls (any
+// received byte resets the clock — same semantics as Channel.arm);
+// timeout_ms < 0 blocks forever.
+int hvd_recv_into(int fd, const uint8_t* secret, int secret_len,
+                  void* buf, int64_t cap,
+                  const uint8_t* skip_tags, int nskip,
+                  int64_t* out_len, uint8_t* out_tag,
+                  int timeout_ms, int interval_ms,
+                  uint8_t** spill);
+
+// ---- native steady replay (the fused speculative cycle in C) ---------
+// One steady-state training step without re-entering Python per frame:
+// both halves speak the exact CACHED_SPEC wire layout of
+// common/wire.py (u8 kind | i64 epoch | u32 nslots | mask |
+// u32 nseg | nseg x (u8 dtype | i64 nbytes | raw)), so native and
+// pure-Python ranks interoperate frame-for-frame. ``prefix`` is the
+// constant region up to the first segment header (request hit-mask ==
+// response grant-mask in a granted steady cycle, so one prefix serves
+// both directions); seg_hdrs are the constant 9-byte per-segment
+// headers. Any frame that deviates from the expected layout is
+// returned whole to Python via dev_buf/dev_len/dev_tag (return 1) and
+// the caller resumes the classic path — deviation is a fallback, not
+// an error. Return 0 on a completed cycle, negative errno otherwise
+// (-ETIMEDOUT after timeout_ms of total silence).
+
+// Worker half: send the speculative request frame (prefix + per-seg
+// header/data iovecs from the fusion arena), then receive the world-
+// reduced response straight into recv_ptrs.
+int hvd_steady_worker(int fd, uint8_t req_tag, uint8_t resp_tag,
+                      const uint8_t* prefix, int64_t prefix_len,
+                      const uint8_t* const* seg_hdrs,
+                      const int64_t* seg_hdr_lens,
+                      const void* const* send_ptrs,
+                      void* const* recv_ptrs,
+                      const int64_t* seg_lens, int nseg,
+                      const uint8_t* secret, int secret_len,
+                      const uint8_t* skip_tags, int nskip,
+                      int timeout_ms, int interval_ms,
+                      uint8_t** dev_buf, int64_t* dev_len,
+                      uint8_t* dev_tag);
+
+// Coordinator half: poll-gather one speculative frame per peer
+// (payload must match prefix/seg_hdrs byte-for-byte; segment data
+// lands in peer_seg_ptrs[i*nseg + j]), reduce every peer's segments
+// into acc_ptrs (pre-filled with rank 0's own contribution; dtype
+// codes as for hvd_sum_into), then broadcast the response frame from
+// the accumulators. ``done`` (n bytes, in/out) marks peers whose
+// frame was already absorbed — on a deviation (rc 1, *dev_idx = peer
+// index) or an out-of-band bounce the caller can hand the array back
+// and resume, or fall back with the absorbed frames intact.
+// on_idle (nullable) fires once per idle poll slice (PING fan-out).
+int hvd_steady_coord(const int* fds, int n, uint8_t req_tag,
+                     uint8_t resp_tag,
+                     const uint8_t* prefix, int64_t prefix_len,
+                     const uint8_t* const* seg_hdrs,
+                     const int64_t* seg_hdr_lens,
+                     const int64_t* seg_lens, const int* seg_dtypes,
+                     int nseg,
+                     uint8_t* const* peer_seg_ptrs,
+                     void* const* acc_ptrs,
+                     const uint8_t* secret, int secret_len,
+                     const uint8_t* skip_tags, int nskip,
+                     int timeout_ms, int interval_ms,
+                     void (*on_idle)(void),
+                     uint8_t* done,
+                     int* dev_idx, uint8_t** dev_buf,
+                     int64_t* dev_len, uint8_t* dev_tag);
+
 // ---- fusion buffer pack/unpack ---------------------------------------
 // (reference: horovod/common/ops/collective_operations.cc:35-63
 //  MemcpyInFusionBuffer / MemcpyOutFusionBuffer)

@@ -39,6 +39,21 @@ _MP_MODULES = {
 
 
 def pytest_configure(config):
+    # Build the native core ONCE up front (the zero-copy data plane
+    # rides it): with a compiler present a broken build must fail the
+    # tier LOUDLY — a silent skip would unhook every native test (and
+    # the whole zero-copy plane) from CI forever. Without a compiler
+    # the native tests skip with a reason, as before.
+    from horovod_tpu import native as _native
+
+    loaded, reason = _native.build_status()
+    if not loaded and _native.compiler_available() \
+            and not _native.disabled_via_env():
+        raise pytest.UsageError(
+            f"native core build failed with a compiler present "
+            f"({reason}) — fix native/hvdtpu.cc or the Makefile; "
+            f"tier-1 refuses to silently drop the zero-copy plane")
+
     config.addinivalue_line(
         "markers", "mp: spawns worker subprocesses (slow integration "
         "tier; deselect with -m 'not mp' for the ~2-minute fast "

@@ -781,6 +781,171 @@ def scenario_response_cache_hetero_spec(hvd, rank, size):
     _assert_cache_coherent(hvd, rank, size, "hs.fp")
 
 
+def scenario_native_steady(hvd, rank, size):
+    """Zero-copy native steady cycle end to end (socket star; shm off
+    and metrics armed by the pytest wrapper): a steady grouped-
+    allreduce loop must (a) return exact sums every step, (b) complete
+    steps through hvd_steady_worker/coord (native_steady_cycles
+    advancing on every rank), (c) perform ZERO fallback byte-object
+    copies on the data plane once steady (hvd_data_copies_total delta
+    == 0 — the O(1)-allocations acceptance property), and (d) honor
+    the aliasing contract: results returned at step k are never
+    clobbered by later steps, and stay independently mutable."""
+    from horovod_tpu import native as _nat
+
+    ssum = sum(range(1, size + 1))
+    xs = [np.full(128 + i, float(rank + 1) * (i + 1), np.float64)
+          for i in range(8)]
+
+    def step():
+        hs = hvd.grouped_allreduce_async(xs, average=False, name="zc")
+        return [np.asarray(hvd.synchronize(h)) for h in hs]
+
+    for _ in range(4):
+        step()
+    hvd.barrier(name="zc.bar")
+    s0 = _cache_runtime_stats(hvd)
+    c0 = hvd.metrics()["local"].get("hvd_data_copies_total",
+                                    {"v": 0.0})["v"]
+    held = kept = None
+    for it in range(25):
+        res = step()
+        for i, r in enumerate(res):
+            np.testing.assert_allclose(r, ssum * (i + 1.0))
+        if it == 5:
+            kept = res                       # live views from step 5
+            held = [r.copy() for r in res]   # their frozen values
+    for a, b in zip(kept, held):
+        np.testing.assert_array_equal(a, b)  # 19 later steps: intact
+    kept[0] += 1000.0                        # outputs stay writable...
+    res = step()
+    for i, r in enumerate(res):              # ...and never feed back
+        np.testing.assert_allclose(r, ssum * (i + 1.0))
+    s1 = _cache_runtime_stats(hvd)
+    c1 = hvd.metrics()["local"].get("hvd_data_copies_total",
+                                    {"v": 0.0})["v"]
+    assert s1["cached_cycles"] > s0["cached_cycles"] \
+        or s1["spec_cycles"] > s0["spec_cycles"], (rank, s0, s1)
+    native_on = (_nat.get() is not None
+                 and os.environ.get("HOROVOD_TPU_ZERO_COPY", "1")
+                 != "0")
+    if os.environ.get("HOROVOD_TPU_SHM") == "0":
+        # Socket star: the steady set rides the fused speculative
+        # round; with the native core loaded, as ONE C call per step.
+        assert s1["spec_cycles"] > s0["spec_cycles"], (rank, s0, s1)
+        if native_on:
+            assert s1["native_steady_cycles"] \
+                > s0["native_steady_cycles"], (rank, s0, s1)
+    if native_on:
+        # The acceptance property: after warmup, steady steps perform
+        # zero fallback byte-object copies on the data plane — on the
+        # shm AND socket backends.
+        assert c1 - c0 == 0, (rank, c0, c1)
+    _assert_cache_coherent(hvd, rank, size, "zc.fp")
+
+
+def scenario_native_hetero(hvd, rank, size):
+    """Heterogeneous native worlds (the pytest wrapper turns the
+    native core / zero-copy knob OFF on a subset of ranks): the
+    CACHED_SPEC wire format is byte-identical whether a rank
+    serializes in Python or sends iovecs from the arena, so mixed
+    worlds must stay EXACT and still complete fused speculative
+    cycles — and a native coordinator keeps its one-call steady loop
+    even when some peers are pure Python."""
+    from horovod_tpu import native as _nat
+
+    ssum = sum(range(1, size + 1))
+    xs = [np.full(96, float(rank + 1) * (i + 1), np.float64)
+          for i in range(6)]
+    for _ in range(4):
+        hs = hvd.grouped_allreduce_async(xs, average=False, name="nh")
+        for h in hs:
+            hvd.synchronize(h)
+    hvd.barrier(name="nh.bar")
+    s0 = _cache_runtime_stats(hvd)
+    for _ in range(20):
+        hs = hvd.grouped_allreduce_async(xs, average=False, name="nh")
+        for i, h in enumerate(hs):
+            np.testing.assert_allclose(hvd.synchronize(h),
+                                       ssum * (i + 1.0))
+    s1 = _cache_runtime_stats(hvd)
+    assert s1["spec_cycles"] > s0["spec_cycles"], (rank, s0, s1)
+    if rank == 0 and _nat.get() is not None \
+            and os.environ.get("HOROVOD_TPU_ZERO_COPY", "1") != "0":
+        # the coordinator runs natively even over pure-Python peers
+        assert s1["native_steady_cycles"] > s0["native_steady_cycles"], \
+            (rank, s0, s1)
+    _assert_cache_coherent(hvd, rank, size, "nh.fp")
+
+
+def scenario_abort_sigkill_native_steady(hvd, rank, size):
+    """SIGKILL a rank squarely mid-NATIVE-steady-cycle (fault spec
+    fires at an op index reached deep in zero-copy steady state, so
+    survivors are blocked inside hvd_steady_worker/coord when the
+    victim dies): the C loop must honor the armed recv deadlines and
+    surface the PR 2 fail-fast invariant — every survivor raises
+    WorldAbortedError naming the dead rank within the heartbeat
+    deadline."""
+    import time
+    from horovod_tpu.common.status import WorldAbortedError
+
+    victim = 1
+    deadline = float(os.environ["HOROVOD_HEARTBEAT_TIMEOUT"]) + 12.0
+    x = np.full(256, float(rank + 1), np.float64)
+    t0 = time.monotonic()
+    aborted = None
+    while True:
+        try:
+            hvd.allreduce(x, average=False, name="zk.steady")
+        except WorldAbortedError as e:
+            aborted = e
+            break
+        assert time.monotonic() - t0 < deadline, (
+            f"rank {rank}: collectives kept succeeding {deadline}s "
+            f"after the fault")
+    assert aborted.origin_rank == victim, (rank, str(aborted))
+    assert f"rank {victim}" in str(aborted), str(aborted)
+    assert time.monotonic() - t0 < deadline
+    stats = _cache_runtime_stats(hvd)
+    from horovod_tpu import native as _nat
+    if _nat.get() is not None:
+        # the kill really did land in zero-copy steady state
+        assert stats["native_steady_cycles"] >= 5, stats
+    try:
+        hvd.allreduce(x, average=False, name="zk.post")
+        raise AssertionError("enqueue after world abort must fail")
+    except WorldAbortedError as e:
+        assert e.origin_rank == victim, str(e)
+    hvd.shutdown()
+
+
+def scenario_abort_sever_native_steady(hvd, rank, size):
+    """Severed control link mid-native-steady-cycle (fault injection
+    closes rank 1's upward channel at a deep cycle index): survivors
+    must abort with a structured WorldAbortedError within the
+    deadline — the native loop's transport errors feed the same
+    world-convergent blame path as the Python one."""
+    import time
+    from horovod_tpu.common.status import WorldAbortedError
+
+    deadline = float(os.environ["HOROVOD_HEARTBEAT_TIMEOUT"]) + 12.0
+    x = np.full(256, float(rank + 1), np.float64)
+    t0 = time.monotonic()
+    aborted = None
+    while True:
+        try:
+            hvd.allreduce(x, average=False, name="zs.steady")
+        except WorldAbortedError as e:
+            aborted = e
+            break
+        assert time.monotonic() - t0 < deadline, (
+            f"rank {rank}: collectives kept succeeding {deadline}s "
+            f"after the sever")
+    assert aborted.origin_rank >= -1, str(aborted)
+    assert time.monotonic() - t0 < deadline
+    hvd.shutdown()
+
+
 def scenario_response_cache_eviction(hvd, rank, size):
     """Capacity eviction under a tiny HOROVOD_CACHE_CAPACITY (set by
     the pytest wrapper): cycling through more distinct tensors than

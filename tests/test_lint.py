@@ -299,6 +299,145 @@ def test_wire_protocol_scopes_to_wire_modules(tmp_path):
                          name="codec.py") == []
 
 
+# -- native-codec -----------------------------------------------------------
+
+_NATIVE_HEADER = """
+    #pragma once
+    #include <cstdint>
+    extern "C" {
+    int hvd_sum_into(void* acc, const void* src, int64_t count,
+                     int dtype);
+    int hvd_gather_frames(const int* fds, int n, const uint8_t* secret,
+                          int secret_len, uint8_t** bufs, int64_t* lens,
+                          uint8_t* tags, int timeout_ms);
+    void hvd_free(uint8_t* buf);
+    int hvd_orphan(int fd, void (*cb)(void), int n);
+    }
+"""
+
+BAD_NATIVE_LOADER = """
+    import ctypes
+
+    def _configure(lib):
+        # arity drift: C declares 4 params, mirror lists 3
+        lib.hvd_sum_into.restype = ctypes.c_int
+        lib.hvd_sum_into.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+        # argtypes without restype
+        lib.hvd_gather_frames.argtypes = [
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]
+        lib.hvd_free.restype = None
+        lib.hvd_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        # configured but not declared anywhere
+        lib.hvd_ghost.restype = ctypes.c_int
+        lib.hvd_ghost.argtypes = [ctypes.c_int]
+
+    def gather(lib, fds):
+        # allocating entry point with no hvd_free anywhere in sight
+        return lib.hvd_gather_frames(fds, 1, None, 0, None, None,
+                                     None, -1)
+"""
+
+GOOD_NATIVE_LOADER = """
+    import ctypes
+
+    def _configure(lib):
+        lib.hvd_sum_into.restype = ctypes.c_int
+        lib.hvd_sum_into.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int]
+        lib.hvd_gather_frames.restype = ctypes.c_int
+        lib.hvd_gather_frames.argtypes = [
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]
+        lib.hvd_free.restype = None
+        lib.hvd_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        lib.hvd_orphan.restype = ctypes.c_int
+        lib.hvd_orphan.argtypes = [ctypes.c_int, ctypes.c_void_p,
+                                   ctypes.c_int]
+
+    def gather(lib, fds, bufs):
+        rc = lib.hvd_gather_frames(fds, 1, None, 0, bufs, None,
+                                   None, -1)
+        for b in bufs:
+            lib.hvd_free(b)
+        return rc
+"""
+
+
+def _lint_native(tmp_path, loader_code: str, header: str = None):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "native.py").write_text(textwrap.dedent(loader_code))
+    native_dir = tmp_path / "native"
+    native_dir.mkdir(exist_ok=True)
+    (native_dir / "hvdtpu.h").write_text(
+        textwrap.dedent(header or _NATIVE_HEADER))
+    return lint_paths([str(pkg)], ["native-codec"])
+
+
+def test_native_codec_fires(tmp_path):
+    fs = _lint_native(tmp_path, BAD_NATIVE_LOADER)
+    msgs = "\n".join(f.message for f in fs)
+    assert "argtypes lists 3 parameters but the C declaration has 4" \
+        in msgs
+    assert "hvd_gather_frames has argtypes but no restype" in msgs
+    assert "hvd_orphan is declared" in msgs  # unmirrored entry point
+    assert "hvd_ghost is configured for ctypes but not declared" in msgs
+    assert "never references hvd_free" in msgs
+
+
+def test_native_codec_clean(tmp_path):
+    assert _lint_native(tmp_path, GOOD_NATIVE_LOADER) == []
+
+
+def test_native_codec_function_pointer_arity(tmp_path):
+    """A function-pointer parameter's own parentheses must not split
+    the C parameter count (the hvd_steady_coord on_idle shape)."""
+    from tools.hvdlint.native_codec import parse_header
+    decls = parse_header(textwrap.dedent(_NATIVE_HEADER))
+    assert decls["hvd_orphan"] == 3
+
+
+def test_native_codec_tag_distinctness(tmp_path):
+    code = """
+        TAG_A = 1
+        TAG_B = 1
+        TAG_BIG = 300
+    """
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "controller.py").write_text(textwrap.dedent(code))
+    fs = lint_paths([str(pkg)], ["native-codec"])
+    msgs = "\n".join(f.message for f in fs)
+    assert "TAG_A and TAG_B share byte value" in msgs
+    assert "does not fit the u8 tag byte" in msgs
+
+
+def test_native_codec_real_tree_mirror():
+    """The REAL loader must mirror the REAL header exactly — this is
+    the check that catches a future C signature change whose author
+    forgot the ctypes side."""
+    from tools.hvdlint.native_codec import parse_header
+    header = os.path.join(REPO, "native", "hvdtpu.h")
+    with open(header) as fh:
+        decls = parse_header(fh.read())
+    # every entry point this PR leans on is visible to the analyzer
+    for fn in ("hvd_sendv", "hvd_recv_into", "hvd_steady_worker",
+               "hvd_steady_coord", "hvd_sum_into"):
+        assert fn in decls, fn
+    fs = lint_paths([os.path.join(REPO, "horovod_tpu")],
+                    ["native-codec"])
+    assert fs == [], "\n".join(f.render() for f in fs)
+
+
 def test_wire_truncated_frames_raise_connectionerror():
     """The fix the analyzer demanded: every decoder surfaces a
     truncated buffer as ConnectionError, never struct.error/IndexError

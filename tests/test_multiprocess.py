@@ -658,6 +658,75 @@ def test_abort_sigkill_mid_cached_cycle():
         expect_rc={1: _SIGKILL_RC})
 
 
+def test_native_steady_zero_copy_socket():
+    """Zero-copy native steady cycle on the socket star at ws=4:
+    exact values, native_steady_cycles advancing everywhere, zero
+    fallback byte-copies after warmup, and the aliasing contract
+    (outputs from step k survive 19 later steps untouched)."""
+    run_scenario(
+        "native_steady", 4, timeout=120.0,
+        extra_env={"HOROVOD_TPU_SHM": "0",
+                   "HOROVOD_TPU_METRICS": "1"})
+
+
+def test_native_steady_alloc_property_shm():
+    """The O(1)-allocations steady-step property on the shm data
+    plane: hvd_data_copies_total must not move across 25 steady
+    steps (the shm plane never defensively copies payload bytes)."""
+    run_scenario(
+        "native_steady", 4, timeout=120.0,
+        extra_env={"HOROVOD_TPU_METRICS": "1"})
+
+
+def test_native_steady_pure_python_fallback():
+    """HOROVOD_NATIVE=0: the whole steady machinery must stay green
+    on the pure-Python paths (classic PR 3 fused cycle)."""
+    run_scenario(
+        "native_steady", 3, timeout=120.0,
+        extra_env={"HOROVOD_TPU_SHM": "0",
+                   "HOROVOD_TPU_METRICS": "1",
+                   "HOROVOD_NATIVE": "0"})
+
+
+def test_native_hetero_world():
+    """Mixed world: rank 1 runs with the native core off, rank 2 with
+    HOROVOD_TPU_ZERO_COPY=0 — the CACHED_SPEC wire format is
+    byte-identical either way, so values stay exact, fused cycles
+    still complete, and the native coordinator keeps its one-call
+    steady loop over pure-Python peers."""
+    run_scenario(
+        "native_hetero", 4, timeout=120.0,
+        extra_env={"HOROVOD_TPU_SHM": "0"},
+        per_rank_env=lambda rank: (
+            {"HOROVOD_NATIVE": "0"} if rank == 1 else
+            {"HOROVOD_TPU_ZERO_COPY": "0"} if rank == 2 else {}))
+
+
+def test_abort_sigkill_mid_native_steady():
+    """SIGKILL rank 1 deep in zero-copy steady state (op=40): the
+    survivors are blocked inside hvd_steady_worker/coord when the
+    victim dies, and must still raise WorldAbortedError naming rank 1
+    within the heartbeat deadline — the C loop honors the armed recv
+    deadlines."""
+    run_scenario(
+        "abort_sigkill_native_steady", 3, timeout=60.0,
+        extra_env={**_HB_ENV,
+                   "HOROVOD_TPU_SHM": "0",
+                   "HOROVOD_FAULT_SPEC": "rank=1:kill:op=40"},
+        expect_rc={1: _SIGKILL_RC})
+
+
+def test_abort_sever_mid_native_steady():
+    """Abruptly close rank 1's upward control channel deep in
+    zero-copy steady state: both sides of the cut converge on a
+    structured world abort instead of blocking in the native loop."""
+    run_scenario(
+        "abort_sever_native_steady", 3, timeout=60.0,
+        extra_env={**_HB_ENV,
+                   "HOROVOD_TPU_SHM": "0",
+                   "HOROVOD_FAULT_SPEC": "rank=1:sever:cycle=30"})
+
+
 def test_abort_heartbeat_detects_silent_hang():
     """Wedge rank 1's background loop for 10 s WITHOUT killing it (no
     FIN/RST ever reaches the peers — the case TCP error detection

@@ -170,6 +170,195 @@ def test_frame_transport_rejects_bad_hmac():
     a.close(); b.close()
 
 
+@pytest.mark.parametrize("secret", [b"", b"sharedsecret"])
+def test_sendv_interop_with_python_channel(secret):
+    """Channel.sendv (native scatter-gather sendmsg) must produce
+    byte-identical frames to the Python path: header, HMAC over
+    tag|payload, payload = concatenation of the parts."""
+    a, b = socket.socketpair()
+    ca, cb = Channel(a, secret), Channel(b, secret)
+    parts = [b"prefix", np.arange(5000, dtype=np.float64),
+             memoryview(b"tail")]
+    t = threading.Thread(target=ca.sendv, args=(parts, 9))
+    t.start()
+    tag, data = cb.recv()
+    t.join()
+    assert tag == 9
+    assert data == b"prefix" + parts[1].tobytes() + b"tail"
+    a.close(); b.close()
+
+
+def test_recv_into_native_skips_and_spills():
+    """hvd_recv_into: skip-tags are drained+authenticated+discarded,
+    a fitting frame lands in the caller buffer, and an oversized frame
+    comes back whole via the spill pointer."""
+    secret = b"s3cret"
+    a, b = socket.socketpair()
+    sender = Channel(b, secret)
+    for payload, tag in ((b"ping!", 5), (os.urandom(3000), 4)):
+        threading.Thread(target=sender.send,
+                         args=(payload, tag)).start()
+    buf = np.zeros(4096, np.uint8)
+    sec = (ctypes.c_uint8 * len(secret))(*secret)
+    skip = (ctypes.c_uint8 * 1)(5)
+    out_len = ctypes.c_int64()
+    out_tag = ctypes.c_uint8()
+    spill = ctypes.POINTER(ctypes.c_uint8)()
+    rc = lib.hvd_recv_into(
+        a.fileno(), sec, len(secret),
+        buf.ctypes.data_as(ctypes.c_void_p), buf.nbytes,
+        skip, 1, ctypes.byref(out_len), ctypes.byref(out_tag),
+        5000, 100, ctypes.byref(spill))
+    assert rc == 0 and out_tag.value == 4 and out_len.value == 3000
+    # the PING was skipped; the data frame landed in the buffer
+    big = os.urandom(8192)
+    threading.Thread(target=sender.send, args=(big, 4)).start()
+    rc = lib.hvd_recv_into(
+        a.fileno(), sec, len(secret),
+        buf.ctypes.data_as(ctypes.c_void_p), buf.nbytes,
+        skip, 1, ctypes.byref(out_len), ctypes.byref(out_tag),
+        5000, 100, ctypes.byref(spill))
+    assert rc == 1 and out_len.value == len(big)
+    assert ctypes.string_at(spill, out_len.value) == big
+    lib.hvd_free(spill)
+    a.close(); b.close()
+
+
+def _steady_c_parts(epoch, nslots, mask, seg):
+    """ctypes bundle for one-segment steady calls, with the prefix and
+    header coming from wire.spec_frame_parts — the SAME single source
+    the runtime uses, so these tests pin C/Python byte identity."""
+    from horovod_tpu.common import wire
+    from horovod_tpu.common.message import DataType
+    prefix, hdrs = wire.spec_frame_parts(
+        epoch, nslots, mask, [(DataType.FLOAT64, seg.nbytes)])
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    mk = lambda b: (ctypes.c_uint8 * len(b)).from_buffer_copy(b)
+    pre = mk(prefix)
+    hdr = mk(hdrs[0])
+    return {
+        "prefix": pre, "prefix_len": len(prefix),
+        "hdr_keep": hdr,
+        "hdrs": (u8p * 1)(ctypes.cast(hdr, u8p)),
+        "hdr_lens": (ctypes.c_int64 * 1)(len(hdrs[0])),
+        "seg_lens": (ctypes.c_int64 * 1)(seg.nbytes),
+        "seg_codes": (ctypes.c_int * 1)(1),  # f64 native code
+        "u8p": u8p,
+    }
+
+
+@pytest.mark.parametrize("secret", [b"", b"steady-secret"])
+def test_native_steady_cycle_roundtrip(secret):
+    """Full steady cycle: two hvd_steady_worker clients against one
+    hvd_steady_coord — the coordinator reduces every rank's segment
+    into its own accumulator and every rank ends with the world sum,
+    with zero Python-side frame assembly."""
+    n = 2
+    epoch, nslots, mask = 11, 64, 0b101
+    seg = np.arange(2048, dtype=np.float64)
+    c = _steady_c_parts(epoch, nslots, mask, seg)
+    sec = (ctypes.c_uint8 * max(1, len(secret))).from_buffer_copy(
+        secret or b"\x00")
+    skip = (ctypes.c_uint8 * 2)(5, 7)
+    pairs = [socket.socketpair() for _ in range(n)]
+    results = {}
+
+    def worker(sock, rank):
+        data = seg * (rank + 1)
+        recv = np.empty_like(data)
+        send_ptrs = (ctypes.c_void_p * 1)(data.ctypes.data)
+        recv_ptrs = (ctypes.c_void_p * 1)(recv.ctypes.data)
+        dev = ctypes.POINTER(ctypes.c_uint8)()
+        dl = ctypes.c_int64()
+        dt = ctypes.c_uint8()
+        rc = lib.hvd_steady_worker(
+            sock.fileno(), 2, 3, c["prefix"], c["prefix_len"],
+            c["hdrs"], c["hdr_lens"], send_ptrs, recv_ptrs,
+            c["seg_lens"], 1, sec, len(secret), skip, 2, 5000, 100,
+            ctypes.byref(dev), ctypes.byref(dl), ctypes.byref(dt))
+        results[rank] = (rc, recv)
+
+    threads = [threading.Thread(target=worker,
+                                args=(pairs[i][1], i + 1))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    acc = seg * 1.0  # coordinator's own contribution
+    scratch = np.empty((n, seg.size), np.float64)
+    fds = (ctypes.c_int * n)(*[pairs[i][0].fileno() for i in range(n)])
+    peer_ptrs = (c["u8p"] * n)(*[
+        ctypes.cast(ctypes.c_void_p(scratch[i].ctypes.data), c["u8p"])
+        for i in range(n)])
+    acc_ptrs = (ctypes.c_void_p * 1)(acc.ctypes.data)
+    done = (ctypes.c_uint8 * n)()
+    dev_idx = ctypes.c_int(-1)
+    dev = ctypes.POINTER(ctypes.c_uint8)()
+    dl = ctypes.c_int64()
+    dt = ctypes.c_uint8()
+    import horovod_tpu.native as _nat
+    rc = lib.hvd_steady_coord(
+        fds, n, 2, 3, c["prefix"], c["prefix_len"], c["hdrs"],
+        c["hdr_lens"], c["seg_lens"], c["seg_codes"], 1, peer_ptrs,
+        acc_ptrs, sec, len(secret), skip, 2, 5000, 100,
+        _nat.ON_IDLE_FUNC(0), done, ctypes.byref(dev_idx),
+        ctypes.byref(dev), ctypes.byref(dl), ctypes.byref(dt))
+    for t in threads:
+        t.join()
+    assert rc == 0, rc
+    expect = seg * (1.0 + 2.0 + 3.0)
+    np.testing.assert_allclose(acc, expect)
+    for r in (1, 2):
+        rcw, recv = results[r]
+        assert rcw == 0, rcw
+        np.testing.assert_allclose(recv, expect)
+
+
+def test_native_steady_coord_deviation_returns_classic_frame():
+    """A peer that sends a CLASSIC frame instead of the expected
+    steady layout must come back to Python whole (deviation), exactly
+    as sent — the fallback path feeds it to the normal parser."""
+    from horovod_tpu.common import wire
+    from horovod_tpu.common.message import CacheCycleRequest
+    secret = b"devsecret"
+    epoch, nslots, mask = 11, 64, 0b101
+    seg = np.arange(128, dtype=np.float64)
+    c = _steady_c_parts(epoch, nslots, mask, seg)
+    sec = (ctypes.c_uint8 * len(secret))(*secret)
+    skip = (ctypes.c_uint8 * 1)(5)
+    a, b = socket.socketpair()
+    classic = wire.serialize_cycle_request(CacheCycleRequest(
+        epoch=epoch, nslots=nslots, hit_mask=mask))
+    t = threading.Thread(target=Channel(b, secret).send,
+                         args=(classic, 2))
+    t.start()
+    scratch = np.empty(seg.size, np.float64)
+    acc = seg.copy()
+    fds = (ctypes.c_int * 1)(a.fileno())
+    peer_ptrs = (c["u8p"] * 1)(
+        ctypes.cast(ctypes.c_void_p(scratch.ctypes.data), c["u8p"]))
+    acc_ptrs = (ctypes.c_void_p * 1)(acc.ctypes.data)
+    done = (ctypes.c_uint8 * 1)()
+    dev_idx = ctypes.c_int(-1)
+    dev = ctypes.POINTER(ctypes.c_uint8)()
+    dl = ctypes.c_int64()
+    dt = ctypes.c_uint8()
+    import horovod_tpu.native as _nat
+    rc = lib.hvd_steady_coord(
+        fds, 1, 2, 3, c["prefix"], c["prefix_len"], c["hdrs"],
+        c["hdr_lens"], c["seg_lens"], c["seg_codes"], 1, peer_ptrs,
+        acc_ptrs, sec, len(secret), skip, 1, 5000, 100,
+        _nat.ON_IDLE_FUNC(0), done, ctypes.byref(dev_idx),
+        ctypes.byref(dev), ctypes.byref(dl), ctypes.byref(dt))
+    t.join()
+    assert rc == 1 and dev_idx.value == 0 and dt.value == 2
+    got = ctypes.string_at(dev, dl.value)
+    lib.hvd_free(dev)
+    assert got == classic
+    parsed = wire.parse_cycle_request(got)
+    assert parsed.hit_mask == mask and parsed.epoch == epoch
+    a.close(); b.close()
+
+
 def test_sum_into_bfloat16_matches_numpy_rne():
     """Native bf16 sum (f32 accumulate + round-to-nearest-even) must
     agree bitwise with ml_dtypes' own bf16 addition."""

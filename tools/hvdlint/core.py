@@ -597,9 +597,10 @@ class Resolver:
 # runner
 
 def get_analyzers() -> Dict[str, object]:
-    from tools.hvdlint import (knobs, lock_order, teardown, wire_protocol,
-                               world_coherence)
-    mods = (lock_order, wire_protocol, world_coherence, teardown, knobs)
+    from tools.hvdlint import (knobs, lock_order, native_codec, teardown,
+                               wire_protocol, world_coherence)
+    mods = (lock_order, wire_protocol, native_codec, world_coherence,
+            teardown, knobs)
     return {m.NAME: m for m in mods}
 
 

@@ -1,0 +1,229 @@
+"""native-codec: the C/Python ABI mirror of the native core.
+
+Scope: modules named ``native`` (the ctypes loader) plus the C header
+they bind (``../native/hvdtpu.h`` relative to the scanned package —
+the layout horovod_tpu/native.py hardcodes). The zero-copy data plane
+moved framing and reduction into C; the Python side describes every
+entry point to ctypes by hand, and NOTHING checks that description
+against the header — a drifted argtype is silent memory corruption,
+not an exception. Four bug classes:
+
+1. **Unmirrored entry points.** Every ``hvd_*`` function declared in
+   the header must have BOTH ``lib.hvd_x.argtypes = [...]`` and
+   ``lib.hvd_x.restype = ...`` assignments in the loader, and every
+   configured name must exist in the header (a binding for a deleted
+   symbol would raise only at call time, on the hot path).
+
+2. **Arity drift.** ``len(argtypes)`` must equal the C declaration's
+   parameter count — the exact mismatch that shifts every later
+   argument one slot over and scribbles through a stale pointer.
+
+3. **Frame-tag distinctness.** The native steady cycle receives raw
+   ``TAG_*`` bytes from Python and byte-compares frames against them;
+   modules named ``controller`` must keep all ``TAG_*`` constants
+   pairwise distinct and within u8 (the FRAME_* discriminator rule of
+   the wire-protocol analyzer, extended to the transport tags the C
+   codec sees).
+
+4. **Allocation discipline.** The entry points that malloc buffers
+   back to Python (gather/recv/steady deviation paths) must be
+   balanced by ``hvd_free`` in the same module — a wrapper module
+   that consumes frames but never frees is a per-cycle leak.
+
+Residual blind spots (accepted): the header parse is regex-based over
+``extern "C"`` declarations — exotic C syntax (macros expanding to
+declarations) would be missed; argtype WIDTHS are not checked against
+C types, only arity.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from tools.hvdlint.core import Finding, Project, SourceFile, dotted_name
+
+NAME = "native-codec"
+
+# hvd_* entry points whose out-params hand malloc'd buffers to Python.
+ALLOCATING = {"hvd_gather_frames", "hvd_recv_into",
+              "hvd_steady_worker", "hvd_steady_coord"}
+
+_DECL_RE = re.compile(
+    r"^\s*(?:int|void|int64_t|uint8_t)\s+(hvd_\w+)\s*\(([^;{]*)\)\s*;",
+    re.MULTILINE | re.DOTALL)
+
+
+def _is_native_module(src: SourceFile) -> bool:
+    return src.shortname == "native"
+
+
+def _header_for(src: SourceFile) -> Optional[str]:
+    """The C header the loader binds: <pkg>/../native/hvdtpu.h —
+    the path horovod_tpu/native.py derives at import time."""
+    pkg_dir = os.path.dirname(os.path.abspath(src.path))
+    path = os.path.join(os.path.dirname(pkg_dir), "native", "hvdtpu.h")
+    return path if os.path.isfile(path) else None
+
+
+def _split_params(arglist: str) -> List[str]:
+    """Split a C parameter list on top-level commas (function-pointer
+    parameters carry parentheses of their own)."""
+    args, depth, cur = [], 0, []
+    for ch in arglist:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            args.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    tail = "".join(cur).strip()
+    if tail:
+        args.append(tail)
+    return [a.strip() for a in args if a.strip()]
+
+
+def parse_header(text: str) -> Dict[str, int]:
+    """{hvd_name: parameter count} from an extern-"C" header."""
+    decls: Dict[str, int] = {}
+    for m in _DECL_RE.finditer(text):
+        name, arglist = m.group(1), m.group(2)
+        params = _split_params(arglist)
+        if len(params) == 1 and params[0] in ("void", ""):
+            params = []
+        decls[name] = len(params)
+    return decls
+
+
+def _configured(src: SourceFile) -> Tuple[Dict[str, Tuple[int, int]],
+                                          Dict[str, int]]:
+    """(argtypes {name: (count, line)}, restypes {name: line}) from
+    ``lib.hvd_x.argtypes = [...]`` / ``.restype = ...`` assignments."""
+    argtypes: Dict[str, Tuple[int, int]] = {}
+    restypes: Dict[str, int] = {}
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        tgt = node.targets[0]
+        if not (isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Attribute)
+                and tgt.value.attr.startswith("hvd_")):
+            continue
+        fn = tgt.value.attr
+        if tgt.attr == "argtypes":
+            if isinstance(node.value, (ast.List, ast.Tuple)):
+                argtypes[fn] = (len(node.value.elts), node.lineno)
+            else:
+                argtypes[fn] = (-1, node.lineno)  # unresolvable
+        elif tgt.attr == "restype":
+            restypes[fn] = node.lineno
+    return argtypes, restypes
+
+
+def _check_loader(src: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    header = _header_for(src)
+    if header is None:
+        return findings  # no native tree next to this package
+    with open(header, encoding="utf-8") as fh:
+        decls = parse_header(fh.read())
+    argtypes, restypes = _configured(src)
+    for fn, nparams in sorted(decls.items()):
+        if fn not in argtypes:
+            findings.append(Finding(
+                NAME, src.path, 1,
+                f"{fn} is declared in {os.path.basename(header)} but "
+                f"has no ctypes argtypes mirror — an unchecked call "
+                f"corrupts memory instead of raising"))
+            continue
+        count, line = argtypes[fn]
+        if count >= 0 and count != nparams:
+            findings.append(Finding(
+                NAME, src.path, line,
+                f"{fn} argtypes lists {count} parameters but the C "
+                f"declaration has {nparams} — every later argument "
+                f"shifts one slot (silent memory corruption)"))
+        if fn not in restypes:
+            findings.append(Finding(
+                NAME, src.path, argtypes[fn][1],
+                f"{fn} has argtypes but no restype — ctypes defaults "
+                f"to c_int, truncating 64-bit returns"))
+    for fn, (_, line) in sorted(argtypes.items()):
+        if fn not in decls:
+            findings.append(Finding(
+                NAME, src.path, line,
+                f"{fn} is configured for ctypes but not declared in "
+                f"{os.path.basename(header)} — the binding raises "
+                f"only at call time, on the hot path"))
+    return findings
+
+
+def _check_tags(src: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Dict[int, Tuple[str, int]] = {}
+    for node in src.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        name = node.targets[0].id
+        if not name.startswith("TAG_"):
+            continue
+        if not (isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)):
+            continue
+        v = node.value.value
+        if not 0 <= v <= 0xFF:
+            findings.append(Finding(
+                NAME, src.path, node.lineno,
+                f"transport tag {name} = {v} does not fit the u8 tag "
+                f"byte of the frame header"))
+        elif v in seen:
+            findings.append(Finding(
+                NAME, src.path, node.lineno,
+                f"transport tags {seen[v][0]} and {name} share byte "
+                f"value {v:#04x} — the native codec byte-compares "
+                f"tags and cannot tell these frames apart"))
+        else:
+            seen[v] = (name, node.lineno)
+    return findings
+
+
+def _check_free_discipline(src: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        calls = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                d = dotted_name(sub.func) or ""
+                calls.add(d.rsplit(".", 1)[-1])
+            elif isinstance(sub, ast.Attribute):
+                calls.add(sub.attr)
+        alloc = sorted(calls & ALLOCATING)
+        if alloc and "hvd_free" not in calls:
+            findings.append(Finding(
+                NAME, src.path, node.lineno,
+                f"{node.name} calls {', '.join(alloc)} (which may "
+                f"malloc buffers back to Python) but never references "
+                f"hvd_free — a per-cycle native memory leak"))
+    return findings
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in project.files:
+        if _is_native_module(src):
+            findings.extend(_check_loader(src))
+        if src.shortname == "controller" \
+                or src.shortname.startswith("controller_"):
+            findings.extend(_check_tags(src))
+        # free discipline applies anywhere the allocating entry points
+        # are driven from (loader wrappers, steady-cycle drivers).
+        findings.extend(_check_free_discipline(src))
+    return findings
