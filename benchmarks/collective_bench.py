@@ -283,6 +283,93 @@ def worker_overhead(rank: int, size: int) -> None:
     hvd.shutdown()
 
 
+ELASTIC_BENCH_STEPS = 400      # total steady allreduce steps
+ELASTIC_BENCH_KILL_OP = 150    # victim's SIGKILL lands mid-run
+
+
+def worker_elastic(rank: int, size: int) -> None:
+    """Elastic recovery section: a steady single-tensor loop at ws=N;
+    the highest rank is SIGKILLed mid-run by fault injection
+    (HOROVOD_FAULT_SPEC, set by the section driver) and the survivors
+    re-rendezvous into ws=N-1 and finish. The surviving rank 0 reports
+    steady-state us/op BEFORE the kill, the re-rendezvous GAP (the one
+    step interval that contains detection + barrier + re-init +
+    resync), and us/op AFTER the shrink — the recovery-time budget is
+    asserted against 2x the heartbeat timeout by the driver."""
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.common import config as hconfig
+    from horovod_tpu.common import elastic
+
+    hvd.init()
+    launch_rank = hconfig.env_int("HOROVOD_RANK", rank)
+    x = np.full(16384, float(rank + 1), np.float32)  # 64 KiB payload
+    state = elastic.State(batch=0)
+    stamps = []  # (t_after_step, world_size)
+
+    @elastic.run
+    def train(state):
+        while state.batch < ELASTIC_BENCH_STEPS:
+            hvd.allreduce(x, average=False, name="el.bench")
+            state.batch += 1
+            state.commit()
+            stamps.append((time.monotonic(), hvd.size()))
+
+    train(state)
+    if launch_rank != 0:
+        hvd.shutdown()
+        return
+    pre, post, gap = [], [], None
+    for (t0, ws0), (t1, ws1) in zip(stamps, stamps[1:]):
+        dt = t1 - t0
+        if ws0 == size and ws1 == size:
+            pre.append(dt)
+        elif ws0 == size - 1 and ws1 == size - 1:
+            post.append(dt)
+        else:
+            gap = dt  # the transition step: detection + re-rendezvous
+    ctx = elastic.context()
+    _, pre_med, _ = _quantiles(pre)
+    _, post_med, _ = _quantiles(post)
+    print("RESULT " + json.dumps({
+        "world": size,
+        "steps": ELASTIC_BENCH_STEPS,
+        "pre_kill_us_per_op": round(pre_med * 1e6, 1),
+        "post_shrink_us_per_op": round(post_med * 1e6, 1),
+        "rendezvous_gap_ms": round((gap or 0.0) * 1e3, 1),
+        "barrier_ms": round(ctx.last_rendezvous_s * 1e3, 1),
+        "generation": ctx.membership.generation,
+    }), flush=True)
+    hvd.shutdown()
+
+
+def _elastic_bench_section(np_: int) -> dict:
+    """`--elastic`: steady us/op before the kill, the re-rendezvous
+    gap, and us/op after the shrink, with the recovery time asserted
+    under 2x the heartbeat timeout."""
+    hb_timeout = 2.0
+    r = _run_world(
+        "elastic", np_, timeout=300.0,
+        extra_env={
+            "HOROVOD_ELASTIC": "1",
+            "HOROVOD_ELASTIC_WINDOW": "10",
+            "HOROVOD_HEARTBEAT_INTERVAL": "0.2",
+            "HOROVOD_HEARTBEAT_TIMEOUT": str(hb_timeout),
+            "HOROVOD_TPU_SHM": "0",
+            "HOROVOD_FAULT_SPEC":
+                f"rank={np_ - 1}:kill:op={ELASTIC_BENCH_KILL_OP}",
+        },
+        allow_rc={np_ - 1: -9})
+    r["heartbeat_timeout_s"] = hb_timeout
+    r["recovery_budget_ms"] = round(2 * hb_timeout * 1e3, 1)
+    r["recovery_within_budget"] = \
+        r["rendezvous_gap_ms"] < 2 * hb_timeout * 1e3
+    assert r["recovery_within_budget"], (
+        f"re-rendezvous gap {r['rendezvous_gap_ms']} ms exceeded the "
+        f"2x-heartbeat budget {r['recovery_budget_ms']} ms")
+    return r
+
+
 CACHE_BENCH_TENSORS = 64       # 4 KiB grads per steady-state step
 CACHE_BENCH_STEPS = 100
 CACHE_BENCH_GAP_S = 0.005      # simulated per-step compute (backward)
@@ -914,7 +1001,11 @@ def _run_single_proc(worker: str, timeout: float = 300.0) -> dict:
 
 
 def _run_world(mode: str, size: int, timeout: float = 600.0,
-               extra_env=None, per_rank_env=None) -> dict:
+               extra_env=None, per_rank_env=None,
+               allow_rc=None) -> dict:
+    """``allow_rc`` maps rank -> expected returncode for ranks that
+    are SUPPOSED to die (the elastic section's fault-injected victim
+    exits -SIGKILL by design)."""
     port = _free_port()
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -949,7 +1040,8 @@ def _run_world(mode: str, size: int, timeout: float = 600.0,
                 q.kill()
             raise RuntimeError(f"{mode} np={size} rank {rank} timed out")
         outs.append(out.decode())
-        if p.returncode != 0:
+        want = allow_rc.get(rank, 0) if allow_rc else 0
+        if p.returncode != want:
             raise RuntimeError(
                 f"{mode} np={size} rank {rank} exited {p.returncode}:\n"
                 + outs[-1])
@@ -965,7 +1057,8 @@ def main() -> None:
     ap.add_argument("--worker",
                     choices=["allreduce", "train", "fixed_compute",
                              "bcast_render", "ragged_allgather",
-                             "overhead", "autotune_value", "cache"])
+                             "overhead", "autotune_value", "cache",
+                             "elastic"])
     ap.add_argument("--rank", type=int)
     ap.add_argument("--size", type=int)
     ap.add_argument("--skip-variants", action="store_true",
@@ -980,6 +1073,12 @@ def main() -> None:
                     help="run just the zero-copy steady-bucket A/B "
                          "(HOROVOD_TPU_ZERO_COPY on/off) and merge it "
                          "into the existing RESULTS_cpu.json")
+    ap.add_argument("--elastic", action="store_true",
+                    help="run just the elastic recovery section "
+                         "(steady us/op before a SIGKILL, the "
+                         "re-rendezvous gap, us/op after the shrink; "
+                         "recovery asserted < 2x heartbeat timeout) "
+                         "and merge it into RESULTS_cpu.json")
     args = ap.parse_args()
 
     if args.worker:
@@ -990,6 +1089,7 @@ def main() -> None:
          "ragged_allgather": worker_ragged_allgather,
          "autotune_value": worker_autotune_value,
          "cache": worker_cache,
+         "elastic": worker_elastic,
          "overhead": worker_overhead}[args.worker](
              args.rank, args.size)
         return
@@ -997,6 +1097,27 @@ def main() -> None:
     np_ = args.np
     cores = os.cpu_count() or 1
     results_path = os.path.join(REPO, "benchmarks", "RESULTS_cpu.json")
+
+    if args.elastic:
+        print(f"== elastic recovery (np={np_} -> {np_ - 1}, SIGKILL "
+              f"at op {ELASTIC_BENCH_KILL_OP}) ==", flush=True)
+        el = _elastic_bench_section(np_)
+        print(f"  pre-kill {el['pre_kill_us_per_op']} us/op   "
+              f"re-rendezvous gap {el['rendezvous_gap_ms']} ms "
+              f"(budget {el['recovery_budget_ms']} ms)   "
+              f"post-shrink {el['post_shrink_us_per_op']} us/op",
+              flush=True)
+        try:
+            with open(results_path) as fh:
+                merged = json.load(fh)
+        except (OSError, ValueError):
+            merged = {}
+        merged["elastic_recovery"] = el
+        with open(results_path, "w") as fh:
+            json.dump(merged, fh, indent=2)
+            fh.write("\n")
+        print(f"merged elastic_recovery into {results_path}")
+        return
 
     if args.steady_only:
         print(f"== zero-copy native data plane A/B (np={np_}, steady "

@@ -68,6 +68,12 @@ from horovod_tpu.common.status import (
     WorldAbortedError,
 )
 
+# Elastic worlds (HOROVOD_ELASTIC=1, docs/fault_tolerance.md):
+# hvd.elastic.State + @hvd.elastic.run make WorldAbortedError a
+# recoverable event — survivors re-rendezvous into a shrunk world and
+# training continues (upstream analog: Elastic Horovod, v0.20).
+from horovod_tpu.common import elastic
+
 __all__ = [
     "HorovodInternalError", "WorldAbortedError",
     "__version__",
@@ -83,4 +89,5 @@ __all__ = [
     "barrier", "poll", "synchronize",
     "Average", "Sum",
     "Compression",
+    "elastic",
 ]

@@ -97,9 +97,30 @@ def init(comm=None, config: Optional[Config] = None,
         elif comm is not None:
             rank, size = comm
             cfg.rank, cfg.size = int(rank), int(size)
+        secret = cfg.secret_key.encode() if cfg.secret_key else b""
+
+        # Elastic worlds (HOROVOD_ELASTIC=1, common/elastic.py): bind
+        # this process's re-rendezvous listener once; a respawned
+        # joiner (HOROVOD_ELASTIC_JOIN=1) instead dials the advertised
+        # coordinator endpoint and blocks until the next rendezvous
+        # barrier admits it with a fresh dense rank.
+        elastic_ctx = None
+        if cfg.elastic_enabled and not isinstance(comm, list):
+            from horovod_tpu.common import elastic as _elastic
+            if cfg.elastic_join:
+                assignment = _elastic.join_world(cfg, secret)
+                cfg.rank = assignment.rank
+                cfg.size = assignment.size
+                cfg.controller_addr = assignment.controller_addr
+                cfg.controller_port = assignment.controller_port
+                cfg.controller_fd = -1
+            if cfg.size > 1 or cfg.size <= 0:
+                elastic_ctx = _elastic.ensure_context(cfg, secret)
+
         size = cfg.size if cfg.size > 0 else 1
         rank = cfg.rank if cfg.rank >= 0 else 0
-        secret = cfg.secret_key.encode() if cfg.secret_key else b""
+        elastic_port = elastic_ctx.port if elastic_ctx is not None \
+            and size > 1 else None
 
         if size == 1:
             controller: Controller = LocalController()
@@ -114,7 +135,8 @@ def init(comm=None, config: Optional[Config] = None,
                                    listener=listener,
                                    hierarchical=cfg.hier_controller,
                                    heartbeat_interval=cfg.heartbeat_interval_s,
-                                   heartbeat_timeout=cfg.heartbeat_timeout_s)
+                                   heartbeat_timeout=cfg.heartbeat_timeout_s,
+                                   elastic_port=elastic_port)
             coord.accept_workers()
             controller = coord
         else:
@@ -126,7 +148,20 @@ def init(comm=None, config: Optional[Config] = None,
                                    cfg.controller_port, secret=secret,
                                    start_timeout=cfg.start_timeout,
                                    heartbeat_interval=cfg.heartbeat_interval_s,
-                                   heartbeat_timeout=cfg.heartbeat_timeout_s)
+                                   heartbeat_timeout=cfg.heartbeat_timeout_s,
+                                   elastic_port=elastic_port)
+
+        # Install the world-identical elastic membership (the
+        # coordinator's broadcast endpoint map) for this generation.
+        endpoints = getattr(controller, "elastic_endpoints", None)
+        if elastic_ctx is not None and endpoints is not None:
+            table = dict(endpoints)
+            host0, port0 = table[0]
+            if not host0:  # the coordinator's own placeholder entry
+                table[0] = (cfg.controller_addr or "127.0.0.1", port0)
+            elastic_ctx.apply_membership(
+                elastic_ctx.membership.generation, controller.rank,
+                controller.size, table)
 
         from horovod_tpu.ops.shm_ops import ShmBackend
         socket_backend = SocketBackend(controller, secret=secret,

@@ -233,6 +233,29 @@ class Config:
     # library is importable; HOROVOD_TPU_NATIVE=0 forces pure-Python.
     native_core: bool = True
 
+    # Elastic worlds (docs/fault_tolerance.md; upstream analog: Elastic
+    # Horovod, v0.20). HOROVOD_ELASTIC=1 makes WorldAbortedError
+    # recoverable: survivors re-rendezvous into a shrunk world within
+    # elastic_window_s seconds (coordinator re-elected from the lowest
+    # surviving rank when rank 0 died), respawned workers rejoin at
+    # the next barrier, and training resumes after an
+    # hvd.elastic.State re-broadcast. Below elastic_min_world members
+    # the job aborts for real. Default OFF: the PR 2 fail-fast
+    # behavior is untouched.
+    elastic_enabled: bool = False
+    elastic_window_s: float = 30.0
+    elastic_min_world: int = 1
+    # Fixed port for this rank's elastic listener (0 = ephemeral). The
+    # launcher pins rank 0's so the join endpoint it advertises to
+    # respawned workers stays stable across resizes.
+    elastic_port: int = 0
+    # Joiner identity (exported by the hvdtpurun --elastic supervision
+    # loop on respawn): dial this elastic endpoint instead of the
+    # normal HOROVOD_CONTROLLER_ADDR/PORT rendezvous.
+    elastic_join: bool = False
+    elastic_join_addr: str = ""
+    elastic_join_port: int = 0
+
     # Elastic/launcher-provided identity (reference: test/common.py:25-57
     # reads OMPI_COMM_WORLD_RANK; we read HOROVOD_RANK/SIZE first).
     rank: int = -1
@@ -319,6 +342,20 @@ class Config:
         c.secret_key = os.environ.get("HOROVOD_SECRET_KEY", "")
         c.start_timeout = _env_float("HOROVOD_START_TIMEOUT", c.start_timeout)
         c.native_core = _env_bool("HOROVOD_TPU_NATIVE", c.native_core)
+        c.elastic_enabled = _env_bool("HOROVOD_ELASTIC",
+                                      c.elastic_enabled)
+        c.elastic_window_s = _env_float("HOROVOD_ELASTIC_WINDOW",
+                                        c.elastic_window_s)
+        c.elastic_min_world = _env_int("HOROVOD_ELASTIC_MIN_WORLD",
+                                       c.elastic_min_world)
+        c.elastic_port = _env_int("HOROVOD_TPU_ELASTIC_PORT",
+                                  c.elastic_port)
+        c.elastic_join = _env_bool("HOROVOD_ELASTIC_JOIN",
+                                   c.elastic_join)
+        c.elastic_join_addr = env_str("HOROVOD_ELASTIC_JOIN_ADDR",
+                                      c.elastic_join_addr)
+        c.elastic_join_port = _env_int("HOROVOD_ELASTIC_JOIN_PORT",
+                                       c.elastic_join_port)
         c.rank = _env_int("HOROVOD_RANK", c.rank)
         c.size = _env_int("HOROVOD_SIZE", c.size)
         c.local_rank = _env_int("HOROVOD_LOCAL_RANK", c.local_rank)

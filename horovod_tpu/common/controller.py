@@ -759,7 +759,8 @@ class TcpCoordinator(Controller):
                  start_timeout: float = 30.0, listener=None,
                  hierarchical: bool = True,
                  heartbeat_interval: float = 5.0,
-                 heartbeat_timeout: float = 30.0):
+                 heartbeat_timeout: float = 30.0,
+                 elastic_port: Optional[int] = None):
         """``listener`` — an already-bound listening socket to adopt
         instead of binding ``port``. Launch layers that must publish
         the coordinator endpoint BEFORE init (Spark rendezvous,
@@ -805,6 +806,12 @@ class TcpCoordinator(Controller):
         # the first steady cycle.
         self._steady_scratch = None
         self._steady_on_idle = None
+        # Elastic membership (common/elastic.py): this rank's elastic
+        # listener port, exchanged in the handshake so every member
+        # learns the full rank -> (host, port) re-rendezvous endpoint
+        # map. None = elastic off; populated by accept_workers.
+        self._elastic_port = elastic_port
+        self.elastic_endpoints: Optional[Dict[int, tuple]] = None
 
     def accept_workers(self) -> None:
         deadline = time.monotonic() + self._start_timeout
@@ -824,11 +831,19 @@ class TcpCoordinator(Controller):
                      f"connected within start timeout; increase "
                      f"HOROVOD_START_TIMEOUT if startup is slow."),
             _validate)
+        elastic_ports: Dict[int, int] = {}
+        peer_ips: Dict[int, str] = {}
         while len(self._channels) < self._size - 1:
             r, hello, ch = next(accepts)
             hostnames[r] = hello["hostname"]
             ch.peer = f"rank {r} ({ch.peer})"
             self._channels[r] = ch
+            if hello.get("elastic_port") is not None:
+                elastic_ports[r] = int(hello["elastic_port"])
+                try:
+                    peer_ips[r] = ch.sock.getpeername()[0]
+                except OSError:
+                    peer_ips[r] = "127.0.0.1"
         # Broadcast the full hostname list so every rank derives the same
         # topology (reference: operations.cc:729-764).
         self.topology = compute_topology(0, hostnames)
@@ -839,8 +854,26 @@ class TcpCoordinator(Controller):
                          - (topo.cross_size - 1))
         hier = (self._hierarchical and topo.cross_size > 1
                 and remote_leaves > 0)
-        blob = json.dumps({"hostnames": hostnames,
-                           "hier": hier}).encode()
+        handshake = {"hostnames": hostnames, "hier": hier}
+        # Elastic endpoint map: only meaningful when EVERY member runs
+        # elastic mode (the knob must be world-uniform, like the cache
+        # knobs); a partial map would leave some ranks unreachable at
+        # re-rendezvous, so it is withheld entirely.
+        if self._elastic_port is not None \
+                and len(elastic_ports) == self._size - 1:
+            handshake["elastic"] = {
+                "coord_port": self._elastic_port,
+                "ports": {str(r): p for r, p in elastic_ports.items()},
+                "ips": {str(r): ip for r, ip in peer_ips.items()},
+            }
+            self.elastic_endpoints = {0: ("", self._elastic_port)}
+            for r, p in elastic_ports.items():
+                self.elastic_endpoints[r] = (peer_ips[r], p)
+        elif self._elastic_port is not None:
+            hlog.warning(
+                "HOROVOD_ELASTIC is not set on every rank; elastic "
+                "re-rendezvous disabled for this world", rank=0)
+        blob = json.dumps(handshake).encode()
         for r, ch in self._channels.items():
             ch.send(blob, TAG_HANDSHAKE)
         self._members = {r: [r] for r in self._channels}
@@ -1410,7 +1443,8 @@ class TcpWorker(Controller):
     def __init__(self, rank: int, size: int, addr: str, port: int,
                  secret: bytes = b"", start_timeout: float = 30.0,
                  heartbeat_interval: float = 5.0,
-                 heartbeat_timeout: float = 30.0):
+                 heartbeat_timeout: float = 30.0,
+                 elastic_port: Optional[int] = None):
         self.coordinator_addr = addr  # rank 0's reachable address
         self._hb_interval = heartbeat_interval
         self._hb_timeout = heartbeat_timeout
@@ -1421,14 +1455,25 @@ class TcpWorker(Controller):
                                    timeout=start_timeout,
                                    retry_deadline=start_timeout)
         self._ch.peer = f"coordinator ({self._ch.peer})"
-        hello = json.dumps({
-            "rank": rank, "hostname": _my_hostname()}).encode()
+        hello_d = {"rank": rank, "hostname": _my_hostname()}
+        if elastic_port is not None:
+            hello_d["elastic_port"] = elastic_port
+        hello = json.dumps(hello_d).encode()
         self._ch.send(hello, TAG_HANDSHAKE)
         tag, payload = self._ch.recv()
         if tag != TAG_HANDSHAKE:
             raise ConnectionError("handshake failed")
         info = json.loads(payload.decode())
         hostnames = info["hostnames"]
+        # Elastic re-rendezvous endpoint map (rank 0's host is the
+        # address this worker dialed — provably reachable from here).
+        self.elastic_endpoints: Optional[Dict[int, tuple]] = None
+        if info.get("elastic") is not None:
+            em = info["elastic"]
+            self.elastic_endpoints = {0: (addr, int(em["coord_port"]))}
+            for r_s, p in em["ports"].items():
+                self.elastic_endpoints[int(r_s)] = \
+                    (em["ips"][r_s], int(p))
         self.topology = compute_topology(rank, hostnames)
         # rank -> loopback channel of each local leaf (local roots only)
         self._children: Dict[int, network.Channel] = {}

@@ -412,11 +412,17 @@ class ResponseCache:
 
     MISS, HIT, INVALID = range(3)
 
-    def __init__(self, capacity: int):
+    def __init__(self, capacity: int, epoch0: int = 0):
+        """``epoch0`` seeds the epoch counter. Elastic worlds
+        (common/elastic.py) seed it from the world GENERATION so a
+        control frame surviving from a pre-resize world mismatches
+        every epoch equality gate and fails fast, instead of silently
+        negotiating against a rebuilt cache that happens to share
+        epoch numbers with the old one."""
         if capacity <= 0:
             raise ValueError("ResponseCache capacity must be positive")
         self.capacity = capacity
-        self.epoch = 0  # hvdlint: world-replicated
+        self.epoch = epoch0  # hvdlint: world-replicated
         # name -> entry, maintained in LRU order (first = oldest)
         self._lru: "OrderedDict[str, _CacheEntry]" = \
             OrderedDict()  # hvdlint: world-replicated

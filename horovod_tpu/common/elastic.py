@@ -1,0 +1,782 @@
+"""Elastic worlds: survive preemption and re-rendezvous instead of
+aborting the job (upstream analog: Elastic Horovod, the v0.20
+fault-tolerance successor of the base system).
+
+PR 2 made peer death FAIL FAST: heartbeats + tree-fanned ABORT turn a
+SIGKILL'd rank into a structured :class:`WorldAbortedError` naming the
+origin on every survivor within the heartbeat deadline. This module
+makes that error RECOVERABLE. With ``HOROVOD_ELASTIC=1``:
+
+1. Every rank binds a small **elastic listener** at init and the
+   controller handshake distributes the full rank -> (host, port)
+   endpoint map (the :class:`Membership` rank table — world-replicated
+   state, installed only from broadcast-identical inputs).
+2. On abort, survivors tear the old runtime down and enter the
+   **re-rendezvous barrier**: the coordinator — or, when rank 0 died,
+   the lowest surviving rank, elected deterministically from the PR 2
+   origin attribution (candidates are swept in ascending old-rank
+   order; a candidate whose elastic listener refuses the dial is dead,
+   because listeners live for the whole process) — collects survivor
+   manifests within ``HOROVOD_ELASTIC_WINDOW`` seconds, re-assigns
+   dense ranks, binds a fresh controller listener and broadcasts a
+   verdict.
+3. Every member re-initializes through the ordinary init path: new
+   controller channels (flat/hierarchical), new backends, and a
+   response cache whose epoch is seeded from the new world GENERATION,
+   so stale frames from the previous world fail fast through the
+   existing epoch machinery (steady predictor, replay plans, fusion
+   arenas and native steady plans all key off that epoch and die with
+   the old runtime).
+4. :func:`run` wraps the training function: it catches
+   ``WorldAbortedError``, drives recovery, restores the
+   :class:`State` to its last commit, re-broadcasts it from the new
+   rank 0 (late rejoiners resync parameters the same way) and resumes.
+   Below ``HOROVOD_ELASTIC_MIN_WORLD`` survivors the job aborts for
+   real. ``HOROVOD_ELASTIC=0`` (the default) leaves the PR 2
+   fail-fast behavior completely untouched.
+
+Rejoins: a respawned process (``HOROVOD_ELASTIC_JOIN=1`` +
+``HOROVOD_ELASTIC_JOIN_ADDR/PORT``, exported by the launcher's
+supervision loop) dials the coordinator's elastic listener and parks a
+join manifest there; the coordinator's background loop notices, fans a
+benign "elastic-resize" abort, and the next barrier admits the joiner
+with a fresh dense rank. A non-coordinator that receives a join dial
+answers with a REDIRECT verdict carrying the current coordinator's
+endpoint, so a launcher only ever needs one stable address.
+
+Threading contract: the context is created under ``basics._lock``
+during init; afterwards the background loop (join poll) and the
+recovery path (which runs strictly after that loop has exited) are the
+only writers, so no module lock is needed.
+"""
+
+from __future__ import annotations
+
+import copy
+import select
+import socket
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from horovod_tpu.common import config as hconfig
+from horovod_tpu.common import faults
+from horovod_tpu.common import logging as hlog
+from horovod_tpu.common import network
+from horovod_tpu.common import wire
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.invariants import world_coherent
+from horovod_tpu.common.status import WorldAbortedError, world_abort_message
+
+# Rendezvous frames ride their own short-lived sockets, framed by
+# network.Channel; the tag value intentionally matches the controller's
+# TAG_HANDSHAKE (1) — both are "identity exchange" frames and the two
+# planes never share a socket.
+RDZV_TAG = 1
+
+# Verdict kinds (wire.serialize_elastic_verdict).
+VERDICT_OK = 0        # assignment: join the new world
+VERDICT_ABORT = 1     # world below the min floor: abort for real
+VERDICT_REDIRECT = 2  # dialed a non-coordinator: retry at (addr, port)
+
+# Manifest kinds (wire.serialize_elastic_manifest).
+MANIFEST_SURVIVOR = 0
+MANIFEST_JOIN = 1
+
+_BARRIER_ACCEPT_SLICE_S = 0.2   # listener accept timeout per sweep
+_MANIFEST_RECV_TIMEOUT_S = 5.0  # a dialer sends its manifest at once
+_SWEEP_PAUSE_S = 0.1            # pause between election sweeps
+_DIAL_TIMEOUT_S = 2.0           # per-candidate connect timeout
+
+
+class Membership:
+    """The world-replicated membership record: who is in the current
+    world, at which generation, and which members past resizes lost.
+    Installed ONLY from broadcast-identical inputs — the coordinator's
+    init-time endpoint map or a rendezvous verdict — so every rank's
+    copy is bit-identical (enforced by hvdlint's world-coherence
+    analyzer through :func:`world_coherent`)."""
+
+    def __init__(self):
+        # new-world rank -> (host, elastic_port) of that member
+        self.rank_table: Dict[int, Tuple[str, int]] = \
+            {}  # hvdlint: world-replicated
+        self.generation = 0  # hvdlint: world-replicated
+        self.size = 0  # hvdlint: world-replicated
+        # "gen:g rank r (host)" per member lost at each resize — the
+        # world-converged view of the launcher's host blacklist
+        self.blacklist: List[str] = []  # hvdlint: world-replicated
+
+    @world_coherent
+    def install(self, generation: int, size: int,
+                rank_table: Dict[int, Tuple[str, int]],
+                lost: Optional[List[str]] = None) -> None:
+        """Adopt a new world membership. Inputs come exclusively from
+        the coordinator's broadcast (handshake endpoint map or
+        rendezvous verdict), identical on every member."""
+        self.rank_table = dict(rank_table)
+        self.generation = generation
+        self.size = size
+        if lost:
+            self.blacklist.extend(lost)
+
+
+class ElasticContext:
+    """Process-global elastic state: the always-bound elastic listener,
+    the membership table, pending join manifests and the counters the
+    metrics plane mirrors. One per process, living across re-inits."""
+
+    def __init__(self, cfg: Config, secret: bytes):
+        self.enabled = True
+        self.window_s = cfg.elastic_window_s
+        self.min_world = max(1, cfg.elastic_min_world)
+        self.secret = secret
+        self.start_timeout = cfg.start_timeout
+        # The elastic listener lives for the whole process: election
+        # treats "connection refused" as proof of death, which is only
+        # sound because a live member is always accept(2)able.
+        self.listener = network.listen(cfg.elastic_port)
+        self.port = self.listener.getsockname()[1]
+        self.membership = Membership()
+        self.rank = -1  # current-generation rank of this process
+        # join manifests parked by the background loop's poll, consumed
+        # by the next rendezvous barrier: [(Channel, manifest dict)]
+        self.pending_joins: List[tuple] = []
+        self.joined_as_rejoiner = False
+        self._join_synced = False
+        # observability (mirrored onto the PR 4 metrics plane)
+        self.resizes = 0            # barriers run by THIS process
+        self.rejoins_admitted = 0   # joiners admitted by THIS process
+        self.last_resize_cause = ""
+        self.last_rendezvous_s = 0.0
+        self._unobserved_rdzv: List[float] = []
+
+    # -- membership ------------------------------------------------------
+    @world_coherent
+    def apply_membership(self, generation: int, rank: int, size: int,
+                         rank_table: Dict[int, Tuple[str, int]],
+                         lost: Optional[List[str]] = None) -> None:
+        """Install a new world view. ``rank_table``/``lost`` are the
+        coordinator's broadcast; ``rank`` is this member's dense rank
+        inside it (per-rank by definition, not replicated)."""
+        self.rank = rank
+        self.membership.install(generation, size, rank_table, lost)
+
+    def world_line(self) -> str:
+        """One status line for the stall report."""
+        m = self.membership
+        line = (f"elastic: generation {m.generation}, "
+                f"world size {m.size}")
+        if self.last_resize_cause:
+            line += f", last resize: {self.last_resize_cause}"
+        if m.blacklist:
+            line += f", lost members: {m.blacklist}"
+        return line
+
+    def take_rendezvous_observations(self) -> List[float]:
+        out, self._unobserved_rdzv = self._unobserved_rdzv, []
+        return out
+
+    # -- join polling (background loop, coordinator + redirectors) -------
+    def poll_joins(self, is_coordinator: bool) -> Optional[str]:
+        """Non-blocking sweep of the elastic listener. The coordinator
+        parks join manifests and returns a resize cause (the caller
+        fans a benign world abort so every member reaches the
+        barrier); any other rank answers with a REDIRECT verdict at
+        the current coordinator's endpoint. Returns None when nothing
+        warrants a resize."""
+        cause = None
+        while True:
+            try:
+                p = select.poll()
+                p.register(self.listener.fileno(), select.POLLIN)
+                if not p.poll(0):
+                    return cause
+                sock, _ = self.listener.accept()
+            except OSError:
+                return cause
+            if not self.membership.rank_table:
+                # Elastic was requested but this world never installed
+                # a membership (mixed knobs withheld the endpoint
+                # map): there is nothing to resize INTO — refuse the
+                # dial instead of letting one stray connection fan an
+                # abort through a healthy world.
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
+            got = self._read_manifest(sock)
+            if got is None:
+                continue
+            ch, m = got
+            if not is_coordinator:
+                coord = self.membership.rank_table.get(0)
+                try:
+                    if coord is not None:
+                        ch.send(wire.serialize_elastic_verdict(
+                            VERDICT_REDIRECT, self.membership.generation,
+                            -1, 0, coord[0], coord[1],
+                            "not the coordinator"), RDZV_TAG)
+                finally:
+                    ch.close()
+                continue
+            self.pending_joins.append((ch, m))
+            kind = ("rejoining" if m["kind"] == MANIFEST_JOIN
+                    else "re-admitting a stale member")
+            cause = (f"elastic-resize: worker {kind} at the next "
+                     f"rendezvous barrier")
+        return cause
+
+    def _read_manifest(self, sock) -> Optional[tuple]:
+        """One manifest frame off a freshly accepted dial; garbage or a
+        dead dialer is dropped without disturbing the world. The
+        dialer's observed peer address overrides the self-reported
+        host — it is the address this process provably can dial
+        back, which is what the rank table is for."""
+        try:
+            sock.settimeout(_MANIFEST_RECV_TIMEOUT_S)
+            ch = network.Channel(sock, self.secret)
+            tag, payload = ch.recv()
+            if tag != RDZV_TAG:
+                raise ConnectionError(f"unexpected tag {tag}")
+            m = wire.parse_elastic_manifest(payload)
+            peer_ip = sock.getpeername()[0]
+            if peer_ip:
+                m["host"] = peer_ip
+            sock.settimeout(None)
+            return ch, m
+        except (ConnectionError, OSError, socket.timeout, ValueError):
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return None
+
+    def close(self) -> None:
+        for ch, _ in self.pending_joins:
+            try:
+                ch.close()
+            except OSError:
+                pass  # stage-guarded: the listener must still close
+        self.pending_joins = []
+        try:
+            self.listener.close()
+        except OSError:
+            pass
+
+
+_ctx: Optional[ElasticContext] = None
+
+
+def context() -> Optional[ElasticContext]:
+    """The live elastic context (None when HOROVOD_ELASTIC is off or
+    init has not run)."""
+    return _ctx
+
+
+def enabled() -> bool:
+    return _ctx is not None
+
+
+def generation() -> int:
+    """Current world generation (0 for the first world and whenever
+    elastic mode is off). The response-cache epoch is seeded from this
+    so control frames of a previous generation fail the existing
+    epoch equality gates instead of silently negotiating."""
+    return 0 if _ctx is None else _ctx.membership.generation
+
+
+def ensure_context(cfg: Config, secret: bytes) -> ElasticContext:
+    """Create (once per process) the elastic context. Called from
+    basics.init under its init lock."""
+    global _ctx
+    if _ctx is None:
+        _ctx = ElasticContext(cfg, secret)
+    return _ctx
+
+
+def reset() -> None:
+    """Test hook: drop the process-global context."""
+    global _ctx
+    if _ctx is not None:
+        _ctx.close()
+    _ctx = None
+
+
+def my_endpoint_port() -> Optional[int]:
+    return None if _ctx is None else _ctx.port
+
+
+# -- rendezvous barrier ------------------------------------------------------
+
+def _fatal_abort(reason: str) -> WorldAbortedError:
+    """A TERMINAL elastic failure (window expired, world below the
+    floor): :func:`run` must propagate it instead of attempting yet
+    another recovery round."""
+    err = WorldAbortedError(world_abort_message(-1, reason),
+                            origin_rank=-1, cause=reason)
+    err.elastic_fatal = True
+    return err
+
+
+class _Assignment:
+    """What a member leaves the barrier with: enough to re-init."""
+
+    __slots__ = ("generation", "rank", "size", "controller_addr",
+                 "controller_port", "listener", "cause", "lost",
+                 "coord_elastic_port")
+
+    def __init__(self, generation: int, rank: int, size: int,
+                 controller_addr: str, controller_port: int,
+                 listener=None, cause: str = "", lost=None,
+                 coord_elastic_port: int = 0):
+        self.generation = generation
+        self.rank = rank
+        self.size = size
+        self.controller_addr = controller_addr
+        self.controller_port = controller_port
+        self.listener = listener  # pre-bound controller listener (rank 0)
+        self.cause = cause
+        self.lost = lost or []
+        # The new coordinator's ELASTIC listener: a follower whose
+        # re-init fails can re-enter recovery against it even before
+        # the full endpoint map arrives via the init handshake.
+        self.coord_elastic_port = coord_elastic_port
+
+
+def _coordinate_barrier(ctx: ElasticContext, cause: str,
+                        deadline: float, dead: set) -> _Assignment:
+    """Run the re-rendezvous barrier as the elected coordinator:
+    collect survivor manifests (and pending joins) until everyone
+    expected arrived or the window expires, re-assign dense ranks,
+    bind a fresh controller listener and broadcast the verdict."""
+    t0 = time.monotonic()
+    table = ctx.membership.rank_table
+    my_host = table.get(ctx.rank, ("127.0.0.1", ctx.port))[0]
+    expected = {r for r in table
+                if r not in dead and r != ctx.rank}
+    # old_rank -> (manifest, channel|None); joiners keyed separately
+    members: Dict[int, tuple] = {
+        ctx.rank: ({"kind": MANIFEST_SURVIVOR, "gen":
+                    ctx.membership.generation, "old_rank": ctx.rank,
+                    "host": my_host, "elastic_port": ctx.port}, None)}
+    joiners: List[tuple] = []
+
+    def _admit(m: dict, ch) -> None:
+        """One classification for parked AND freshly accepted
+        manifests: a current-generation survivor takes its expected
+        slot (it may have dialed EARLY — before this coordinator's
+        own abort — and been parked by the join poll); everything
+        else (a joiner, a stale-generation straggler, a duplicate) is
+        admitted as a fresh member at the tail."""
+        if (m["kind"] == MANIFEST_SURVIVOR
+                and m["gen"] == ctx.membership.generation
+                and m["old_rank"] in expected
+                and m["old_rank"] not in members):
+            members[m["old_rank"]] = (m, ch)
+        else:
+            joiners.append((m, ch))
+
+    pending, ctx.pending_joins = ctx.pending_joins, []
+    for ch, m in pending:
+        _admit(m, ch)
+    ctx.listener.settimeout(_BARRIER_ACCEPT_SLICE_S)
+    try:
+        while time.monotonic() < deadline and expected - set(members):
+            try:
+                sock, _ = ctx.listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            got = ctx._read_manifest(sock)
+            if got is None:
+                continue
+            _admit(got[1], got[0])
+    finally:
+        ctx.listener.settimeout(None)
+
+    survivors = sorted(members)
+    lost = [f"gen:{ctx.membership.generation} rank {r} "
+            f"({table[r][0]})"
+            for r in sorted(set(table) - set(survivors))]
+    new_size = len(survivors) + len(joiners)
+    gen2 = ctx.membership.generation + 1
+    if new_size < ctx.min_world:
+        reason = (f"elastic world shrank to {new_size} member(s), "
+                  f"below HOROVOD_ELASTIC_MIN_WORLD="
+                  f"{ctx.min_world} (after: {cause})")
+        for _, ch in list(members.values()) + joiners:
+            if ch is None:
+                continue
+            try:
+                ch.send(wire.serialize_elastic_verdict(
+                    VERDICT_ABORT, gen2, -1, new_size, "", 0, reason),
+                    RDZV_TAG)
+            except (ConnectionError, OSError):
+                pass
+            ch.close()
+        raise _fatal_abort(reason)
+
+    listener = network.listen(0)
+    port = listener.getsockname()[1]
+    new_ranks: List[tuple] = []  # (new_rank, manifest, channel)
+    for i, r in enumerate(survivors):
+        m, ch = members[r]
+        new_ranks.append((i, m, ch))
+    for j, (m, ch) in enumerate(joiners):
+        new_ranks.append((len(survivors) + j, m, ch))
+    table2 = {nr: (m["host"], m["elastic_port"])
+              for nr, m, _ in new_ranks}
+    for nr, _, ch in new_ranks:
+        if ch is None:
+            continue  # self
+        try:
+            ch.send(wire.serialize_elastic_verdict(
+                VERDICT_OK, gen2, nr, new_size, my_host, port, cause,
+                lost=lost, joined=len(joiners),
+                coord_elastic_port=ctx.port), RDZV_TAG)
+        except (ConnectionError, OSError):
+            # died between manifest and verdict: it will come back (or
+            # not) through the join path; the new world forms without
+            # waiting — a second resize re-admits it.
+            pass
+        ch.close()
+    ctx.resizes += 1
+    ctx.rejoins_admitted += len(joiners)
+    ctx.last_resize_cause = cause
+    ctx.last_rendezvous_s = time.monotonic() - t0
+    ctx.apply_membership(gen2, 0, new_size, table2, lost=lost)
+    hlog.warning(
+        f"elastic re-rendezvous complete: generation {gen2}, "
+        f"{len(survivors)} survivor(s) + {len(joiners)} rejoin(s) "
+        f"-> world size {new_size} "
+        f"({ctx.last_rendezvous_s * 1000:.0f} ms barrier); "
+        f"cause: {cause}", rank=ctx.rank)
+    return _Assignment(gen2, 0, new_size, my_host, port,
+                       listener=listener, cause=cause,
+                       coord_elastic_port=ctx.port)
+
+
+def _follow_barrier(ctx: ElasticContext, candidate: int,
+                    deadline: float, kind: int = MANIFEST_SURVIVOR,
+                    endpoint: Optional[Tuple[str, int]] = None):
+    """Dial ``candidate``'s elastic listener, park a manifest, await
+    the verdict. Returns an _Assignment, the string "dead" (dial
+    REFUSED, or the accepted channel died mid-barrier: exclude and
+    move on), the string "retry" (dial timed out / host unreachable:
+    the candidate may be alive-but-unresponsive, so the election must
+    NOT step past it — self-electing on a timeout would split the
+    brain; the sweep restarts and a truly lost world ends at the
+    window), or a (host, port) redirect target."""
+    host, port = endpoint if endpoint is not None \
+        else ctx.membership.rank_table[candidate]
+    try:
+        sock = socket.create_connection((host, port),
+                                        timeout=_DIAL_TIMEOUT_S)
+    except ConnectionRefusedError:
+        # The listener lives for the candidate's whole process life:
+        # an active refusal is proof of death — the invariant the
+        # deterministic election rests on.
+        return "dead"
+    except (OSError, socket.timeout):
+        return "retry"
+    sock.settimeout(None)
+    ch = network.Channel(sock, ctx.secret, peer=f"{host}:{port}")
+    try:
+        me = ctx.membership.rank_table.get(ctx.rank)
+        my_host = me[0] if me is not None else "127.0.0.1"
+        ch.send(wire.serialize_elastic_manifest(
+            kind, ctx.membership.generation, ctx.rank, my_host,
+            ctx.port), RDZV_TAG)
+        # The verdict arrives only when the barrier closes — wait out
+        # the remaining window plus slack for the coordinator's own
+        # teardown/window.
+        wait = max(1.0, deadline - time.monotonic()) + ctx.window_s \
+            + 5.0
+        ch.sock.settimeout(wait)
+        tag, payload = ch.recv()
+        if tag != RDZV_TAG:
+            raise ConnectionError(f"unexpected tag {tag}")
+        v = wire.parse_elastic_verdict(payload)
+    except (ConnectionError, OSError, socket.timeout):
+        return "dead"
+    finally:
+        ch.close()
+    if v["verdict"] == VERDICT_ABORT:
+        raise _fatal_abort(v["cause"])
+    if v["verdict"] == VERDICT_REDIRECT:
+        return (v["addr"], v["port"])
+    return _Assignment(v["gen"], v["rank"], v["size"], v["addr"],
+                       v["port"], cause=v["cause"], lost=v["lost"],
+                       coord_elastic_port=v["coord_elastic_port"])
+
+
+def rendezvous(origin_rank: int, cause: str) -> _Assignment:
+    """The re-rendezvous barrier, entered by every survivor after the
+    old runtime is torn down. Election is deterministic: candidates
+    are swept in ascending old-rank order, skipping ranks known dead
+    (the PR 2 origin attribution plus refused dials); the first live
+    candidate is the coordinator — each process that reaches its own
+    rank in the sweep coordinates, everyone else follows."""
+    ctx = _ctx
+    assert ctx is not None
+    t0 = time.monotonic()
+    faults.tick_rendezvous(ctx.rank)
+    dead = set()
+    if origin_rank is not None and origin_rank >= 0:
+        dead.add(origin_rank)
+    deadline = t0 + ctx.window_s
+    while time.monotonic() < deadline:
+        cands = [r for r in sorted(ctx.membership.rank_table)
+                 if r not in dead]
+        if ctx.rank not in cands:
+            break  # everyone else presumed dead would still include us
+        restart_sweep = False
+        for c in cands:
+            if c == ctx.rank:
+                a = _coordinate_barrier(ctx, cause, deadline, dead)
+                ctx.last_rendezvous_s = time.monotonic() - t0
+                ctx._unobserved_rdzv.append(ctx.last_rendezvous_s)
+                return a
+            res = _follow_barrier(ctx, c, deadline)
+            if res == "dead":
+                dead.add(c)
+                continue
+            if res == "retry" or isinstance(res, tuple):
+                # REDIRECT (the candidate is alive but has not entered
+                # recovery yet — its runtime answered the dial) or an
+                # ambiguous timeout (alive-but-unresponsive?). Either
+                # way the candidate may still be the rightful
+                # coordinator — restarting the sweep, never falling
+                # through past it, is what keeps the election
+                # split-brain-free; a truly lost world ends at the
+                # window expiry instead.
+                restart_sweep = True
+                break
+            ctx.last_resize_cause = cause
+            ctx.last_rendezvous_s = time.monotonic() - t0
+            ctx._unobserved_rdzv.append(ctx.last_rendezvous_s)
+            ctx.apply_membership(res.generation, res.rank, res.size,
+                                 _table_placeholder(res, ctx),
+                                 lost=res.lost)
+            hlog.warning(
+                f"elastic re-rendezvous complete: generation "
+                f"{res.generation}, new rank {res.rank} of "
+                f"{res.size} ({ctx.last_rendezvous_s * 1000:.0f} ms); "
+                f"cause: {cause}", rank=res.rank)
+            return res
+        if restart_sweep:
+            time.sleep(_SWEEP_PAUSE_S)
+    reason = (f"elastic re-rendezvous failed within "
+              f"HOROVOD_ELASTIC_WINDOW={ctx.window_s:g}s "
+              f"(no live coordinator candidate; after: {cause})")
+    raise _fatal_abort(reason)
+
+
+def _table_placeholder(a: _Assignment,
+                       ctx: ElasticContext
+                       ) -> Dict[int, Tuple[str, int]]:
+    """A follower's rank table between verdict and re-init: the new
+    coordinator's DIALABLE elastic endpoint plus this member's own —
+    enough that a failure during re-init (another member dying before
+    the handshake completes) can run a further recovery round instead
+    of finding no candidates. The full map is installed from the init
+    handshake moments later."""
+    table = {0: (a.controller_addr, a.coord_elastic_port)}
+    if a.rank != 0:
+        me = ctx.membership.rank_table.get(ctx.rank)
+        table[a.rank] = (me[0] if me is not None else "127.0.0.1",
+                         ctx.port)
+    return table
+
+
+def join_world(cfg: Config, secret: bytes) -> _Assignment:
+    """Joiner path (HOROVOD_ELASTIC_JOIN=1): dial the advertised
+    coordinator endpoint, park a join manifest, follow redirects, and
+    wait for the next rendezvous barrier to admit us."""
+    ctx = ensure_context(cfg, secret)
+    addr = cfg.elastic_join_addr or cfg.controller_addr or "127.0.0.1"
+    port = cfg.elastic_join_port
+    if port <= 0:
+        raise ValueError(
+            "HOROVOD_ELASTIC_JOIN=1 needs HOROVOD_ELASTIC_JOIN_PORT "
+            "(the coordinator's elastic listener; the hvdtpurun "
+            "--elastic supervision loop exports it)")
+    deadline = time.monotonic() + max(cfg.elastic_window_s,
+                                      cfg.start_timeout)
+    ctx.rank = -1
+    target = (addr, port)
+    delays = network.backoff_delays(base=0.1, cap=1.0)
+    while time.monotonic() < deadline:
+        res = _follow_barrier(ctx, -1, deadline, kind=MANIFEST_JOIN,
+                              endpoint=target)
+        if isinstance(res, _Assignment):
+            ctx.joined_as_rejoiner = True
+            ctx.last_resize_cause = res.cause
+            ctx.apply_membership(res.generation, res.rank, res.size,
+                                 _table_placeholder(res, ctx),
+                                 lost=res.lost)
+            return res
+        if isinstance(res, tuple):
+            target = res  # redirect to the live coordinator
+            continue
+        time.sleep(min(next(delays),
+                       max(0.0, deadline - time.monotonic())))
+    raise WorldAbortedError(
+        world_abort_message(-1, "elastic join timed out"),
+        origin_rank=-1,
+        cause=(f"could not join an elastic world at {target[0]}:"
+               f"{target[1]} within the window"))
+
+
+# -- user-facing API ---------------------------------------------------------
+
+class State:
+    """Training state carried across resizes: parameters, optimizer
+    state, batch/epoch counters — anything numpy-shaped or scalar.
+
+    ``commit()`` snapshots, ``restore()`` rolls back to the last
+    commit (survivors roll back work the dead rank never contributed
+    to), and ``sync()`` broadcasts every value from rank 0 of the new
+    world so survivors and late rejoiners resume bit-identical."""
+
+    def __init__(self, **values):
+        object.__setattr__(self, "_values", dict(values))
+        object.__setattr__(self, "_committed", copy.deepcopy(values))
+
+    def __getattr__(self, name):
+        try:
+            return object.__getattribute__(self, "_values")[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def __setattr__(self, name, value):
+        object.__getattribute__(self, "_values")[name] = value
+
+    def commit(self) -> None:
+        object.__setattr__(self, "_committed",
+                           copy.deepcopy(object.__getattribute__(
+                               self, "_values")))
+
+    def restore(self) -> None:
+        object.__setattr__(self, "_values",
+                           copy.deepcopy(object.__getattribute__(
+                               self, "_committed")))
+
+    def sync(self) -> None:
+        """Broadcast every value from rank 0 (deterministic key order
+        on every member) and commit the result. New members pass
+        same-shaped placeholders constructed by their own user code —
+        the broadcast overwrites them."""
+        from horovod_tpu import ops
+        vals = object.__getattribute__(self, "_values")
+        gen = generation()
+        for key in sorted(vals):
+            v = vals[key]
+            out = ops.broadcast(np.asarray(v), root_rank=0,
+                                name=f"elastic.sync.g{gen}.{key}")
+            if isinstance(v, np.ndarray):
+                vals[key] = out
+            elif isinstance(v, (bool, int, float)) or np.isscalar(v):
+                vals[key] = type(v)(out.item())
+            else:
+                vals[key] = out
+        self.commit()
+
+
+def _recover(err: WorldAbortedError) -> None:
+    """Tear the dead runtime down, re-rendezvous, re-init, done.
+    Raises the (possibly new) WorldAbortedError when the world cannot
+    be re-formed."""
+    from horovod_tpu.common import basics
+    ctx = _ctx
+    if not ctx.membership.rank_table:
+        # Elastic was requested but the world never exchanged an
+        # endpoint map (mixed knobs, size-1 world): fail fast —
+        # terminally, there is no membership to recover with.
+        err.elastic_fatal = True
+        raise err
+    origin = getattr(err, "origin_rank", -1)
+    cause = getattr(err, "cause", str(err))
+    hlog.warning(
+        f"elastic recovery engaged (origin rank {origin}): {cause}",
+        rank=ctx.rank)
+    basics.shutdown()
+    assignment = rendezvous(origin, cause)
+    cfg = Config.from_env()
+    cfg.elastic_join = False  # a member re-inits, it does not re-join
+    cfg.rank = assignment.rank
+    cfg.size = assignment.size
+    cfg.controller_addr = assignment.controller_addr
+    cfg.controller_port = assignment.controller_port
+    cfg.controller_fd = -1
+    basics.init(config=cfg,
+                coordinator_listener=assignment.listener)
+
+
+def run(func):
+    """Decorator making a training function elastic::
+
+        state = hvd.elastic.State(params=..., batch=0)
+
+        @hvd.elastic.run
+        def train(state):
+            while state.batch < total:
+                step(state); state.batch += 1; state.commit()
+
+        train(state)
+
+    On :class:`WorldAbortedError` the wrapper re-rendezvouses the
+    survivors into a shrunk world (or admits rejoiners into a grown
+    one), restores ``state`` to its last commit, re-broadcasts it from
+    the new rank 0 and calls ``func`` again. With elastic mode off the
+    error propagates unchanged — today's fail-fast behavior."""
+
+    def wrapper(state: State, *args, **kwargs):
+        ctx = _ctx
+        if ctx is not None and ctx.joined_as_rejoiner \
+                and not ctx._join_synced:
+            # A joiner's first act is the SAME State broadcast the
+            # survivors run at the end of their recovery — parameters
+            # and counters arrive from rank 0 before any training.
+            ctx._join_synced = True
+            state.sync()
+        while True:
+            try:
+                return func(state, *args, **kwargs)
+            except WorldAbortedError as e:
+                if _ctx is None:
+                    raise
+                err = e
+                # Recovery may itself be interrupted — another member
+                # dying during state.sync() or between the verdict and
+                # re-init surfaces as a fresh abort/transport error,
+                # and the answer is another recovery round, not death.
+                # Only a TERMINAL failure (_fatal_abort: rendezvous
+                # window expired, world below the min floor)
+                # propagates; a truly lost world always reaches one,
+                # because every retry re-runs the bounded rendezvous.
+                while True:
+                    try:
+                        _recover(err)
+                        state.restore()
+                        state.sync()
+                        break
+                    except WorldAbortedError as e2:
+                        if getattr(e2, "elastic_fatal", False):
+                            raise
+                        err = e2
+                    except (ConnectionError, OSError,
+                            TimeoutError) as e2:
+                        cause = (f"world re-initialization failed: "
+                                 f"{e2}")
+                        err = WorldAbortedError(
+                            world_abort_message(-1, cause),
+                            origin_rank=-1, cause=cause)
+
+    wrapper.__name__ = getattr(func, "__name__", "elastic_run")
+    wrapper.__doc__ = func.__doc__
+    return wrapper

@@ -19,10 +19,16 @@ without code changes::
 
 Grammar: directives separated by ``;``; each directive is ``:``-joined
 tokens — one bare action word plus ``key=value`` arguments. ``rank``
-scopes the directive to one global rank (absent = every rank). Exactly
+scopes the directive to one global rank — the LAUNCH-TIME identity
+(HOROVOD_RANK), which stays stable even when an elastic resize
+renumbers the survivors (absent = every rank). Exactly
 one trigger is required: ``cycle=K`` fires at the K-th negotiation
 cycle, ``op=K`` fires just before the K-th executed response (i.e.
-after negotiation, squarely mid-collective).
+after negotiation, squarely mid-collective), and ``rdzv=K`` fires on
+entry to this process's K-th elastic re-rendezvous barrier
+(common/elastic.py) — the double-fault case: a member dying DURING
+recovery. ``rank`` in an ``rdzv`` directive matches the member's rank
+in the world that just aborted.
 
 Actions:
 
@@ -57,24 +63,33 @@ _ACTIONS = ("kill", "exit", "hang", "sever", "delay")
 class Fault:
     """One armed fault directive."""
 
-    __slots__ = ("action", "rank", "at_cycle", "at_op", "seconds", "ms",
-                 "code", "target", "fired")
+    __slots__ = ("action", "rank", "at_cycle", "at_op", "at_rdzv",
+                 "seconds", "ms", "code", "target", "fired")
 
     def __init__(self, action: str, rank: Optional[int] = None,
                  at_cycle: Optional[int] = None,
-                 at_op: Optional[int] = None, seconds: float = 60.0,
+                 at_op: Optional[int] = None,
+                 at_rdzv: Optional[int] = None, seconds: float = 60.0,
                  ms: float = 0.0, code: int = 1,
                  target: Optional[int] = None):
         if action not in _ACTIONS:
             raise ValueError(f"unknown fault action {action!r}; "
                              f"expected one of {_ACTIONS}")
-        if (at_cycle is None) == (at_op is None):
+        triggers = [t for t in (at_cycle, at_op, at_rdzv)
+                    if t is not None]
+        if len(triggers) != 1:
             raise ValueError(
-                "a fault needs exactly one trigger: at_cycle= or at_op=")
+                "a fault needs exactly one trigger: at_cycle=, at_op= "
+                "or at_rdzv=")
+        if action == "sever" and at_rdzv is not None:
+            raise ValueError(
+                "sever has no channel to cut during re-rendezvous; "
+                "use kill/exit/hang/delay with rdzv=")
         self.action = action
         self.rank = rank
         self.at_cycle = at_cycle
         self.at_op = at_op
+        self.at_rdzv = at_rdzv
         self.seconds = seconds
         self.ms = ms
         self.code = code
@@ -82,8 +97,12 @@ class Fault:
         self.fired = False
 
     def __repr__(self) -> str:
-        trig = (f"cycle={self.at_cycle}" if self.at_cycle is not None
-                else f"op={self.at_op}")
+        if self.at_cycle is not None:
+            trig = f"cycle={self.at_cycle}"
+        elif self.at_op is not None:
+            trig = f"op={self.at_op}"
+        else:
+            trig = f"rdzv={self.at_rdzv}"
         scope = "*" if self.rank is None else self.rank
         return f"Fault({self.action}@{trig}, rank={scope})"
 
@@ -116,6 +135,8 @@ def parse_spec(spec: str) -> List[Fault]:
                     kw["at_cycle"] = int(v)
                 elif k == "op":
                     kw["at_op"] = int(v)
+                elif k == "rdzv":
+                    kw["at_rdzv"] = int(v)
                 elif k == "seconds":
                     kw["seconds"] = float(v)
                 elif k == "ms":
@@ -152,9 +173,10 @@ def install(action: str, rank: Optional[int] = None,
 
 
 def clear() -> None:
-    global _PLAN, _ENV_LOADED
+    global _PLAN, _ENV_LOADED, _RDZV_COUNT
     _PLAN = None
     _ENV_LOADED = False
+    _RDZV_COUNT = 0
 
 
 def load_env() -> None:
@@ -172,9 +194,13 @@ def load_env() -> None:
     _PLAN.extend(parsed)
 
 
-def _apply(fault: Fault, runtime) -> None:
+def _apply(fault: Fault, runtime, rank: Optional[int] = None) -> None:
+    """``runtime`` may be None for rendezvous-triggered faults (the
+    old runtime is already torn down there); ``rank`` then labels the
+    log line."""
     fault.fired = True
-    rank = runtime.controller.rank
+    if rank is None:
+        rank = runtime.controller.rank
     hlog.warning(f"fault injection firing on rank {rank}: {fault!r}",
                  rank=rank)
     if fault.action == "kill":
@@ -185,12 +211,18 @@ def _apply(fault: Fault, runtime) -> None:
         time.sleep(fault.seconds)
     elif fault.action == "delay":
         time.sleep(fault.ms / 1000.0)
-    elif fault.action == "sever":
+    elif fault.action == "sever" and runtime is not None:
         runtime.controller.sever_connection(fault.target)
 
 
 def _tick(runtime, cycle: Optional[int], op: Optional[int]) -> None:
-    rank = runtime.controller.rank
+    # Scope on the LAUNCH-TIME identity (HOROVOD_RANK), not the
+    # current controller rank: an elastic resize renumbers survivors
+    # densely, and a directive for "rank 0" must keep meaning the
+    # process the launcher started as rank 0 — not whoever inherited
+    # that rank after a re-election (which would make every newly
+    # promoted coordinator re-fire a spent coordinator-kill fault).
+    rank = hconfig.env_int("HOROVOD_RANK", runtime.controller.rank)
     for f in _PLAN:  # type: ignore[union-attr]
         if f.fired or (f.rank is not None and f.rank != rank):
             continue
@@ -213,3 +245,24 @@ def tick_op(runtime, op_index: int) -> None:
     if _PLAN is None:
         return
     _tick(runtime, None, op_index)
+
+
+_RDZV_COUNT = 0
+
+
+def tick_rendezvous(rank: int) -> None:
+    """Called by common/elastic.py on entry to each re-rendezvous
+    barrier, so a fault can land squarely DURING recovery (the
+    double-fault case). ``rank`` is this member's rank in the world
+    that just aborted."""
+    global _RDZV_COUNT
+    _RDZV_COUNT += 1
+    if _PLAN is None:
+        return
+    rank = hconfig.env_int("HOROVOD_RANK", rank)  # launch identity
+    for f in _PLAN:
+        if f.fired or f.at_rdzv is None \
+                or (f.rank is not None and f.rank != rank):
+            continue
+        if _RDZV_COUNT >= f.at_rdzv:
+            _apply(f, None, rank=rank)

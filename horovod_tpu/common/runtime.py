@@ -26,6 +26,7 @@ from collections import OrderedDict
 from typing import Dict, List, Optional
 
 from horovod_tpu.common import arena as harena
+from horovod_tpu.common import elastic as helastic
 from horovod_tpu.common import faults
 from horovod_tpu.common import lockdep
 from horovod_tpu.common import logging as hlog
@@ -99,6 +100,7 @@ class Runtime:
             op_manager.attach_finalizer(self.finalizer)
         self._shutdown_requested = threading.Event()
         self._done = threading.Event()
+        self._teardown_started = False
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[Exception] = None
         # (origin_rank, cause) once the world has aborted: handles that
@@ -132,7 +134,14 @@ class Runtime:
         # the frame kinds and epochs fail fast on divergence.
         self._cache: Optional[ResponseCache] = None
         if config.cache_enabled and config.cache_capacity > 0:
-            self._cache = ResponseCache(config.cache_capacity)
+            # Elastic worlds seed the epoch from the world generation:
+            # every post-resize rank starts at the SAME (bumped) epoch,
+            # so the response cache, steady predictor, replay plans
+            # and native steady plans of the old world all invalidate
+            # through the existing epoch machinery.
+            self._cache = ResponseCache(
+                config.cache_capacity,
+                epoch0=helastic.generation() << 32)
         # name -> (signature, dtype, slice_numel) recorded when a
         # cacheable request is sent the FULL way; consumed when its
         # negotiated response comes back and populates the cache.
@@ -284,6 +293,27 @@ class Runtime:
             "hvd_lockcheck_inversions_total",
             "lock-order inversions observed by the runtime lockdep "
             "(HOROVOD_TPU_LOCKCHECK; 0 when unarmed)")
+        # -- elastic worlds (HOROVOD_ELASTIC, common/elastic.py) -----
+        # The context survives re-inits; each new Runtime generation
+        # mirrors its counters so resize history rides the PR 4 plane.
+        self._elastic = helastic.context()
+        self._elastic_last_poll = 0.0
+        self._m_world_size = reg.gauge(
+            "hvd_world_size",
+            "current world size (max-aggregated: the world view IS "
+            "the size)", agg=hmetrics.AGG_MAX)
+        self._m_world_resizes = reg.counter(
+            "hvd_world_resizes_total",
+            "elastic re-rendezvous barriers run by this rank as the "
+            "(elected) coordinator")
+        self._m_elastic_rejoins = reg.counter(
+            "hvd_elastic_rejoins_total",
+            "workers admitted into a resized world by this rank's "
+            "rendezvous barriers")
+        self._m_rdzv_s = reg.histogram(
+            "hvd_elastic_rendezvous_seconds",
+            "wall time from entering elastic recovery to holding a "
+            "new world assignment")
         # The fused speculative cycle bypasses OperationManager, so the
         # runtime owns its share of the allreduce op/byte totals (the
         # registry memoizes by name — these are the SAME counters the
@@ -498,59 +528,84 @@ class Runtime:
             hlog.error(f"horovod_tpu background loop failed: {e!r}",
                        rank=self.controller.rank)
         finally:
-            self._done.set()
-            # Teardown stages are individually guarded: a raising
-            # finalizer drain or user completion callback must not
-            # skip the stages after it — in particular the timeline
-            # flush, or the trace of exactly the aborted runs you most
-            # want to inspect is left an unterminated JSON fragment.
+            self._teardown()
+
+    def _teardown(self) -> None:
+        """Tear the runtime down — re-entrant AND stage-guarded.
+
+        Re-entrant: the background loop's ``finally`` calls this, and
+        elastic recovery (common/elastic.py) may call it again while
+        draining a dead world; a SECOND abort raised during recovery
+        (e.g. a WorldAbortedError surfacing from a native
+        hvd_steady_worker/hvd_steady_coord teardown path) must find a
+        no-op here, not a half-closed runtime whose finalizer drain
+        wedges on re-entry. Stage-guarded: a raising finalizer drain
+        or user completion callback must not skip the stages after it
+        — in particular the timeline flush, or the trace of exactly
+        the aborted runs you most want to inspect is left an
+        unterminated JSON fragment."""
+        if getattr(self, "_teardown_started", False):
+            return
+        self._teardown_started = True
+        self._done.set()
+        # Native steady state first: the plans' cached ctypes bundles
+        # bind file descriptors and arena generations of the world
+        # that just died — drop them before anything that could raise,
+        # so a resumed (elastic) process can never replay a stale
+        # plan against rebuilt channels.
+        try:
+            self._spec_steady = None
+            self._spec_inflight = None
+            self._steady_plans.clear()
+        except Exception:
+            pass  # stage-guarded: the finalizer must still drain
+        try:
+            # Drain in-flight async completions first so every
+            # issued collective fires its real status, then fail
+            # what was never issued (reference:
+            # operations.cc:898-913).
+            if self.finalizer is not None:
+                self.finalizer.drain()
+        except Exception as e:
+            hlog.warning(f"finalizer drain failed at shutdown: "
+                         f"{e!r}", rank=self.controller.rank)
+        terminal = self._terminal_status()
+        for entry in self.tensor_table.pop_all():
+            if entry.callback:
+                try:
+                    entry.callback(terminal)
+                except Exception:
+                    pass  # user callback; teardown must continue
+        try:
+            self.timeline.shutdown()
+        except Exception:
+            pass
+        if self._aggregator is not None \
+                and self._metrics_log is not None:
+            # Final JSONL line with rank 0's own totals exact and
+            # every owner's last-received frame folded in (workers
+            # tear down concurrently, so their tail interval is
+            # inherently best-effort — the log is a sampled view;
+            # live exactness is the API/endpoint's job).
             try:
-                # Drain in-flight async completions first so every
-                # issued collective fires its real status, then fail
-                # what was never issued (reference:
-                # operations.cc:898-913).
-                if self.finalizer is not None:
-                    self.finalizer.drain()
-            except Exception as e:
-                hlog.warning(f"finalizer drain failed at shutdown: "
-                             f"{e!r}", rank=self.controller.rank)
-            terminal = self._terminal_status()
-            for entry in self.tensor_table.pop_all():
-                if entry.callback:
-                    try:
-                        entry.callback(terminal)
-                    except Exception:
-                        pass  # user callback; teardown must continue
-            try:
-                self.timeline.shutdown()
+                self._aggregator.update_local(
+                    self.metrics.snapshot())
+                self._metrics_log.append(self._aggregator.world())
             except Exception:
                 pass
-            if self._aggregator is not None \
-                    and self._metrics_log is not None:
-                # Final JSONL line with rank 0's own totals exact and
-                # every owner's last-received frame folded in (workers
-                # tear down concurrently, so their tail interval is
-                # inherently best-effort — the log is a sampled view;
-                # live exactness is the API/endpoint's job).
-                try:
-                    self._aggregator.update_local(
-                        self.metrics.snapshot())
-                    self._metrics_log.append(self._aggregator.world())
-                except Exception:
-                    pass
-            if self._metrics_http is not None:
-                try:
-                    self._metrics_http.close()
-                except Exception:
-                    pass  # stage-guarded: backends must still close
+        if self._metrics_http is not None:
             try:
-                self.op_manager.close()
+                self._metrics_http.close()
             except Exception:
-                pass  # stage-guarded: the controller must still close
-            try:
-                self.controller.close()
-            except Exception:
-                pass
+                pass  # stage-guarded: backends must still close
+        try:
+            self.op_manager.close()
+        except Exception:
+            pass  # stage-guarded: the controller must still close
+        try:
+            self.controller.close()
+        except Exception:
+            pass
 
     _IDLE_GRACE = 16  # empty cycles before the backoff ramp starts
 
@@ -852,6 +907,22 @@ class Runtime:
         t0 = time.monotonic()
         self._cycle_count += 1
         faults.tick_cycle(self, self._cycle_count)
+        if self._elastic is not None \
+                and t0 - self._elastic_last_poll >= 0.25:
+            # Elastic join sweep: the coordinator parks any join
+            # manifest waiting on its elastic listener and fans a
+            # benign world abort so every member reaches the
+            # re-rendezvous barrier (where the joiner is admitted);
+            # other ranks answer stray dials with a redirect to the
+            # current coordinator. Four syscalls a second when idle.
+            self._elastic_last_poll = t0
+            cause = self._elastic.poll_joins(self.controller.rank == 0)
+            if cause is not None:
+                err = WorldAbortedError(
+                    world_abort_message(-1, cause), origin_rank=-1,
+                    cause=cause)
+                err.resolved = True  # deliberate: skip the drain
+                raise err
         self.timeline.mark_cycle_start()
 
         requests = self.tensor_table.pop_messages()
@@ -871,7 +942,16 @@ class Runtime:
         if isinstance(payload, hsteady.SteadyPlan):
             # Zero-copy steady step: negotiation + data plane in ONE
             # native call (deviations rejoin the classic path inside).
-            meta = self._native_steady_cycle(payload)
+            # An abort raised from inside the C loop must leave no
+            # in-flight speculative state behind: elastic recovery
+            # re-enters a fresh cycle loop, and stale inflight entries
+            # would satisfy the next spec verdict with dead arrays.
+            try:
+                meta = self._native_steady_cycle(payload)
+            except BaseException:
+                self._spec_inflight = None
+                self._spec_steady = None
+                raise
         else:
             gathered = self.controller.gather_requests(payload)
             if self.controller.is_coordinator:
@@ -1385,6 +1465,13 @@ class Runtime:
             self._m_cache_misses.set_total(c.misses)
             self._m_cache_evictions.set_total(c.evictions)
             self._m_cache_entries.set(len(c))
+        self._m_world_size.set(self.controller.size)
+        if self._elastic is not None:
+            self._m_world_resizes.set_total(self._elastic.resizes)
+            self._m_elastic_rejoins.set_total(
+                self._elastic.rejoins_admitted)
+            for v in self._elastic.take_rendezvous_observations():
+                self._m_rdzv_s.observe(v)
         self._m_cycles.set_total(self._cycle_count)
         self._m_cached_cycles.set_total(self._cached_cycles)
         self._m_spec_cycles.set_total(self._spec_cycles)
@@ -1443,6 +1530,8 @@ class Runtime:
         the metrics plane maintains them — one warning then carries
         enough to diagnose without a second tool."""
         parts = [f"tensor queue depth {len(self.tensor_table)}"]
+        if self._elastic is not None:
+            parts.append(self._elastic.world_line())
         ages = self.controller.peer_heartbeat_ages()
         if ages:
             worst = sorted(ages.items(), key=lambda kv: -kv[1])[:4]

@@ -293,7 +293,7 @@ def run_coord_cycle(lib, plan: SteadyPlan, fds: List[int],
         *[a.ctypes.data for a in acc_bufs])
     done = (ctypes.c_uint8 * n)()
     timeout_ms, interval_ms = _hb_ms(hb)
-    idle_cb = on_idle if on_idle is not None else _native.ON_IDLE_FUNC(0)
+    idle_cb = on_idle if on_idle is not None else _native.NULL_ON_IDLE
     dev_idx = ctypes.c_int(-1)
     dev_buf = _u8p()
     dev_len = ctypes.c_int64()

@@ -152,22 +152,47 @@ class TensorTable:
             return len(self._table)
 
 
+# Process-lifetime handle watermark: an elastic resize
+# (common/elastic.py) replaces the Runtime — and with it the
+# HandleManager — while user code may still hold handles from the old
+# world. Restarting ids at 0 would let a stale handle COLLIDE with a
+# fresh one and silently return the wrong tensor; continuing from the
+# watermark makes a stale handle an unambiguous "Invalid handle"
+# instead. Only one live manager allocates at a time (the old
+# runtime is torn down before the new one starts), so the plain
+# module global needs no lock of its own.
+_HANDLE_WATERMARK = 0
+
+
 class HandleManager:
     """Integer handles for async ops; poll/wait on completion status
-    (reference: horovod/torch/handle_manager.{h,cc})."""
+    (reference: horovod/torch/handle_manager.{h,cc}). Ids are unique
+    across every manager the process ever creates (elastic resizes
+    create a new one per world generation — see _HANDLE_WATERMARK)."""
 
     def __init__(self):
         self._lock = lockdep.lock("tensor_table.HandleManager._lock")
         self._cv = threading.Condition(self._lock)
-        self._last = 0
+        self._base = _HANDLE_WATERMARK  # ids at or below: prior manager
+        self._last = _HANDLE_WATERMARK
         self._waiters = 0
         self._results: Dict[int, Optional[Status]] = {}
         self._outputs: Dict[int, Any] = {}
 
+    def from_prior_generation(self, handle: int) -> bool:
+        """True when ``handle`` was allocated by a manager that
+        predates this one (an elastic resize replaced the runtime):
+        its collective completed — with WorldAbortedError — before
+        the old world tore down. Distinguishes that case from
+        current-world misuse (double release, garbage id)."""
+        return 0 < handle <= self._base
+
     def allocate(self) -> int:
+        global _HANDLE_WATERMARK
         with self._lock:
             self._last += 1
             handle = self._last
+            _HANDLE_WATERMARK = self._last
             self._results[handle] = None
             return handle
 
@@ -175,9 +200,11 @@ class HandleManager:
         """``n`` fresh handles under ONE lock acquisition — a grouped
         submission's per-handle locking is a measurable share of the
         steady-state submit path."""
+        global _HANDLE_WATERMARK
         with self._lock:
             first = self._last + 1
             self._last += n
+            _HANDLE_WATERMARK = self._last
             handles = list(range(first, self._last + 1))
             for h in handles:
                 self._results[h] = None

@@ -661,3 +661,66 @@ def combine_cycle_requests(frames) -> "bytes | None":
         combined.shutdown = combined.shutdown or cf.shutdown
         combined.requests.extend(cf.requests)
     return serialize_cycle_request(combined, aggregate=True)
+
+
+# -- elastic rendezvous frames (common/elastic.py) ---------------------------
+#
+# These ride short-lived dedicated sockets (never the controller
+# channels), framed by network.Channel like everything else:
+#
+#   manifest := u8 kind | i64 generation | i32 old_rank
+#             | string host | i32 elastic_port
+#   verdict  := u8 verdict | i64 generation | i32 new_rank | i32 size
+#             | string controller_addr | i32 controller_port
+#             | string cause | u32 n_lost x string | i32 joined
+#             | i32 coord_elastic_port
+
+def serialize_elastic_manifest(kind: int, generation: int,
+                               old_rank: int, host: str,
+                               elastic_port: int) -> bytes:
+    w = _Writer()
+    w.u8(kind)
+    w.i64(generation)
+    w.i32(old_rank)
+    w.string(host)
+    w.i32(elastic_port)
+    return w.bytes()
+
+
+def parse_elastic_manifest(data: bytes) -> dict:
+    r = _Reader(data)
+    return {"kind": r.u8(), "gen": r.i64(), "old_rank": r.i32(),
+            "host": r.string(), "elastic_port": r.i32()}
+
+
+def serialize_elastic_verdict(verdict: int, generation: int,
+                              new_rank: int, size: int, addr: str,
+                              port: int, cause: str,
+                              lost=None, joined: int = 0,
+                              coord_elastic_port: int = 0) -> bytes:
+    w = _Writer()
+    w.u8(verdict)
+    w.i64(generation)
+    w.i32(new_rank)
+    w.i32(size)
+    w.string(addr)
+    w.i32(port)
+    w.string(cause)
+    lost = lost or []
+    w.u32(len(lost))
+    for entry in lost:
+        w.string(entry)
+    w.i32(joined)
+    w.i32(coord_elastic_port)
+    return w.bytes()
+
+
+def parse_elastic_verdict(data: bytes) -> dict:
+    r = _Reader(data)
+    out = {"verdict": r.u8(), "gen": r.i64(), "rank": r.i32(),
+           "size": r.i32(), "addr": r.string(), "port": r.i32(),
+           "cause": r.string()}
+    out["lost"] = [r.string() for _ in range(r.u32())]
+    out["joined"] = r.i32()
+    out["coord_elastic_port"] = r.i32()
+    return out

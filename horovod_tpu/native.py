@@ -36,6 +36,14 @@ _SRC_PATH = os.path.join(_NATIVE_DIR, "hvdtpu.cc")
 # callback being garbage-collected mid-call.
 ON_IDLE_FUNC = ctypes.CFUNCTYPE(None)
 
+# The null idle callback, shared: callers that run a steady cycle
+# WITHOUT a liveness deadline previously constructed a fresh
+# ON_IDLE_FUNC(0) per cycle — a per-step allocation on the hot path
+# whose mid-call garbage collection the type comment above warns
+# about. One module-level instance removes both hazards and survives
+# elastic re-inits (common/elastic.py) unchanged.
+NULL_ON_IDLE = ON_IDLE_FUNC(0)
+
 
 def disabled_via_env() -> bool:
     """The one definition of 'native core disabled by the operator'.

@@ -108,7 +108,21 @@ def synchronize(handle: int) -> Any:
     A fail-fast world abort surfaces as WorldAbortedError (a
     HorovodInternalError subclass) carrying the originating rank."""
     rt = basics.runtime()
-    status = rt.handle_manager.wait(handle)
+    try:
+        status = rt.handle_manager.wait(handle)
+    except ValueError:
+        # Handle ids are unique across world generations, so a stale
+        # id is provably from BEFORE an elastic resize (its collective
+        # already completed with WorldAbortedError when the old world
+        # tore down) — say so. Current-generation misuse (double
+        # synchronize, garbage id) keeps the plain ValueError.
+        if not rt.handle_manager.from_prior_generation(handle):
+            raise
+        raise HorovodInternalError(
+            f"handle {handle} belongs to a previous world generation: "
+            f"async handles do not survive an elastic resize — their "
+            f"collectives failed with WorldAbortedError at the abort; "
+            f"re-enqueue after recovery") from None
     output = rt.handle_manager.release(handle)
     if not status.ok():
         if status.aborted_by is not None:

@@ -249,6 +249,169 @@ def run_local(np_: int, command: List[str],
     return exit_code
 
 
+class HostBlacklist:
+    """Per-slot failure ledger with exponential backoff — the
+    launcher-side half of elastic mode (upstream analog: Elastic
+    Horovod's host blacklist). A slot whose worker died waits
+    ``base * 2^(failures-1)`` seconds (capped) before its respawn
+    rejoins at the next rendezvous barrier; a slot that keeps dying
+    past ``retries`` is blacklisted for good."""
+
+    def __init__(self, base_s: Optional[float] = None,
+                 cap_s: float = 60.0, retries: Optional[int] = None):
+        self.base_s = base_s if base_s is not None else \
+            hconfig.env_float("HOROVOD_TPU_ELASTIC_BACKOFF", 1.0)
+        self.cap_s = cap_s
+        self.retries = retries if retries is not None else \
+            hconfig.env_int("HOROVOD_TPU_ELASTIC_RETRIES", 3)
+        self._failures: Dict[int, int] = {}
+        self._until: Dict[int, float] = {}
+
+    def record_failure(self, slot: int, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        n = self._failures.get(slot, 0) + 1
+        self._failures[slot] = n
+        self._until[slot] = now + min(
+            self.cap_s, self.base_s * (2.0 ** (n - 1)))
+
+    def permanently_dead(self, slot: int) -> bool:
+        return self._failures.get(slot, 0) > self.retries
+
+    def ready_to_retry(self, slot: int,
+                       now: Optional[float] = None) -> bool:
+        if self.permanently_dead(slot):
+            return False
+        now = time.monotonic() if now is None else now
+        return now >= self._until.get(slot, 0.0)
+
+    def backlog(self) -> Dict[int, int]:
+        """slot -> failure count, for logs and the launcher summary."""
+        return dict(self._failures)
+
+
+def run_local_elastic(np_: int, command: List[str],
+                      env: Optional[Dict[str, str]] = None,
+                      start_timeout: float = 30.0,
+                      min_np: int = 1,
+                      max_np: Optional[int] = None,
+                      spawn_fn=None,
+                      blacklist: Optional[HostBlacklist] = None,
+                      poll_s: float = 0.1) -> int:
+    """Elastic local launch (``hvdtpurun --elastic``): spawn ``np_``
+    ranks, then SUPERVISE instead of killing the world on the first
+    death. A dead worker's slot goes on the blacklist with exponential
+    backoff; once its backoff expires it is respawned as a JOINER
+    (HOROVOD_ELASTIC_JOIN=1) that rejoins the running world at the
+    next rendezvous barrier. The in-process elastic machinery
+    (common/elastic.py) keeps the surviving ranks training throughout;
+    this loop only manages processes. Every slot's elastic listener
+    port is launcher-reserved so a respawn can always dial SOME live
+    member (any member redirects a joiner to the current coordinator).
+
+    ``spawn_fn(slot, env, joiner) -> Popen-like`` is injectable for
+    tests. Returns 0 when every live worker exits cleanly; the first
+    nonzero exit code when the world is lost."""
+    max_np = max_np or np_
+    blacklist = blacklist or HostBlacklist()
+    port = _free_port()
+    elastic_ports = [_free_port() for _ in range(max_np)]
+
+    def _spawn(slot: int, joiner: bool):
+        penv = dict(os.environ)
+        if env:
+            penv.update(env)
+        penv["HOROVOD_ELASTIC"] = "1"
+        penv["HOROVOD_ELASTIC_MIN_WORLD"] = str(min_np)
+        penv["HOROVOD_TPU_ELASTIC_PORT"] = str(elastic_ports[slot])
+        penv.setdefault("HOROVOD_START_TIMEOUT", str(start_timeout))
+        if joiner:
+            # Point the joiner at any LIVE member's elastic listener;
+            # whoever answers redirects it to the current coordinator.
+            alive = [s for s in procs if procs[s].poll() is None
+                     and s != slot]
+            anchor = alive[0] if alive else 0
+            penv["HOROVOD_ELASTIC_JOIN"] = "1"
+            penv["HOROVOD_ELASTIC_JOIN_ADDR"] = "127.0.0.1"
+            penv["HOROVOD_ELASTIC_JOIN_PORT"] = \
+                str(elastic_ports[anchor])
+            penv.pop("HOROVOD_RANK", None)
+            penv.pop("HOROVOD_SIZE", None)
+            # An injected fault already did its job killing the first
+            # incarnation; the respawn must not re-arm it.
+            penv.pop("HOROVOD_FAULT_SPEC", None)
+        else:
+            penv["HOROVOD_RANK"] = str(slot)
+            penv["HOROVOD_SIZE"] = str(np_)
+        penv["HOROVOD_CONTROLLER_ADDR"] = "127.0.0.1"
+        penv["HOROVOD_CONTROLLER_PORT"] = str(port)
+        if spawn_fn is not None:
+            return spawn_fn(slot, penv, joiner)
+        return subprocess.Popen(command, env=penv)
+
+    procs: Dict[int, object] = {}
+    for slot in range(np_):
+        procs[slot] = _spawn(slot, joiner=False)
+    pending_respawn: set = set()
+    exit_code = 0
+    clean_exits = 0
+    try:
+        while True:
+            for slot, p in list(procs.items()):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                del procs[slot]
+                if rc == 0:
+                    clean_exits += 1
+                    continue  # finished training: never respawned
+                exit_code = exit_code or rc
+                blacklist.record_failure(slot)
+                if blacklist.permanently_dead(slot):
+                    print(f"hvdtpurun: slot {slot} failed "
+                          f"{blacklist.backlog()[slot]} times — "
+                          f"blacklisted for good", file=sys.stderr)
+                else:
+                    pending_respawn.add(slot)
+            for slot in sorted(pending_respawn):
+                if len(procs) >= max_np or not procs:
+                    break
+                if blacklist.ready_to_retry(slot):
+                    pending_respawn.discard(slot)
+                    procs[slot] = _spawn(slot, joiner=True)
+            if not procs:
+                break
+            if len(procs) < min_np and not pending_respawn \
+                    and clean_exits == 0:
+                # Below the floor with nothing left to respawn and
+                # nobody finishing normally: the in-process min-world
+                # check aborts the survivors; we just stop
+                # supervising. (With clean exits the job is simply
+                # draining — lockstep training finishes everywhere at
+                # once, so keep reaping until empty.)
+                break
+            time.sleep(poll_s)
+    except KeyboardInterrupt:
+        exit_code = 130
+    finally:
+        deadline = time.monotonic() + abort_grace_seconds() + 10.0
+        for p in procs.values():
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        for p in procs.values():
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+    # A world that ended with every (surviving) worker clean is a
+    # success even if some workers died and were replaced on the way.
+    if clean_exits > 0 and exit_code != 0 and not procs \
+            and clean_exits >= min_np:
+        return 0
+    return exit_code
+
+
 def _ssh_spawn(host: str, ssh_port: Optional[int], remote_cmd: str,
                env_to_forward: Dict[str, str]) -> subprocess.Popen:
     """ssh-launch a task server on ``host``
@@ -326,6 +489,19 @@ def main(argv: Optional[List[str]] = None) -> None:
                     "(reference: horovodrun).")
     parser.add_argument("-np", "--num-proc", type=int, required=True,
                         help="total number of training processes")
+    parser.add_argument("--elastic", action="store_true",
+                        help="supervise instead of kill-on-first-exit: "
+                             "dead workers are blacklisted with "
+                             "backoff and respawned to rejoin the "
+                             "running world (HOROVOD_ELASTIC=1 on "
+                             "every rank; docs/fault_tolerance.md)")
+    parser.add_argument("--min-np", type=int, default=None,
+                        help="elastic world floor: abort for real "
+                             "below this many members (env "
+                             "HOROVOD_ELASTIC_MIN_WORLD; default 1)")
+    parser.add_argument("--max-np", type=int, default=None,
+                        help="elastic world ceiling for rejoins "
+                             "(default: -np)")
     parser.add_argument("-H", "--hosts", default=None,
                         help="host1:slots,host2:slots (default: local)")
     parser.add_argument("-p", "--ssh-port", type=int, default=None)
@@ -392,9 +568,20 @@ def main(argv: Optional[List[str]] = None) -> None:
             total = sum(s for _, s in parse_hosts(args.hosts))
             if total != args.num_proc:
                 parser.error(f"-np {args.num_proc} != total slots {total}")
+        if args.elastic:
+            sys.exit(run_local_elastic(
+                args.num_proc, command, env=metrics_env,
+                start_timeout=start_timeout,
+                min_np=args.min_np or 1,
+                max_np=args.max_np))
         sys.exit(run_local(args.num_proc, command, env=metrics_env,
                            start_timeout=start_timeout))
 
+    if args.elastic:
+        parser.error("--elastic currently drives the local launch "
+                     "path only; run one elastic launcher per host or "
+                     "drop -H (remote supervision is tracked in "
+                     "ROADMAP item 1)")
     hosts = parse_hosts(args.hosts)
     total = sum(s for _, s in hosts)
     if total != args.num_proc:

@@ -1,0 +1,321 @@
+"""Unit tests for elastic worlds (common/elastic.py): wire codecs,
+membership, State semantics, election building blocks, fault-injection
+rendezvous triggers, the re-entrant runtime teardown, and the
+launcher's blacklist/backoff supervision — everything that doesn't
+need a real multi-process world (tests/test_multiprocess.py covers
+those)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common import elastic, faults, wire
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.status import WorldAbortedError
+
+
+@pytest.fixture(autouse=True)
+def _clean_elastic_state():
+    yield
+    elastic.reset()
+    faults.clear()
+
+
+def _cfg(**kw) -> Config:
+    c = Config()
+    c.elastic_enabled = True
+    for k, v in kw.items():
+        setattr(c, k, v)
+    return c
+
+
+# -- wire codecs -------------------------------------------------------------
+
+def test_manifest_roundtrip():
+    payload = wire.serialize_elastic_manifest(
+        elastic.MANIFEST_SURVIVOR, 7, 3, "10.0.0.9", 41234)
+    m = wire.parse_elastic_manifest(payload)
+    assert m == {"kind": elastic.MANIFEST_SURVIVOR, "gen": 7,
+                 "old_rank": 3, "host": "10.0.0.9",
+                 "elastic_port": 41234}
+
+
+def test_verdict_roundtrip_with_lost_and_joined():
+    payload = wire.serialize_elastic_verdict(
+        elastic.VERDICT_OK, 2, 1, 3, "host-a", 555, "kill",
+        lost=["gen:1 rank 2 (h)"], joined=1, coord_elastic_port=777)
+    v = wire.parse_elastic_verdict(payload)
+    assert v["verdict"] == elastic.VERDICT_OK
+    assert (v["gen"], v["rank"], v["size"]) == (2, 1, 3)
+    assert (v["addr"], v["port"]) == ("host-a", 555)
+    assert v["lost"] == ["gen:1 rank 2 (h)"] and v["joined"] == 1
+    assert v["coord_elastic_port"] == 777
+
+
+@pytest.mark.parametrize("cut", [1, 5, 9, 14])
+def test_truncated_elastic_frames_fail_as_transport_errors(cut):
+    payload = wire.serialize_elastic_manifest(1, 1, 1, "h", 1)
+    with pytest.raises(ConnectionError):
+        wire.parse_elastic_manifest(payload[:cut])
+    payload = wire.serialize_elastic_verdict(0, 1, 1, 2, "h", 1, "c")
+    with pytest.raises(ConnectionError):
+        wire.parse_elastic_verdict(payload[:cut])
+
+
+# -- membership --------------------------------------------------------------
+
+def test_membership_install_and_blacklist_accumulates():
+    m = elastic.Membership()
+    m.install(1, 3, {0: ("a", 1), 1: ("b", 2), 2: ("c", 3)},
+              lost=["gen:0 rank 3 (d)"])
+    assert m.generation == 1 and m.size == 3
+    m.install(2, 2, {0: ("a", 1), 1: ("c", 3)},
+              lost=["gen:1 rank 1 (b)"])
+    assert m.blacklist == ["gen:0 rank 3 (d)", "gen:1 rank 1 (b)"]
+    assert m.rank_table == {0: ("a", 1), 1: ("c", 3)}
+
+
+def test_context_world_line_mentions_resize_state():
+    ctx = elastic.ensure_context(_cfg(), b"")
+    ctx.apply_membership(2, 0, 2, {0: ("a", 1), 1: ("b", 2)},
+                         lost=["gen:1 rank 2 (c)"])
+    ctx.last_resize_cause = "rank 2 died"
+    line = ctx.world_line()
+    assert "generation 2" in line and "world size 2" in line
+    assert "rank 2 died" in line and "gen:1 rank 2 (c)" in line
+
+
+def test_generation_seeds_response_cache_epoch():
+    from horovod_tpu.common.coordinator import ResponseCache
+    assert ResponseCache(4).epoch == 0
+    assert ResponseCache(4, epoch0=3 << 32).epoch == 3 << 32
+
+
+# -- State -------------------------------------------------------------------
+
+def test_state_commit_restore_roundtrip():
+    s = elastic.State(params=np.arange(4.0), batch=0)
+    s.params = s.params + 10.0
+    s.batch = 5
+    s.commit()
+    s.params = s.params * 0.0
+    s.batch = 99
+    s.restore()
+    np.testing.assert_array_equal(s.params, np.arange(4.0) + 10.0)
+    assert s.batch == 5
+
+
+def test_state_unknown_attribute_raises():
+    s = elastic.State(a=1)
+    with pytest.raises(AttributeError):
+        s.nope
+
+
+# -- election building blocks ------------------------------------------------
+
+def test_follow_barrier_refused_dial_means_dead():
+    import socket
+    ctx = elastic.ensure_context(_cfg(), b"")
+    # a port with no listener: connection refused == candidate dead
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()
+    ctx.apply_membership(0, 1, 2, {0: ("127.0.0.1", dead_port),
+                                   1: ("127.0.0.1", ctx.port)})
+    res = elastic._follow_barrier(ctx, 0, time.monotonic() + 1.0)
+    assert res == "dead"
+
+
+def test_rendezvous_without_membership_aborts_for_real():
+    ctx = elastic.ensure_context(_cfg(elastic_window_s=0.3), b"")
+    ctx.rank = 1  # never installed a table: no candidates at all
+    with pytest.raises(WorldAbortedError) as ei:
+        elastic.rendezvous(0, "unit test")
+    assert "re-rendezvous failed" in str(ei.value)
+
+
+def test_min_world_floor_aborts_for_real():
+    ctx = elastic.ensure_context(
+        _cfg(elastic_window_s=0.5, elastic_min_world=3), b"")
+    ctx.apply_membership(0, 0, 2, {0: ("127.0.0.1", ctx.port),
+                                   1: ("127.0.0.1", 1)})
+    with pytest.raises(WorldAbortedError) as ei:
+        # rank 0 coordinates; rank 1 is dead; 1 survivor < floor of 3
+        elastic.rendezvous(1, "unit test")
+    assert "HOROVOD_ELASTIC_MIN_WORLD" in str(ei.value)
+
+
+# -- config knobs ------------------------------------------------------------
+
+def test_elastic_knobs_from_env(monkeypatch):
+    monkeypatch.setenv("HOROVOD_ELASTIC", "1")
+    monkeypatch.setenv("HOROVOD_ELASTIC_WINDOW", "12.5")
+    monkeypatch.setenv("HOROVOD_ELASTIC_MIN_WORLD", "2")
+    monkeypatch.setenv("HOROVOD_TPU_ELASTIC_PORT", "4100")
+    monkeypatch.setenv("HOROVOD_ELASTIC_JOIN", "1")
+    monkeypatch.setenv("HOROVOD_ELASTIC_JOIN_ADDR", "10.1.2.3")
+    monkeypatch.setenv("HOROVOD_ELASTIC_JOIN_PORT", "4200")
+    c = Config.from_env()
+    assert c.elastic_enabled and c.elastic_join
+    assert c.elastic_window_s == 12.5 and c.elastic_min_world == 2
+    assert c.elastic_port == 4100
+    assert (c.elastic_join_addr, c.elastic_join_port) == \
+        ("10.1.2.3", 4200)
+
+
+def test_elastic_default_off_means_no_context():
+    c = Config.from_env()
+    assert not c.elastic_enabled
+    assert elastic.context() is None and elastic.generation() == 0
+
+
+# -- fault-injection rendezvous trigger --------------------------------------
+
+def test_fault_spec_rdzv_trigger_parses():
+    (f,) = faults.parse_spec("rank=2:delay:rdzv=1:ms=1")
+    assert f.at_rdzv == 1 and f.rank == 2 and f.action == "delay"
+
+
+def test_fault_needs_exactly_one_trigger():
+    with pytest.raises(ValueError):
+        faults.Fault("kill", at_cycle=1, at_rdzv=1)
+    with pytest.raises(ValueError):
+        faults.Fault("kill")
+    with pytest.raises(ValueError):
+        faults.Fault("sever", at_rdzv=1)  # no channel during rdzv
+
+
+def test_tick_rendezvous_fires_scoped_fault(monkeypatch):
+    monkeypatch.delenv("HOROVOD_RANK", raising=False)
+    fired = faults.install("delay", rank=4, at_rdzv=1, ms=1)
+    other = faults.install("delay", rank=5, at_rdzv=1, ms=1)
+    faults.tick_rendezvous(4)
+    assert fired.fired and not other.fired
+
+
+# -- re-entrant runtime teardown (satellite bugfix) --------------------------
+
+def test_runtime_teardown_is_reentrant_and_idempotent():
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics
+    hvd.init()
+    rt = basics.runtime()
+    hvd.shutdown()          # first teardown via the background loop
+    rt._teardown()          # second entry: must be a clean no-op
+    rt._teardown()          # and a third
+    assert rt._teardown_started and not rt.alive
+
+
+def test_handle_ids_unique_across_world_generations():
+    """An elastic resize replaces the HandleManager; a stale handle
+    from the old world must never collide with a fresh one (it would
+    silently return the wrong tensor) — ids continue from a
+    process-lifetime watermark instead."""
+    from horovod_tpu.common.tensor_table import HandleManager
+    old = HandleManager()
+    stale = old.allocate()
+    new = HandleManager()  # what an elastic re-init builds
+    fresh = new.allocate_many(3)
+    assert stale not in fresh
+    with pytest.raises(ValueError):
+        new.wait(stale)
+    # the two ValueError cases stay distinguishable: a pre-resize id
+    # is provably stale, a never-allocated current-gen id is misuse
+    assert new.from_prior_generation(stale)
+    assert not new.from_prior_generation(fresh[-1] + 100)
+
+
+# -- launcher supervision (blacklist + backoff + respawn-as-joiner) ----------
+
+class _FakeProc:
+    """Popen-like double the supervision loop can reap."""
+
+    def __init__(self, rc_after=None):
+        self.rc_after = rc_after  # (deadline, rc) or None = immortal
+        self.terminated = False
+
+    def poll(self):
+        if self.terminated:
+            return 0
+        if self.rc_after and time.monotonic() >= self.rc_after[0]:
+            return self.rc_after[1]
+        return None
+
+    def terminate(self):
+        self.terminated = True
+        self.rc_after = (0.0, 0)
+
+    def wait(self, timeout=None):
+        return self.poll() or 0
+
+    def kill(self):
+        self.terminate()
+
+
+def test_host_blacklist_backoff_doubles_and_caps():
+    from horovod_tpu.run.launch import HostBlacklist
+    bl = HostBlacklist(base_s=1.0, cap_s=3.0, retries=3)
+    t = 100.0
+    bl.record_failure(0, now=t)
+    assert not bl.ready_to_retry(0, now=t + 0.5)
+    assert bl.ready_to_retry(0, now=t + 1.01)
+    bl.record_failure(0, now=t)
+    assert not bl.ready_to_retry(0, now=t + 1.5)   # 2s backoff now
+    assert bl.ready_to_retry(0, now=t + 2.01)
+    bl.record_failure(0, now=t)                     # 3rd failure: 3s cap
+    assert bl.ready_to_retry(0, now=t + 3.01)
+    bl.record_failure(0, now=t)                     # 4th > retries
+    assert bl.permanently_dead(0)
+    assert not bl.ready_to_retry(0, now=t + 1000.0)
+
+
+def test_run_local_elastic_respawns_dead_slot_as_joiner():
+    from horovod_tpu.run.launch import HostBlacklist, run_local_elastic
+    spawned = []
+
+    def spawn_fn(slot, env, joiner):
+        spawned.append((slot, joiner, dict(env)))
+        if slot == 2 and not joiner:
+            # first incarnation of slot 2 dies quickly
+            return _FakeProc(rc_after=(time.monotonic() + 0.2, -9))
+        # everyone else (and the respawn) finishes cleanly shortly
+        return _FakeProc(rc_after=(time.monotonic() + 1.2, 0))
+
+    rc = run_local_elastic(
+        3, ["train.py"], spawn_fn=spawn_fn, min_np=2,
+        blacklist=HostBlacklist(base_s=0.1, retries=3), poll_s=0.02)
+    assert rc == 0
+    joiners = [(s, env) for s, j, env in spawned if j]
+    assert len(joiners) == 1 and joiners[0][0] == 2
+    env = joiners[0][1]
+    assert env["HOROVOD_ELASTIC"] == "1"
+    assert env["HOROVOD_ELASTIC_JOIN"] == "1"
+    assert env["HOROVOD_ELASTIC_JOIN_ADDR"] == "127.0.0.1"
+    assert int(env["HOROVOD_ELASTIC_JOIN_PORT"]) > 0
+    assert "HOROVOD_RANK" not in env
+    # non-joiner spawns carried the fixed elastic listener ports
+    first = [env for s, j, env in spawned if not j and s == 0][0]
+    assert first["HOROVOD_TPU_ELASTIC_PORT"].isdigit()
+
+
+def test_run_local_elastic_blacklists_for_good_after_retries():
+    from horovod_tpu.run.launch import HostBlacklist, run_local_elastic
+    spawned = []
+
+    def spawn_fn(slot, env, joiner):
+        spawned.append((slot, joiner))
+        if slot == 1:
+            return _FakeProc(rc_after=(time.monotonic() + 0.05, 1))
+        return _FakeProc(rc_after=(time.monotonic() + 1.5, 0))
+
+    rc = run_local_elastic(
+        2, ["train.py"], spawn_fn=spawn_fn, min_np=1,
+        blacklist=HostBlacklist(base_s=0.05, retries=1), poll_s=0.02)
+    # slot 1 failed, was respawned once, failed again, got blacklisted
+    # for good; slot 0 finished clean -> overall success
+    assert rc == 0
+    assert [s for s, j in spawned if j] == [1]
+    assert spawned.count((1, True)) == 1

@@ -540,6 +540,60 @@ def test_world_coherence_decorator_is_load_bearing():
     assert any("world-replicated" in f.message for f in fs), fs
 
 
+# A rank-local mutation of the elastic membership (the PR 8 rank
+# table / generation / blacklist) — the exact divergence class the
+# elastic re-rendezvous must never allow: one rank editing its own
+# view of who is in the world outside a broadcast verdict.
+BAD_ELASTIC_COHERENCE = """
+    class Membership:
+        def __init__(self):
+            self.rank_table = {}  # hvdlint: world-replicated
+            self.generation = 0  # hvdlint: world-replicated
+
+        def install(self, gen, table):
+            self.rank_table = dict(table)
+            self.generation = gen
+
+    class Recovery:
+        def __init__(self):
+            self._membership = Membership()
+
+        def handle_timeout(self, dead_rank):
+            # rank-LOCAL guess: drops a member without a verdict
+            self._membership.install(
+                self._membership.generation + 1, {})
+"""
+
+
+def test_world_coherence_fires_on_local_elastic_mutation(tmp_path):
+    fs = _lint_snippet(tmp_path, BAD_ELASTIC_COHERENCE,
+                       "world-coherence")
+    msgs = "\n".join(f.message for f in fs)
+    assert "world-replicated" in msgs and "Membership.install" in msgs, fs
+
+
+def test_world_coherence_real_elastic_membership_is_anchored():
+    """The REAL elastic Membership.install must carry the
+    @world_coherent anchor — stripping it fails the tree, proving the
+    rank table / generation / blacklist can only move behind
+    broadcast-identical inputs."""
+    from tools.hvdlint import world_coherence
+    p = Project([os.path.join(REPO, "horovod_tpu")])
+    qn = "horovod_tpu.common.elastic.Membership.install"
+    assert qn in p.index.functions, sorted(
+        k for k in p.index.functions if "elastic" in k)[:20]
+    info = p.index.functions[qn]
+    info.decorators = set()
+    # apply_membership is covered only through its own decorator;
+    # strip that too so coverage cannot flow around the mutator.
+    p.index.functions[
+        "horovod_tpu.common.elastic.ElasticContext.apply_membership"
+    ].decorators = set()
+    fs = world_coherence.run(p)
+    assert any("Membership" in f.message
+               and "world-replicated" in f.message for f in fs), fs
+
+
 def test_world_coherent_decorator_is_identity():
     from horovod_tpu.common.invariants import world_coherent
 

@@ -777,6 +777,81 @@ def test_ring_data_plane_with_hier_controller():
             "HOROVOD_HOSTNAME": f"fakehost{rank // 2}"})
 
 
+# -- elastic worlds (HOROVOD_ELASTIC=1; survive preemption and -------
+# re-rendezvous instead of aborting — docs/fault_tolerance.md). The
+# victims die by fault injection; the SURVIVORS must re-form a smaller
+# world and keep computing EXACT collectives, all under the
+# HOROVOD_TEST_DEADLINE alarm guard like every other mp scenario.
+
+_ELASTIC_ENV = {
+    **_HB_ENV,
+    "HOROVOD_ELASTIC": "1",
+    "HOROVOD_ELASTIC_WINDOW": "10",
+}
+
+
+@pytest.mark.parametrize("plane", ["shm", "socket"])
+def test_elastic_shrink_survives_sigkill(plane):
+    """SIGKILL one of four ranks mid-collective: survivors
+    re-rendezvous into ws=3 within 2x the heartbeat timeout and
+    complete >= 20 more collectives whose allreduce results match a
+    fresh ws=3 world bit-for-bit — on the shm AND socket planes."""
+    extra = dict(_ELASTIC_ENV,
+                 HOROVOD_FAULT_SPEC="rank=3:kill:op=12",
+                 HOROVOD_TPU_METRICS="1")
+    if plane == "socket":
+        extra["HOROVOD_TPU_SHM"] = "0"
+    run_scenario("elastic_shrink", 4, timeout=120.0, extra_env=extra,
+                 expect_rc={3: _SIGKILL_RC})
+
+
+def test_elastic_coordinator_death_reelects():
+    """SIGKILL rank 0 (coordinator + controller socket): old rank 1
+    wins the deterministic election, hosts the new controller, and
+    the world continues at ws=2."""
+    run_scenario(
+        "elastic_coordinator_death", 3, timeout=120.0,
+        extra_env=dict(_ELASTIC_ENV,
+                       HOROVOD_FAULT_SPEC="rank=0:kill:op=8"),
+        expect_rc={0: _SIGKILL_RC})
+
+
+def test_elastic_double_fault_kill_during_rendezvous():
+    """A second rank dies ON ENTRY TO the re-rendezvous barrier
+    (fault trigger rdzv=1): the barrier waits out its window for the
+    silent victim and still closes with the remaining survivors."""
+    run_scenario(
+        "elastic_double_fault", 4, timeout=120.0,
+        extra_env=dict(
+            _ELASTIC_ENV,
+            HOROVOD_ELASTIC_WINDOW="4",
+            HOROVOD_ELASTIC_MIN_WORLD="2",
+            HOROVOD_FAULT_SPEC="rank=3:kill:op=8;rank=2:kill:rdzv=1"),
+        expect_rc={2: _SIGKILL_RC, 3: _SIGKILL_RC})
+
+
+def test_elastic_rejoin_after_shrink():
+    """Shrink then GROW: after the kill, old rank 0 respawns the lost
+    slot as a joiner (the launcher supervision loop's move); it is
+    admitted at the next rendezvous barrier, resyncs the State by
+    broadcast, and the world trains to completion at full size."""
+    run_scenario(
+        "elastic_rejoin", 3, timeout=180.0,
+        extra_env=dict(_ELASTIC_ENV,
+                       HOROVOD_FAULT_SPEC="rank=2:kill:op=8"),
+        expect_rc={2: _SIGKILL_RC})
+
+
+def test_elastic_disabled_keeps_fail_fast():
+    """Without HOROVOD_ELASTIC the wrapper is transparent: the PR 2
+    WorldAbortedError (naming the dead rank) propagates verbatim."""
+    run_scenario(
+        "elastic_disabled_fail_fast", 3, timeout=60.0,
+        extra_env={**_HB_ENV,
+                   "HOROVOD_FAULT_SPEC": "rank=1:kill:op=3"},
+        expect_rc={1: _SIGKILL_RC})
+
+
 def test_rank_subset_init():
     """init(comm=[1, 2]) on 3 processes: the 2-rank subset allreduces
     while the third abstains in a size-1 world."""
