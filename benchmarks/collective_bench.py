@@ -617,6 +617,276 @@ AUTOTUNE_VALUE_BYTES = 32 << 10
 AUTOTUNE_VALUE_STEPS = 40
 
 
+COMP_BENCH_STEPS = 30
+COMP_BENCH_GAP_S = 0.002
+
+
+def worker_compression(rank: int, size: int) -> None:
+    """Compression/algorithm grid leg (ISSUE 9): a steady
+    single-tensor allreduce loop at the bucket size in
+    HVD_BENCH_BYTES, with wire dtype and algorithm selected by the
+    section driver through the production knobs (HOROVOD_COMPRESSION,
+    HOROVOD_TWO_LEVEL, HOROVOD_TPU_RING_THRESHOLD, HOROVOD_TPU_SHM) —
+    the grid measures exactly what an operator would deploy.
+    ``us_per_op`` is the median steady step latency; values are
+    bf16-exact small integers so every wire dtype is spot-checkable."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    nbytes = int(os.environ.get("HVD_BENCH_BYTES", str(1 << 20)))
+    steps = int(os.environ.get("HVD_BENCH_STEPS",
+                               str(COMP_BENCH_STEPS)))
+    hvd.init()
+    n = max(1, nbytes // 4)
+    x = np.full(n, float(rank + 1), np.float32)
+    ssum = float(sum(range(1, size + 1)))
+
+    out = None
+    for _ in range(5):
+        out = hvd.allreduce(x, average=False, name="cg")
+        time.sleep(COMP_BENCH_GAP_S)
+    assert abs(float(np.asarray(out)[0]) - ssum) < 1e-3
+    hvd.barrier(name="cg.bar")
+    times = []
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        hvd.allreduce(x, average=False, name="cg")
+        times.append(time.perf_counter() - t0)
+        time.sleep(COMP_BENCH_GAP_S)
+    out = hvd.allreduce(x, average=False, name="cg")
+    assert abs(float(np.asarray(out)[0]) - ssum) < 1e-3
+    _, med, _ = _quantiles(times)
+    report = {
+        "bytes": nbytes,
+        "steps": steps,
+        "us_per_op": round(med * 1e6, 1),
+        "compression": os.environ.get("HOROVOD_COMPRESSION", "none"),
+    }
+    if rank == 0:
+        print("RESULT " + json.dumps(report), flush=True)
+    hvd.shutdown()
+
+
+def worker_compression_autotune(rank: int, size: int) -> None:
+    """Autotuner-convergence leg: the same steady loop under
+    HOROVOD_AUTOTUNE=1 — the per-bucket grid phase sweeps
+    (algorithm x wire dtype) live, the BO phase settles
+    threshold x cycle, and the post-convergence median latency is
+    what the section compares against the best hand-picked grid
+    point (acceptance: >= 90% of its throughput)."""
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics as _b
+    from horovod_tpu.common import wire_dtype as _wd
+    from horovod_tpu.common.parameter_manager import bucket_of
+
+    nbytes = int(os.environ.get("HVD_BENCH_BYTES", str(1 << 20)))
+    hvd.init()
+    rt = _b.runtime()
+    pm = rt.parameter_manager
+    assert pm is not None
+    n = max(1, nbytes // 4)
+    x = np.full(n, float(rank + 1), np.float32)
+    converged = False
+    for i in range(6000):
+        hvd.allreduce(x, average=False, name="ca")
+        if i % 5 != 4:
+            # Back-to-back ops keep the tuner's score windows DENSE
+            # (an op-starved window scores noise); the world-consistent
+            # convergence probe only needs to run every few steps.
+            continue
+        flag = 0.0 if rank != 0 else (0.0 if pm.tuning else 1.0)
+        done = hvd.broadcast(np.asarray([flag]), root_rank=0,
+                             name=f"ca.done/{i}")
+        if float(done[0]) == 1.0:
+            converged = True
+            break
+    hvd.barrier(name="ca.bar")
+    times = []
+    for _ in range(COMP_BENCH_STEPS):
+        t0 = time.perf_counter()
+        hvd.allreduce(x, average=False, name="ca")
+        times.append(time.perf_counter() - t0)
+        time.sleep(COMP_BENCH_GAP_S)
+    _, med, _ = _quantiles(times)
+    report = {"converged": converged,
+              "us_per_op": round(med * 1e6, 1),
+              "ops_to_converge": i}
+    if rank == 0:
+        alg, cap = pm.bucket_plan()[bucket_of(nbytes)]
+        report["tuned"] = {
+            "algorithm": _wd.ALG_NAMES[alg],
+            "wire": "-" if cap is None else _wd.WIRE_NAMES[cap]}
+        print("RESULT " + json.dumps(report), flush=True)
+    hvd.shutdown()
+
+
+def _compression_bench_section(np_: int) -> dict:
+    """The ISSUE 9 acceptance grid at world_size=np_ on a fake
+    multi-host topology (np_//2 hosts x 2 ranks): (algorithm x wire
+    dtype x size bucket) medians with ISOLATED legs (3 reps on the
+    headline >= 1 MiB bucket), a SIMULTANEOUS star none/bf16 pair
+    (the throttle-immune protocol), and the autotuner-convergence
+    run. Records:
+
+    * ``bf16_star_speedup`` — median of ADJACENT isolated star
+      none/bf16 leg ratios on the >= 1 MiB bucket (acceptance:
+      >= 1.5x; the simultaneous pairs are recorded alongside);
+    * ``twolevel_vs_best_flat_none`` / ``_bf16`` — best flat
+      (star/ring) latency over two-level at the SAME wire dtype;
+      the pass bit gates on the NONE ratio (the algorithm
+      comparison, acceptance > 1.0) — see the loopback caveat in
+      ``twolevel_note`` for why the bf16 column can invert on a
+      one-host CI box;
+    * ``autotune.frac_of_best`` — throughput fraction of the best
+      grid combo (re-measured adjacent in time) the tuned config
+      reaches (acceptance: >= 0.9)."""
+    import threading
+
+    def hosts(rank: int) -> dict:
+        return {"HOROVOD_HOSTNAME": f"bhost{rank // 2}"}
+
+    algs = {
+        "star": {"HOROVOD_TPU_SHM": "0",
+                 "HOROVOD_TPU_RING_THRESHOLD": "-1"},
+        "ring": {"HOROVOD_TPU_SHM": "0",
+                 "HOROVOD_TPU_RING_THRESHOLD": "1"},
+        "twolevel": {"HOROVOD_TWO_LEVEL": "1"},
+    }
+    buckets = [64 << 10, 1 << 20]
+    big = 1 << 20
+    grid = {}
+    for nb in buckets:
+        for alg, aenv in algs.items():
+            for w in ("none", "bf16"):
+                env = dict(aenv, HOROVOD_COMPRESSION=w,
+                           HVD_BENCH_BYTES=str(nb))
+                reps = 3 if nb == big else 1
+                runs = sorted(
+                    _run_world("compression", np_, timeout=600.0,
+                               extra_env=env,
+                               per_rank_env=hosts)["us_per_op"]
+                    for _ in range(reps))
+                key = f"{nb}/{alg}/{w}"
+                grid[key] = {"us_per_op": runs[len(runs) // 2],
+                             "runs": runs}
+                print(f"  {key:>24}: {runs[len(runs) // 2]} us/op "
+                      f"{runs}", flush=True)
+
+    # Headline bf16-vs-none ratio, BOTH protocols (the zero_copy
+    # section's doctrine for this throttling host):
+    # * ISOLATED ALTERNATING legs — none/bf16/none/bf16/...: adjacent
+    #   runs see similar throttle states, so the median of ADJACENT
+    #   ratios is the undistorted isolated-leg speedup (grouped reps
+    #   drift across the multi-second throttle phases);
+    # * SIMULTANEOUS pairs — both worlds see the identical machine at
+    #   every instant.
+    iso_ratios = []
+    for _ in range(3):
+        a = _run_world("compression", np_, timeout=600.0,
+                       extra_env=dict(algs["star"],
+                                      HOROVOD_COMPRESSION="none",
+                                      HVD_BENCH_BYTES=str(big)),
+                       per_rank_env=hosts)
+        b = _run_world("compression", np_, timeout=600.0,
+                       extra_env=dict(algs["star"],
+                                      HOROVOD_COMPRESSION="bf16",
+                                      HVD_BENCH_BYTES=str(big)),
+                       per_rank_env=hosts)
+        iso_ratios.append(a["us_per_op"] / b["us_per_op"])
+    iso_ratios.sort()
+
+    pair_ratios = []
+    for _ in range(3):
+        pair = {}
+
+        def _go(key, w):
+            env = dict(algs["star"], HOROVOD_COMPRESSION=w,
+                       HVD_BENCH_BYTES=str(big))
+            pair[key] = _run_world("compression", np_, timeout=600.0,
+                                   extra_env=env, per_rank_env=hosts)
+
+        ta = threading.Thread(target=_go, args=("none", "none"))
+        tb = threading.Thread(target=_go, args=("bf16", "bf16"))
+        ta.start()
+        tb.start()
+        ta.join()
+        tb.join()
+        pair_ratios.append(pair["none"]["us_per_op"]
+                           / pair["bf16"]["us_per_op"])
+    pair_ratios.sort()
+
+    bf16_star = iso_ratios[len(iso_ratios) // 2]
+    # Algorithm comparison at the SAME wire dtype (orthogonal axes):
+    # the headline number compares uncompressed algorithms. On this
+    # one-host CI box "cross-host" links are loopback, so the star's
+    # whole-path bf16 compression can beat two-level's cross-leg-only
+    # compression — recorded per-dtype so real-fabric readers can see
+    # both; on real DCN the cross links bound everything and the two
+    # gains compound.
+    tl_vs_flat_none = (
+        min(grid[f"{big}/star/none"]["us_per_op"],
+            grid[f"{big}/ring/none"]["us_per_op"])
+        / grid[f"{big}/twolevel/none"]["us_per_op"])
+    tl_vs_flat_bf16 = (
+        min(grid[f"{big}/star/bf16"]["us_per_op"],
+            grid[f"{big}/ring/bf16"]["us_per_op"])
+        / grid[f"{big}/twolevel/bf16"]["us_per_op"])
+
+    # Autotuner-convergence leg: bf16 proposed, shm on (so the
+    # two-level candidate is feasible). Sample windows are LONG
+    # (steps_per_sample=6, back-to-back ops) — an op-starved window
+    # scores scheduler noise and the grid argmax inherits it.
+    at = _run_world(
+        "compression_autotune", np_, timeout=900.0,
+        extra_env={"HOROVOD_AUTOTUNE": "1",
+                   "HOROVOD_COMPRESSION": "bf16",
+                   "HOROVOD_AUTOTUNE_WARMUP_SAMPLES": "1",
+                   "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE": "6",
+                   "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES": "4",
+                   "HVD_BENCH_BYTES": str(big)},
+        per_rank_env=hosts)
+    # The comparison baseline re-runs the grid's best combo ADJACENT
+    # in time to the tuned world (same throttle phase) — comparing
+    # against a grid number measured minutes earlier mixes machine
+    # phases, not configurations.
+    best_key = min((k for k in grid if k.startswith(f"{big}/")),
+                   key=lambda k: grid[k]["us_per_op"])
+    _, best_alg, best_w = best_key.split("/")
+    best_adj = _run_world(
+        "compression", np_, timeout=600.0,
+        extra_env=dict(algs[best_alg], HOROVOD_COMPRESSION=best_w,
+                       HVD_BENCH_BYTES=str(big)),
+        per_rank_env=hosts)
+    best_us = best_adj["us_per_op"]
+    frac = best_us / at["us_per_op"] if at["us_per_op"] else 0.0
+
+    return {
+        "world_size": np_,
+        "hosts": np_ // 2,
+        "cores": os.cpu_count(),
+        "grid": grid,
+        "pair_ratios_star_none_over_bf16":
+            [round(r, 2) for r in pair_ratios],
+        "isolated_ratios_star_none_over_bf16":
+            [round(r, 2) for r in iso_ratios],
+        "bf16_star_speedup": round(bf16_star, 2),
+        "bf16_star_speedup_pass": bf16_star >= 1.5,
+        "twolevel_vs_best_flat_none": round(tl_vs_flat_none, 2),
+        "twolevel_vs_best_flat_bf16": round(tl_vs_flat_bf16, 2),
+        "twolevel_pass": tl_vs_flat_none > 1.0,
+        "twolevel_note": (
+            "same-dtype comparison; on this one-host CI box the "
+            "cross-host links are loopback, so whole-path star "
+            "compression can outrun two-level's cross-leg-only "
+            "compression at bf16 — on real DCN the cross links bound "
+            "both and the gains compound"),
+        "autotune": {**at, "best_grid_us_per_op": best_us,
+                     "frac_of_best": round(frac, 3),
+                     "meets_90pct": frac >= 0.9},
+    }
+
+
 def worker_autotune_value(rank: int, size: int) -> None:
     """Autotune VALUE demo (not just mechanics): a fusion-sensitive
     workload — many small allreduces per step — measured under (a)
@@ -1058,7 +1328,8 @@ def main() -> None:
                     choices=["allreduce", "train", "fixed_compute",
                              "bcast_render", "ragged_allgather",
                              "overhead", "autotune_value", "cache",
-                             "elastic"])
+                             "elastic", "compression",
+                             "compression_autotune"])
     ap.add_argument("--rank", type=int)
     ap.add_argument("--size", type=int)
     ap.add_argument("--skip-variants", action="store_true",
@@ -1079,6 +1350,13 @@ def main() -> None:
                          "re-rendezvous gap, us/op after the shrink; "
                          "recovery asserted < 2x heartbeat timeout) "
                          "and merge it into RESULTS_cpu.json")
+    ap.add_argument("--compression", action="store_true",
+                    help="run just the wire-compression/two-level "
+                         "grid ((algorithm x dtype x bucket) medians "
+                         "on a fake multi-host world, isolated + "
+                         "simultaneous-pair protocols, plus the "
+                         "autotuner-convergence run) and merge it "
+                         "into RESULTS_cpu.json")
     args = ap.parse_args()
 
     if args.worker:
@@ -1090,6 +1368,8 @@ def main() -> None:
          "autotune_value": worker_autotune_value,
          "cache": worker_cache,
          "elastic": worker_elastic,
+         "compression": worker_compression,
+         "compression_autotune": worker_compression_autotune,
          "overhead": worker_overhead}[args.worker](
              args.rank, args.size)
         return
@@ -1117,6 +1397,31 @@ def main() -> None:
             json.dump(merged, fh, indent=2)
             fh.write("\n")
         print(f"merged elastic_recovery into {results_path}")
+        return
+
+    if args.compression:
+        print(f"== wire compression + two-level grid (np={np_}, "
+              f"{np_ // 2} fake hosts) ==", flush=True)
+        cp = _compression_bench_section(np_)
+        print(f"  bf16 star speedup {cp['bf16_star_speedup']}x "
+              f"(>=1.5 pass={cp['bf16_star_speedup_pass']})   "
+              f"twolevel vs best flat "
+              f"{cp['twolevel_vs_best_flat_none']}x @none / "
+              f"{cp['twolevel_vs_best_flat_bf16']}x @bf16 "
+              f"(pass={cp['twolevel_pass']})   autotuned "
+              f"{cp['autotune']['frac_of_best']:.0%} of best grid "
+              f"point (>=90% pass={cp['autotune']['meets_90pct']})",
+              flush=True)
+        try:
+            with open(results_path) as fh:
+                merged = json.load(fh)
+        except (OSError, ValueError):
+            merged = {}
+        merged["compression"] = cp
+        with open(results_path, "w") as fh:
+            json.dump(merged, fh, indent=2)
+            fh.write("\n")
+        print(f"merged compression into {results_path}")
         return
 
     if args.steady_only:

@@ -63,6 +63,12 @@ def init(comm=None, config: Optional[Config] = None,
                     # test-and-set, operations.cc:1342-1360)
         cfg = config or Config.from_env()
         hlog.set_level(cfg.log_level)
+        # Publish the wire-compression latch (common/wire_dtype.py):
+        # the framework-level Compression helpers become pass-throughs
+        # while the negotiated data plane compresses, so gradients are
+        # never cast twice.
+        from horovod_tpu.common import wire_dtype as _wd
+        _wd.set_active(_wd.wire_code_of(cfg.compression))
         if isinstance(comm, list):
             ranks = [int(r) for r in comm]
             g_rank = cfg.rank if cfg.rank >= 0 else 0
@@ -168,7 +174,8 @@ def init(comm=None, config: Optional[Config] = None,
                                        config=cfg)
         backends = [
             XlaMeshBackend(controller, config=cfg),
-            ShmBackend(controller, fallback=socket_backend, config=cfg),
+            ShmBackend(controller, fallback=socket_backend, config=cfg,
+                       secret=secret),
             socket_backend,
             LocalBackend(lambda: controller.size),
         ]
@@ -200,6 +207,8 @@ def shutdown() -> None:
         rt.request_shutdown()
         rt.join(timeout=30.0)
         _runtime = None
+        from horovod_tpu.common import wire_dtype as _wd
+        _wd.set_active(_wd.WIRE_NONE)
 
 
 atexit.register(shutdown)

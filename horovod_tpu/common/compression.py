@@ -20,10 +20,13 @@ def _astype(tensor, dtype):
 
 
 def _is_floating(tensor) -> bool:
-    d = np.dtype(tensor.dtype) if not hasattr(tensor.dtype, "name") \
-        else tensor.dtype
-    name = getattr(d, "name", str(d))
-    return name in ("float16", "float32", "float64", "bfloat16")
+    # ONE shared dtype table with the wire codec (common/wire_dtype.py):
+    # the old per-module name list here silently missed extension
+    # dtypes the other half knew about (jax's bfloat16 reaches numpy
+    # as an ml_dtypes dtype whose .name the wire codec recognized but
+    # a stale copy of this list would not).
+    from horovod_tpu.common import wire_dtype as _wd
+    return _wd.is_floating(tensor.dtype)
 
 
 class Compressor:
@@ -51,12 +54,42 @@ class NoneCompressor(Compressor):
         return tensor
 
 
+_warned_double_cast = False
+
+
 class _CastCompressor(Compressor):
     _wire_dtype: str = "float16"
 
     @classmethod
     def compress(cls, tensor):
         ctx = tensor.dtype
+        from horovod_tpu.common import wire_dtype as _wd
+        if _wd.active() != _wd.WIRE_NONE:
+            # The negotiated data plane already compresses on the wire
+            # (HOROVOD_COMPRESSION): a framework-level cast on top
+            # would quantize twice and decompress once. Deprecated
+            # no-op in that configuration — warn once and pass
+            # through. NOTE: this latch follows the LOCAL knob (the
+            # world verdict is only known per batch, after
+            # negotiation), so when combining the framework-level
+            # compressor with HOROVOD_COMPRESSION the knob must be
+            # set IDENTICALLY on every rank — a rank without it would
+            # keep casting here and submit a different dtype, which
+            # negotiation rejects loudly (mismatched data types).
+            global _warned_double_cast
+            if not _warned_double_cast:
+                _warned_double_cast = True
+                from horovod_tpu.common import logging as hlog
+                hlog.warning(
+                    "Compression.fp16/bf16 is a pass-through while "
+                    "HOROVOD_COMPRESSION="
+                    f"{_wd.WIRE_NAMES[_wd.active()]} is set: the "
+                    "negotiated data plane compresses on the wire "
+                    "instead (to the world's least aggressive "
+                    "proposal — set the knob on EVERY rank, or a "
+                    "mixed world degrades to uncompressed); drop "
+                    "the framework-level compressor")
+            return tensor, None
         if _is_floating(tensor):
             if cls._wire_dtype == "bfloat16":
                 import ml_dtypes

@@ -121,6 +121,29 @@ class Config:
     # mpi_operations.cc:179-329). HOROVOD_TPU_SHM=0 forces sockets.
     shm_enabled: bool = True
 
+    # Wire-dtype gradient compression (docs/performance.md; upstream
+    # analog: the Compression API's fp16-on-the-wire, deepened into a
+    # negotiated per-request attribute — common/wire_dtype.py). This
+    # rank PROPOSES the value for every float32/float64 allreduce; the
+    # coordinator resolves the world's common denominator per fused
+    # batch and broadcasts it in the Response, so heterogeneous knobs
+    # degrade to the least aggressive proposal instead of diverging.
+    # none | bf16 (recommended on TPU hosts: f32's exponent range at
+    # half the bytes) | fp16 | int8 (with per-tensor error-feedback
+    # residuals, Deep Gradient Compression style).
+    compression: str = "none"
+
+    # Two-level hierarchical allreduce (intra-host shm reduce ->
+    # cross-host ring among local roots -> intra-host shm broadcast;
+    # reference analog: NCCLHierarchicalAllreduce). HOROVOD_TWO_LEVEL=1
+    # stamps multi-host allreduce batches at or above
+    # two_level_threshold_bytes with the two-level algorithm; default
+    # off keeps the existing shm-hier/star/ring routing untouched.
+    # With HOROVOD_AUTOTUNE=1 the per-bucket (algorithm, wire dtype)
+    # choice is tuned instead (common/parameter_manager.py).
+    two_level: bool = False
+    two_level_threshold_bytes: int = 0
+
     # Idle backoff for the background loop (TPU-native extension): after
     # a grace period of empty cycles the negotiation sleep ramps toward
     # this cap instead of waking every cycle_time_ms forever; enqueue
@@ -279,6 +302,17 @@ class Config:
         c.ring_threshold_bytes = _env_int(
             "HOROVOD_TPU_RING_THRESHOLD", c.ring_threshold_bytes)
         c.shm_enabled = _env_bool("HOROVOD_TPU_SHM", c.shm_enabled)
+        c.compression = os.environ.get("HOROVOD_COMPRESSION",
+                                       c.compression).lower()
+        # Validate through THE shared name table (wire_dtype.py) —
+        # a second hardcoded list here would desync the moment a new
+        # wire dtype lands. A typo must not silently run
+        # uncompressed: wire_code_of raises naming the knob.
+        from horovod_tpu.common import wire_dtype as _wdt
+        _wdt.wire_code_of(c.compression)
+        c.two_level = _env_bool("HOROVOD_TWO_LEVEL", c.two_level)
+        c.two_level_threshold_bytes = _env_int(
+            "HOROVOD_TWO_LEVEL_THRESHOLD", c.two_level_threshold_bytes)
         c.idle_backoff_ms = _env_float(
             "HOROVOD_TPU_IDLE_BACKOFF", c.idle_backoff_ms)
         c.hierarchical_allreduce = _env_bool(

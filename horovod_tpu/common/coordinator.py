@@ -22,6 +22,7 @@ from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Tuple
 
 from horovod_tpu.common import logging as hlog
+from horovod_tpu.common import wire_dtype as _wd
 from horovod_tpu.common.message import (
     DataType, Request, RequestType, Response, ResponseType, datatype_name,
     datatype_size,
@@ -216,11 +217,23 @@ def construct_response(table: MessageTable, name: str,
         numel = 1
         for d in first.tensor_shape:
             numel *= d
+        # Wire-dtype negotiation (common/wire_dtype.py): the verdict is
+        # the LEAST aggressive proposal across ranks — a heterogeneous-
+        # KNOB world (one rank launched with compression off) degrades
+        # to a dtype everyone speaks rather than erroring, mirroring
+        # how the fusion threshold heals. (This heals knob divergence
+        # only, not build divergence: the control frames themselves
+        # carry the proposal byte, so every rank must run the same
+        # wire layout.) Only compressible dtypes (f32/f64) ever carry
+        # a nonzero verdict.
+        wire = _wd.resolve(req.wire_dtype for req in requests) \
+            if first.tensor_type in _wd.COMPRESSIBLE else _wd.WIRE_NONE
         return Response(response_type=ResponseType.ALLREDUCE,
                         tensor_names=[name], devices=devices,
                         tensor_sizes=[numel],
                         prescale_factor=first.prescale_factor,
-                        postscale_factor=first.postscale_factor)
+                        postscale_factor=first.postscale_factor,
+                        wire_dtype=wire)
     if op == RequestType.ALLGATHER:
         return Response(response_type=ResponseType.ALLGATHER,
                         tensor_names=[name], devices=devices,
@@ -314,7 +327,11 @@ def fuse_responses(responses: List[Response],
                 and dtypes[cand.tensor_names[0]] == dtype
                 and cand.devices == resp.devices
                 and cand.prescale_factor == resp.prescale_factor
-                and cand.postscale_factor == resp.postscale_factor)
+                and cand.postscale_factor == resp.postscale_factor
+                # one fused buffer = one wire representation and one
+                # data-plane route; mixed verdicts must not share it
+                and cand.wire_dtype == resp.wire_dtype
+                and cand.algorithm == resp.algorithm)
             if joinable:
                 # Byte accounting once per candidate, after the cheap
                 # compatibility checks pass (and only then — computing
@@ -386,7 +403,9 @@ class _CacheEntry:
                         devices=list(r.devices),
                         tensor_sizes=list(r.tensor_sizes),
                         prescale_factor=r.prescale_factor,
-                        postscale_factor=r.postscale_factor)
+                        postscale_factor=r.postscale_factor,
+                        wire_dtype=r.wire_dtype,
+                        algorithm=r.algorithm)
 
 
 class ResponseCache:
@@ -445,10 +464,13 @@ class ResponseCache:
     @staticmethod
     def signature(req: Request) -> tuple:
         """Everything that determines a Request's negotiated verdict
-        (rank-local: shape and device legitimately differ per rank)."""
+        (rank-local: shape and device legitimately differ per rank).
+        The proposed wire dtype is part of it: a knob change must
+        renegotiate the compression verdict, not replay a stale one."""
         return (int(req.request_type), int(req.tensor_type),
                 req.tensor_shape, req.root_rank, req.device,
-                req.prescale_factor, req.postscale_factor)
+                req.prescale_factor, req.postscale_factor,
+                req.wire_dtype)
 
     def lookup(self, req: Request) -> Tuple[int, int]:
         """(state, slot): HIT — the queued request matches the cached
@@ -469,7 +491,8 @@ class ResponseCache:
                 and s[2] == req.tensor_shape and s[3] == req.root_rank
                 and s[4] == req.device
                 and s[5] == req.prescale_factor
-                and s[6] == req.postscale_factor):
+                and s[6] == req.postscale_factor
+                and s[7] == req.wire_dtype):
             self.hits += 1
             return self.HIT, e.slot
         self.misses += 1
@@ -536,6 +559,17 @@ class ResponseCache:
             e = self._slots[slot]
             if e is not None:
                 self._lru.move_to_end(e.name)
+
+    def slot_mask(self, response_type: ResponseType) -> int:
+        """Mask of occupied slots holding a verdict of
+        ``response_type`` — read-only (the coordinator's wire-plan
+        eviction builds its broadcast invalid mask from it)."""
+        mask = 0
+        for e in self._slots:
+            if e is not None \
+                    and e.response.response_type == response_type:
+                mask |= 1 << e.slot
+        return mask
 
     def entry(self, slot: int) -> _CacheEntry:
         e = self._slots[slot]

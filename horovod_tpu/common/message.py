@@ -123,7 +123,7 @@ class Request:
 
     __slots__ = ("request_rank", "request_type", "tensor_type",
                  "tensor_name", "root_rank", "device", "tensor_shape",
-                 "prescale_factor", "postscale_factor")
+                 "prescale_factor", "postscale_factor", "wire_dtype")
 
     def __init__(self, request_rank: int = 0,
                  request_type: RequestType = RequestType.ALLREDUCE,
@@ -133,7 +133,8 @@ class Request:
                  device: int = -1,
                  tensor_shape: Sequence[int] = (),
                  prescale_factor: float = 1.0,
-                 postscale_factor: float = 1.0):
+                 postscale_factor: float = 1.0,
+                 wire_dtype: int = 0):
         self.request_rank = request_rank
         # Enum() calls dominate a hot enqueue burst's Request inits;
         # skip the re-wrap when the caller already passed the enum.
@@ -149,6 +150,13 @@ class Request:
         self.tensor_shape = tuple(int(d) for d in tensor_shape)
         self.prescale_factor = prescale_factor
         self.postscale_factor = postscale_factor
+        # Proposed wire dtype (common/wire_dtype.py WIRE_* codes): this
+        # rank's bid for on-the-wire compression of this tensor. The
+        # coordinator resolves the world's common denominator and
+        # broadcasts the verdict in Response.wire_dtype — negotiated
+        # exactly like the fusion threshold, so heterogeneous knobs
+        # degrade instead of diverging.
+        self.wire_dtype = wire_dtype
 
     def __eq__(self, other):
         return (isinstance(other, Request) and
@@ -188,7 +196,7 @@ class Response:
 
     __slots__ = ("response_type", "tensor_names", "error_message",
                  "devices", "tensor_sizes", "prescale_factor",
-                 "postscale_factor")
+                 "postscale_factor", "wire_dtype", "algorithm")
 
     def __init__(self, response_type: ResponseType = ResponseType.ALLREDUCE,
                  tensor_names: List[str] | None = None,
@@ -196,7 +204,9 @@ class Response:
                  devices: List[int] | None = None,
                  tensor_sizes: List[int] | None = None,
                  prescale_factor: float = 1.0,
-                 postscale_factor: float = 1.0):
+                 postscale_factor: float = 1.0,
+                 wire_dtype: int = 0,
+                 algorithm: int = 0):
         self.response_type = ResponseType(response_type)
         self.tensor_names = tensor_names if tensor_names is not None else []
         self.error_message = error_message
@@ -204,6 +214,14 @@ class Response:
         self.tensor_sizes = tensor_sizes if tensor_sizes is not None else []
         self.prescale_factor = prescale_factor
         self.postscale_factor = postscale_factor
+        # The coordinator's world-coherent verdicts for this (possibly
+        # fused) batch: wire_dtype = resolved WIRE_* compression every
+        # rank applies symmetrically on the data plane; algorithm =
+        # stamped ALG_* route (default keeps each backend's own
+        # size-based heuristics). Broadcast with the response, cached
+        # with it, replayed with it.
+        self.wire_dtype = wire_dtype
+        self.algorithm = algorithm
 
     def add_tensor_name(self, name: str) -> None:
         self.tensor_names.append(name)

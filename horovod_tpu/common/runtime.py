@@ -33,6 +33,7 @@ from horovod_tpu.common import logging as hlog
 from horovod_tpu.common import metrics as hmetrics
 from horovod_tpu.common import steady as hsteady
 from horovod_tpu.common import wire
+from horovod_tpu.common import wire_dtype as _wd
 from horovod_tpu.common.config import Config
 from horovod_tpu.common.controller import Controller
 from horovod_tpu.common.coordinator import (
@@ -42,7 +43,7 @@ from horovod_tpu.common.coordinator import (
 from horovod_tpu.common.invariants import world_coherent
 from horovod_tpu.common.message import (
     CacheCycleRequest, CacheCycleResponse, DataType, Request, RequestList,
-    RequestType, Response, ResponseList, ResponseType,
+    RequestType, Response, ResponseList, ResponseType, datatype_size,
     datatype_to_numpy_dtype, numpy_dtype_to_datatype,
 )
 from horovod_tpu.common.status import (
@@ -133,6 +134,13 @@ class Runtime:
         # docs/performance.md). All knobs must match across ranks —
         # the frame kinds and epochs fail fast on divergence.
         self._cache: Optional[ResponseCache] = None
+        # The cache stays ON under autotune: cached replays would pin
+        # every steady tensor to the (algorithm, wire dtype) verdict
+        # of its FIRST negotiation, so whenever the tuner's active
+        # combo changes the coordinator force-evicts every cached
+        # allreduce verdict world-wide through the broadcast invalid
+        # mask (_stale_plan_slots) — the tensors renegotiate under
+        # the new plan and the tuner measures what it steers.
         if config.cache_enabled and config.cache_capacity > 0:
             # Elastic worlds seed the epoch from the world generation:
             # every post-resize rank starts at the SAME (bumped) epoch,
@@ -170,12 +178,17 @@ class Runtime:
         # negotiation + data plane in a single world round-trip. Any
         # deviation on any rank degrades that cycle to the classic
         # two-round cached path (the payload is simply ignored).
-        # Autotune steers fusion/cycle parameters mid-run through full
-        # responses, which speculation would starve — mutually
-        # exclusive by construction.
-        self._spec_enabled = (self._cache is not None
-                              and config.cache_speculative
-                              and parameter_manager is None)
+        # Under autotune, speculation is gated per-PHASE
+        # (ParameterManager.spec_safe): live through the discrete
+        # grid phase — so per-combo scores measure the DEPLOYMENT
+        # regime, spec cycle included — and after convergence, but
+        # off while the Bayesian phase steers fusion/cycle parameters
+        # through full-response trailers that speculation would
+        # starve. The gate is coordinator-side (a spec round needs
+        # the coordinator's own bid), so a worker's view of the
+        # phase never has to be synchronized.
+        self._spec_ok = (self._cache is not None
+                         and config.cache_speculative)
         # Recently fully-granted pure-hit masks -> their name sets
         # (insertion-ordered, capped): the steady-state predictions,
         # doubling as the burst-hold's (_absorb_burst) reference sets.
@@ -193,8 +206,47 @@ class Runtime:
         # cached-cycle responses: replay and speculative packing must
         # fuse with the WORLD's value, not this rank's local config
         # (a divergent HOROVOD_FUSION_THRESHOLD would otherwise build
-        # mismatched batches from the same grant).
-        self._world_fusion_threshold = config.fusion_threshold_bytes
+        # mismatched batches from the same grant). World-replicated:
+        # only the broadcast verdict may move it.
+        self._world_fusion_threshold = \
+            config.fusion_threshold_bytes  # hvdlint: world-replicated
+        # Wire-dtype compression (common/wire_dtype.py): this rank's
+        # PROPOSAL, attached to every compressible allreduce Request;
+        # the coordinator's resolved verdict rides each Response (and
+        # the cache with it), so the applied dtype is world-coherent
+        # by the same broadcast that makes the negotiation coherent.
+        self._wire_propose = _wd.wire_code_of(config.compression)
+        t = getattr(controller, "topology", None)
+        self._multi_host = (t is not None
+                            and t.local_size < t.size)
+        # Algorithm/dtype policy consulted when stamping fused
+        # responses (coordinator only): the autotuner when armed
+        # (ParameterManager.plan — per-size-bucket tuned table), the
+        # static config policy otherwise.
+        if parameter_manager is not None:
+            self._wire_policy = parameter_manager
+            parameter_manager.configure_wire(
+                self._wire_propose, self._multi_host, controller.size,
+                shm_enabled=config.shm_enabled,
+                ring_allowed=config.ring_threshold_bytes >= 0)
+        else:
+            self._wire_policy = _wd.StaticWirePolicy(
+                config.two_level, config.two_level_threshold_bytes,
+                self._multi_host, shm_enabled=config.shm_enabled)
+            if config.two_level and controller.rank == 0 \
+                    and not (self._multi_host and config.shm_enabled):
+                hlog.warning(
+                    "HOROVOD_TWO_LEVEL=1 has no effect: the two-level"
+                    " plane needs a multi-host world with the shm"
+                    " data plane enabled (HOROVOD_TPU_SHM=1)")
+        # Last stamped/applied (algorithm, wire dtype) — rank-local
+        # observability for the stall report.
+        self._last_wire_verdict = None
+        # Last wire-plan revision this coordinator stamped under: a
+        # bump means the tuner moved the active combo, and every
+        # cached allreduce verdict is stale — force-evicted world-wide
+        # on the next cycle (see _coordinate_cycle).
+        self._wire_plan_rev = 0
         # mask -> consecutive speculative bids the world answered with
         # a CLASSIC full grant: everything was granted, yet the fused
         # round was refused — the signature of a peer that will never
@@ -219,9 +271,9 @@ class Runtime:
         # falls back to the classic PR 3 path for that cycle, and the
         # wire format is byte-identical either way, so mixed
         # native/pure-Python worlds interoperate frame-for-frame.
-        self._steady_native = (config.zero_copy
-                               and self._spec_enabled
-                               and controller.steady_native_ready())
+        self._steady_native_ok = (config.zero_copy
+                                  and self._spec_ok
+                                  and controller.steady_native_ready())
         self._send_arena = harena.FusionArena()
         # (mask, threshold) -> SteadyPlan, valid for one cache epoch.
         self._steady_plans: Dict[tuple, hsteady.SteadyPlan] = {}
@@ -273,6 +325,16 @@ class Runtime:
             "hvd_data_copies_total",
             "payload byte-object copies on fallback data paths "
             "(0 while the zero-copy plane is engaged)")
+        # Wire-compression plane (same counter objects as the socket
+        # backend's module hooks — the registry memoizes by name).
+        self._m_wire_saved = reg.counter(
+            "hvd_wire_bytes_saved_total",
+            "payload bytes kept OFF the wire by the negotiated "
+            "wire dtype (uncompressed minus wire size, per send)")
+        self._m_comp_ratio = reg.histogram(
+            "hvd_compression_ratio",
+            "wire bytes / uncompressed bytes per compressed payload",
+            hmetrics.RATIO_BUCKETS)
         self._m_cache_hits = reg.counter("hvd_cache_hits_total")
         self._m_cache_misses = reg.counter("hvd_cache_misses_total")
         self._m_cache_evictions = reg.counter(
@@ -348,6 +410,15 @@ class Runtime:
                     self._metrics_log = hmetrics.JsonlMetricsLog(
                         config.metrics_log)
 
+    @property
+    def _spec_enabled(self) -> bool:
+        pm = self.parameter_manager
+        return self._spec_ok and (pm is None or pm.spec_safe)
+
+    @property
+    def _steady_native(self) -> bool:
+        return self._steady_native_ok and self._spec_enabled
+
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:
         self._thread = threading.Thread(target=self._background_loop,
@@ -391,7 +462,9 @@ class Runtime:
                       device=entry.device,
                       tensor_shape=shape,
                       prescale_factor=prescale,
-                      postscale_factor=postscale)
+                      postscale_factor=postscale,
+                      wire_dtype=self._propose_wire(request_type,
+                                                    dtype))
         entry.request_type = request_type
         if not self.tensor_table.add(entry, req):
             return Status.InvalidArgument(
@@ -430,7 +503,9 @@ class Runtime:
                           device=entry.device,
                           tensor_shape=shape,
                           prescale_factor=prescale,
-                          postscale_factor=postscale)
+                          postscale_factor=postscale,
+                          wire_dtype=self._propose_wire(request_type,
+                                                        dtype))
             entry.request_type = request_type
             pairs.append((entry, req))
         dup = self.tensor_table.add_all(pairs)
@@ -450,6 +525,18 @@ class Runtime:
         if not self._wake.is_set():
             self._wake.set()
         return Status.OK()
+
+    def _propose_wire(self, request_type: RequestType,
+                      dtype: DataType) -> int:
+        """This rank's wire-dtype bid for one request: the configured
+        compression for float32/float64 allreduces (the gradient
+        path), none for everything else. The coordinator min-resolves
+        the world's bids per tensor, so a divergent knob degrades the
+        verdict instead of the world."""
+        if self._wire_propose and request_type == RequestType.ALLREDUCE \
+                and dtype in _wd.COMPRESSIBLE:
+            return self._wire_propose
+        return _wd.WIRE_NONE
 
     def _resolve_abort(self, origin: int, cause: str) -> tuple:
         """A blame inferred from an anonymous transport error can race
@@ -775,12 +862,30 @@ class Runtime:
         bytes — _run_loop_once dispatches on the type."""
         from horovod_tpu.ops.socket_ops import _pack_fused, _to_numpy
         cache = self._cache
+        pm = self.parameter_manager
+        if pm is not None and self.controller.is_coordinator \
+                and pm.plan_revision != self._wire_plan_rev:
+            # The tuner just moved the active combo: the pending
+            # world-wide eviction must run through _coordinate_cycle
+            # this cycle — a native/spec grant would bypass it and
+            # keep replaying verdicts of the superseded plan.
+            return None
         plan = self._replay_plan(hit_mask, self._world_fusion_threshold)
         seg_arrays = []
+        seg_wires = []
         prescales = []
         inflight = []
         for resp in plan:
             if resp.response_type != ResponseType.ALLREDUCE:
+                return None
+            if resp.algorithm not in (_wd.ALG_DEFAULT, _wd.ALG_STAR):
+                # Ring/two-level batches own their data plane; the
+                # speculative round must not steal them.
+                return None
+            if resp.wire_dtype == _wd.WIRE_INT8:
+                # int8 payloads carry per-rank scales the inline
+                # coordinator reduce cannot sum — the classic star
+                # path (which dequantizes) keeps carrying them.
                 return None
             entries = self.tensor_table.peek_entries(resp.tensor_names)
             if entries is None:
@@ -794,16 +899,26 @@ class Runtime:
                     sum(a.nbytes for a in arrays)):
                 return None
             seg_arrays.append(arrays)
+            seg_wires.append(resp.wire_dtype)
             prescales.append(resp.prescale_factor)
             inflight.append((resp, entries, arrays))
         if self._steady_native:
-            splan = self._steady_plan_for(hit_mask, seg_arrays)
+            splan = self._steady_plan_for(hit_mask, seg_arrays,
+                                          seg_wires)
             if splan is not None:
                 # Coordinator accumulators double as the broadcast
                 # result its outputs will alias — fresh, never arena.
                 bufs = splan.pack(
                     seg_arrays, prescales,
                     use_arena=not self.controller.is_coordinator)
+                if any(seg_wires):
+                    from horovod_tpu.ops.socket_ops import (
+                        record_compression,
+                    )
+                    record_compression(
+                        sum(sum(a.nbytes for a in arrays)
+                            for arrays in seg_arrays),
+                        sum(splan.seg_nbytes))
                 self._spec_inflight = inflight
                 self._spec_steady = (splan, bufs)
                 self._spec_bids += 1
@@ -811,18 +926,29 @@ class Runtime:
         segments = []
         for (resp, _, arrays) in inflight:
             fused, _ = _pack_fused(arrays, resp)  # applies prescale
-            segments.append((numpy_dtype_to_datatype(fused.dtype),
-                             fused))
+            w = resp.wire_dtype
+            if w:
+                from horovod_tpu.ops.socket_ops import (
+                    compress_send_payload,
+                )
+                wirearr = compress_send_payload(fused, w)
+                segments.append((_wd.wire_datatype(w), wirearr))
+            else:
+                segments.append((numpy_dtype_to_datatype(fused.dtype),
+                                 fused))
         self._spec_inflight = inflight
         self._spec_bids += 1
         return wire.serialize_cycle_request(CacheCycleRequest(
             epoch=cache.epoch, nslots=cache.nslots, hit_mask=hit_mask,
             spec_payload=segments))
 
-    def _steady_plan_for(self, hit_mask: int, seg_arrays):
+    def _steady_plan_for(self, hit_mask: int, seg_arrays, seg_wires):
         """Memoized SteadyPlan for (mask, threshold) at the current
         cache epoch; None when a segment's dtype has no native reduce
-        kernel (the classic path carries it)."""
+        kernel (the classic path carries it). With a negotiated wire
+        dtype the plan's segments are declared IN the wire dtype — the
+        native coordinator reduces bf16/fp16 through the same
+        hvd_sum_into codes, and pack compresses into the arena."""
         cache = self._cache
         if self._steady_plan_epoch != cache.epoch:
             self._steady_plans.clear()
@@ -831,12 +957,19 @@ class Runtime:
         splan = self._steady_plans.get(key)
         if splan is None:
             segments = []
-            for arrays in seg_arrays:
+            for arrays, wire in zip(seg_arrays, seg_wires):
                 dtype = arrays[0].dtype
                 if any(a.dtype != dtype for a in arrays):
                     return None
-                segments.append((numpy_dtype_to_datatype(dtype), dtype,
-                                 sum(a.nbytes for a in arrays)))
+                src_nbytes = sum(a.nbytes for a in arrays)
+                if wire:
+                    np_wire = _wd.wire_np_dtype(wire)
+                    count = src_nbytes // dtype.itemsize
+                    segments.append((_wd.wire_datatype(wire), np_wire,
+                                     count * np_wire.itemsize, dtype))
+                else:
+                    segments.append((numpy_dtype_to_datatype(dtype),
+                                     dtype, src_nbytes, None))
             splan = hsteady.SteadyPlan(cache.epoch, cache.nslots,
                                        hit_mask, segments,
                                        self._send_arena)
@@ -1122,6 +1255,20 @@ class Runtime:
                 spec_frames.append(cf)
             if cf.requests:
                 req_lists.append(RequestList(cf.requests, cf.shutdown))
+        if self.parameter_manager is not None:
+            # Tuner moved the active (algorithm, wire dtype) combo:
+            # every cached allreduce verdict was stamped under the
+            # OLD plan. Fold a coordinator-initiated eviction of
+            # those slots into the broadcast invalid mask — a
+            # world-identical event by construction, so every rank's
+            # cache (this one included) drops them in the same
+            # canonical order and the tensors renegotiate under the
+            # new plan. Also suppresses this cycle's spec grant
+            # (or_invalid is part of its precondition).
+            rev = self.parameter_manager.plan_revision
+            if rev != self._wire_plan_rev:
+                self._wire_plan_rev = rev
+                or_invalid |= self._stale_plan_slots()
         if (spec_frames and len(spec_frames) == n_frames
                 and not shutdown and not or_invalid
                 and all(cf.hit_mask == and_hits
@@ -1153,6 +1300,14 @@ class Runtime:
                                   invalid_mask=or_invalid,
                                   response_list=resp_list)
         return wire.serialize_cycle_response(meta), meta
+
+    def _stale_plan_slots(self) -> int:
+        """Mask of every cached slot holding an ALLREDUCE verdict —
+        the entries whose stamped (algorithm, wire dtype) belongs to
+        a superseded tuner plan. Read-only over the coordinator's own
+        cache; the eviction itself happens on every rank through the
+        broadcast invalid mask."""
+        return self._cache.slot_mask(ResponseType.ALLREDUCE)
 
     # Canonical ascending-bit iteration, shared with the cache's own
     # mask-driven mutations (coordinator.iter_set_bits) so replay and
@@ -1361,9 +1516,23 @@ class Runtime:
                 self._m_ops_allreduce.inc()
                 self._m_bytes_allreduced.inc(
                     sum(a.nbytes for a in arrays))
+            # Autotune score attribution: spec cycles bypass
+            # _perform_operations, so their bytes must feed the
+            # tuner's bytes/µs stream here (the grid phase measures
+            # the deployment regime, spec cycle included).
+            self._cycle_bytes += sum(a.nbytes for a in arrays)
             names = resp.tensor_names
             popped = self.tensor_table.pop_entries(names)
-            if isinstance(buf, np.ndarray):
+            if resp.wire_dtype:
+                # Compressed steady cycle: the world result arrived in
+                # the negotiated wire dtype; decompress ONCE into a
+                # fresh full-precision array outputs may alias (a
+                # cast, not a fallback byte copy — hvd_data_copies
+                # stays 0 on this path).
+                result = _wd.decompress(
+                    buf, resp.wire_dtype, arrays[0].dtype,
+                    sum(a.size for a in arrays))
+            elif isinstance(buf, np.ndarray):
                 # Zero-copy plane: the native cycle received the world
                 # result into a FRESH writable per-step buffer (never
                 # arena memory), so outputs may alias it directly.
@@ -1416,7 +1585,9 @@ class Runtime:
                         devices=list(resp.devices),
                         tensor_sizes=sizes,
                         prescale_factor=resp.prescale_factor,
-                        postscale_factor=resp.postscale_factor)
+                        postscale_factor=resp.postscale_factor,
+                        wire_dtype=resp.wire_dtype,
+                        algorithm=resp.algorithm)
 
     @world_coherent
     def _populate_cache(self, resp_list: ResponseList) -> None:
@@ -1530,6 +1701,11 @@ class Runtime:
         the metrics plane maintains them — one warning then carries
         enough to diagnose without a second tool."""
         parts = [f"tensor queue depth {len(self.tensor_table)}"]
+        if self._last_wire_verdict is not None:
+            alg, w = self._last_wire_verdict
+            parts.append(
+                f"wire plan {_wd.ALG_NAMES.get(alg, alg)}"
+                f"/{_wd.WIRE_NAMES.get(w, w)}")
         if self._elastic is not None:
             parts.append(self._elastic.world_line())
         ages = self.controller.peer_heartbeat_ages()
@@ -1609,6 +1785,34 @@ class Runtime:
                                                         cause),
                                     origin_rank=origin, cause=cause)
 
+    def _stamp_wire_plan(self, fused: List[Response]) -> None:
+        """Coordinator-side algorithm/dtype stamping of a cycle's
+        fused allreduce batches: the policy (static config or the
+        autotuner's per-bucket table) picks the ALG_* route for the
+        batch's UNCOMPRESSED size and may cap the min-resolved wire
+        dtype (the tuner explores dtypes by capping — it can only
+        ever weaken a rank's proposal, never exceed it, so tuning
+        stays numerics-safe). Runs before the broadcast, so the
+        verdicts ride the same world-identical response stream as
+        everything else."""
+        for resp in fused:
+            if resp.response_type != ResponseType.ALLREDUCE \
+                    or not resp.tensor_names:
+                continue
+            dtype = self._dtypes.get(resp.tensor_names[0])
+            if dtype is None:
+                continue
+            nbytes = sum(resp.tensor_sizes) * datatype_size(dtype)
+            alg, cap = self._wire_policy.plan(nbytes)
+            resp.algorithm = alg
+            if cap is not None and resp.wire_dtype > cap:
+                resp.wire_dtype = cap
+            if alg or resp.wire_dtype:
+                self._last_wire_verdict = (alg, resp.wire_dtype)
+                self.timeline.wire_plan(
+                    f"{_wd.ALG_NAMES[alg]}/"
+                    f"{_wd.WIRE_NAMES[resp.wire_dtype]}")
+
     def _coordinate(self, req_lists: List[RequestList],
                     extra_shutdown: bool = False) -> ResponseList:
         """Coordinator half of the cycle
@@ -1628,13 +1832,20 @@ class Runtime:
         ready = table.pop_ready()
         responses = []
         for name in ready:
-            self.timeline.negotiate_end(name)
-            responses.append(construct_response(table, name, size))
+            resp = construct_response(table, name, size)
+            # The NEGOTIATE_* span's end names the resolved wire
+            # dtype, so a timeline reader can see compression engage
+            # per tensor without cross-referencing metrics.
+            self.timeline.negotiate_end(
+                name, verdict=_wd.WIRE_NAMES[resp.wire_dtype]
+                if resp.wire_dtype else "")
+            responses.append(resp)
         threshold = self.config.fusion_threshold_bytes
         if self.parameter_manager is not None:
             threshold = self.parameter_manager.fusion_threshold_bytes()
         fused = fuse_responses(responses, self._dtypes, threshold,
                                self._slice_numels)
+        self._stamp_wire_plan(fused)
         for resp in fused:
             for n in resp.tensor_names:
                 self._dtypes.pop(n, None)
@@ -1707,6 +1918,12 @@ class Runtime:
         for response in resp_list.responses:
             self._op_count += 1
             faults.tick_op(self, self._op_count)
+            if response.wire_dtype or response.algorithm:
+                # Rank-local observability: the stall report names the
+                # last applied (algorithm, wire dtype) on every rank,
+                # not just the stamping coordinator.
+                self._last_wire_verdict = (response.algorithm,
+                                           response.wire_dtype)
             entries = self.tensor_table.pop_entries(
                 response.tensor_names)
             if response.response_type == ResponseType.ERROR:

@@ -50,23 +50,33 @@ class SteadyPlan:
 
     __slots__ = ("epoch", "nslots", "mask", "seg_dtypes",
                  "seg_np_dtypes", "seg_nbytes", "seg_counts",
-                 "seg_codes", "prefix", "seg_hdrs", "payload_nbytes",
-                 "arena", "send_views", "native_ok", "cache")
+                 "seg_codes", "seg_src_dtypes", "prefix", "seg_hdrs",
+                 "payload_nbytes", "arena", "send_views",
+                 "stage_views", "native_ok", "cache")
 
     def __init__(self, epoch: int, nslots: int, mask: int,
                  segments, arena: FusionArena):
-        """``segments``: [(DataType, np_dtype, nbytes), ...] in
-        replay-plan order."""
+        """``segments``: [(DataType, np_dtype, nbytes, src_np_dtype),
+        ...] in replay-plan order, where ``np_dtype``/``nbytes``
+        describe the ON-WIRE representation and ``src_np_dtype`` names
+        the tensors' real dtype when a negotiated wire dtype
+        compresses this segment (None = uncompressed; a legacy
+        3-tuple means the same)."""
         self.epoch = epoch
         self.nslots = nslots
         self.mask = mask
-        self.seg_dtypes = [dt for dt, _, _ in segments]
-        self.seg_np_dtypes = [np.dtype(npdt) for _, npdt, _ in segments]
-        self.seg_nbytes = [n for _, _, n in segments]
-        self.seg_counts = [n // np.dtype(npdt).itemsize
-                           for _, npdt, n in segments]
-        codes = [_native._DTYPE_CODES.get(str(np.dtype(npdt)))
-                 for _, npdt, _ in segments]
+        segments = [tuple(s) + (None,) if len(s) == 3 else tuple(s)
+                    for s in segments]
+        self.seg_dtypes = [s[0] for s in segments]
+        self.seg_np_dtypes = [np.dtype(s[1]) for s in segments]
+        self.seg_nbytes = [s[2] for s in segments]
+        self.seg_src_dtypes = [None if s[3] is None else np.dtype(s[3])
+                               for s in segments]
+        self.seg_counts = [n // npdt.itemsize
+                           for npdt, n in zip(self.seg_np_dtypes,
+                                              self.seg_nbytes)]
+        codes = [_native._DTYPE_CODES.get(str(npdt))
+                 for npdt in self.seg_np_dtypes]
         self.seg_codes = codes
         # The native coordinator must be able to reduce every segment
         # in C; one exotic dtype degrades the whole cycle to Python.
@@ -74,22 +84,40 @@ class SteadyPlan:
                                                 for c in codes)
         self.prefix, self.seg_hdrs = wire.spec_frame_parts(
             epoch, nslots, mask,
-            [(dt, n) for dt, _, n in segments])
+            [(dt, n) for dt, n in zip(self.seg_dtypes,
+                                      self.seg_nbytes)])
         self.payload_nbytes = (len(self.prefix)
                                + sum(len(h) for h in self.seg_hdrs)
                                + sum(self.seg_nbytes))
         self.arena = arena
         # Send-side segment views: stable arena memory, so the iovec
-        # pointers below survive across steps.
+        # pointers below survive across steps. Compressed segments
+        # additionally get a full-precision STAGING view right after
+        # the wire region — pack concatenates + prescales there, then
+        # casts once into the wire view (send bytes only ever live in
+        # the arena; the staging bytes never reach the wire).
         off = 0
         views = []
-        total = sum(self.seg_nbytes)
-        arena.ensure(total)
+        wire_total = sum(self.seg_nbytes)
+        stage_total = sum(
+            cnt * src.itemsize
+            for cnt, src in zip(self.seg_counts, self.seg_src_dtypes)
+            if src is not None)
+        arena.ensure(wire_total + stage_total)
         for npdt, n, count in zip(self.seg_np_dtypes, self.seg_nbytes,
                                   self.seg_counts):
             views.append(arena.typed(off, npdt, count))
             off += n
         self.send_views = views
+        stages = []
+        soff = wire_total
+        for count, src in zip(self.seg_counts, self.seg_src_dtypes):
+            if src is None:
+                stages.append(None)
+            else:
+                stages.append(arena.typed(soff, src, count))
+                soff += count * src.itemsize
+        self.stage_views = stages
         # Role-specific ctypes bundles attached by the controllers;
         # dies with the plan (plans are epoch-memoized in the runtime).
         self.cache: Dict = {}
@@ -107,20 +135,35 @@ class SteadyPlan:
         pointers, zero allocations) or fresh accumulators
         (coordinator — its outputs alias the reduced buffers, which
         must therefore never be arena memory)."""
+        from horovod_tpu.common import wire_dtype as _wd
         bufs = []
         for j, arrays in enumerate(seg_arrays):
             npdt = self.seg_np_dtypes[j]
-            if use_arena:
-                dst = self.send_views[j]
-            else:
-                dst = np.empty(self.seg_counts[j], npdt)
+            src_dt = self.seg_src_dtypes[j]
             flats = [a.reshape(-1) if a.flags["C_CONTIGUOUS"]
                      else np.ascontiguousarray(a).reshape(-1)
                      for a in arrays]
-            concat_into(flats, dst)
+            if src_dt is None:
+                dst = self.send_views[j] if use_arena \
+                    else np.empty(self.seg_counts[j], npdt)
+                concat_into(flats, dst)
+                f = prescales[j]
+                if f != 1.0:
+                    np.multiply(dst, np.asarray(f, npdt), out=dst)
+                bufs.append(dst)
+                continue
+            # Compressed segment: concat + prescale in the tensors'
+            # real dtype (staging), one cast into the wire view — the
+            # native hvd_cast kernel when it speaks the pair.
+            stage = self.stage_views[j] if use_arena \
+                else np.empty(self.seg_counts[j], src_dt)
+            concat_into(flats, stage)
             f = prescales[j]
             if f != 1.0:
-                np.multiply(dst, np.asarray(f, npdt), out=dst)
+                np.multiply(stage, np.asarray(f, src_dt), out=stage)
+            dst = self.send_views[j] if use_arena \
+                else np.empty(self.seg_counts[j], npdt)
+            _wd.cast_into(stage, dst)
             bufs.append(dst)
         return bufs
 

@@ -42,8 +42,9 @@ class _NoOpTimeline:
     def attach_drop_counter(self, counter): pass
     def negotiate_start(self, name, request_type): pass
     def negotiate_rank_ready(self, name, rank): pass
-    def negotiate_end(self, name): pass
+    def negotiate_end(self, name, verdict=""): pass
     def negotiate_cached(self, fused=False): pass
+    def wire_plan(self, detail): pass
     def start(self, name, op_name): pass
     def activity_start_all(self, names, activity): pass
     def activity_end_all(self, names): pass
@@ -148,8 +149,19 @@ class Timeline(_NoOpTimeline):
     def negotiate_rank_ready(self, name: str, rank: int) -> None:
         self._emit("X", name, f"{rank}", dur=0)
 
-    def negotiate_end(self, name: str) -> None:
-        self._emit("E", name, "")
+    def negotiate_end(self, name: str, verdict: str = "") -> None:
+        # ``verdict`` names the resolved wire dtype so the span's end
+        # carries the compression decision for this tensor.
+        if verdict:
+            self._emit("E", name, "", args={"wire": verdict})
+        else:
+            self._emit("E", name, "")
+
+    def wire_plan(self, detail: str) -> None:
+        """Instant marker naming a fused batch's stamped
+        (algorithm, wire dtype) — NEGOTIATE_WIRE_PLAN in the trace."""
+        self._emit("i", "cycle", f"NEGOTIATE_WIRE_PLAN {detail}",
+                   s="g")
 
     def negotiate_cached(self, fused: bool = False) -> None:
         """Instant marker for a cycle negotiated entirely through the

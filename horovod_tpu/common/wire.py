@@ -21,10 +21,12 @@ Layout (all little-endian):
   vectors are u32 count + elements.
 
   Request      := u8 request_type | i32 request_rank | u8 tensor_type
-                | i32 root_rank | i32 device | str tensor_name
+                | u8 wire_dtype | i32 root_rank | i32 device
+                | str tensor_name
                 | f64 prescale | f64 postscale | u8 ndim | i64 dims[ndim]
   RequestList  := u8 shutdown | u32 n | Request[n]
-  Response     := u8 response_type | str error_message
+  Response     := u8 response_type | u8 wire_dtype | u8 algorithm
+                | str error_message
                 | f64 prescale | f64 postscale
                 | u32 nnames | str names[nnames]
                 | u32 ndev | i32 devices[ndev]
@@ -52,7 +54,11 @@ _F64 = struct.Struct("<d")
 # parses world_size RequestLists per cycle, and per-field unpacks +
 # enum __call__ dominate that cost (measured 86% of a synthetic
 # 64-rank cycle). Same wire layout, one unpack per segment.
-_REQ_HEAD = struct.Struct("<BiBiiI")  # type|rank|dtype|root|device|namelen
+# type|rank|dtype|wire_dtype|root|device|namelen — wire_dtype is the
+# rank's proposed on-the-wire compression (WIRE_* codes,
+# common/wire_dtype.py), negotiated by the coordinator like the
+# fusion threshold.
+_REQ_HEAD = struct.Struct("<BiBBiiI")
 _REQ_TAIL = struct.Struct("<ddB")     # prescale|postscale|ndim
 _REQ_TYPE_OF = RequestType._value2member_map_
 _DTYPE_OF = DataType._value2member_map_
@@ -137,7 +143,7 @@ def _write_request(w: _Writer, req: Request) -> None:
     shape = req.tensor_shape
     w.parts.append(_REQ_HEAD.pack(
         int(req.request_type), req.request_rank, int(req.tensor_type),
-        req.root_rank, req.device, len(name)))
+        req.wire_dtype, req.root_rank, req.device, len(name)))
     w.parts.append(name)
     w.parts.append(_REQ_TAIL.pack(
         req.prescale_factor, req.postscale_factor, len(shape)))
@@ -148,8 +154,8 @@ def _write_request(w: _Writer, req: Request) -> None:
 def _read_request(r: _Reader) -> Request:
     data, off = r.data, r.off
     r._need(_REQ_HEAD.size)
-    (req_type, request_rank, tensor_type, root_rank, device,
-     namelen) = _REQ_HEAD.unpack_from(data, off)
+    (req_type, request_rank, tensor_type, wire_dtype, root_rank,
+     device, namelen) = _REQ_HEAD.unpack_from(data, off)
     off += _REQ_HEAD.size
     if off + namelen + _REQ_TAIL.size > len(data):
         raise ConnectionError(
@@ -181,6 +187,7 @@ def _read_request(r: _Reader) -> Request:
     req.tensor_shape = shape
     req.prescale_factor = prescale
     req.postscale_factor = postscale
+    req.wire_dtype = wire_dtype
     return req
 
 
@@ -202,6 +209,10 @@ def parse_request_list(data: bytes) -> RequestList:
 
 def _write_response(w: _Writer, resp: Response) -> None:
     w.u8(int(resp.response_type))
+    # The coordinator's world-coherent data-plane verdicts: resolved
+    # wire dtype + stamped algorithm (WIRE_*/ALG_*, wire_dtype.py).
+    w.u8(resp.wire_dtype)
+    w.u8(resp.algorithm)
     w.string(resp.error_message)
     w.f64(resp.prescale_factor)
     w.f64(resp.postscale_factor)
@@ -223,6 +234,8 @@ def _write_response(w: _Writer, resp: Response) -> None:
 
 def _read_response(r: _Reader) -> Response:
     resp_type = _RESP_TYPE_OF[r.u8()]
+    wire_dtype = r.u8()
+    algorithm = r.u8()
     err = r.string()
     prescale = r.f64()
     postscale = r.f64()
@@ -243,7 +256,8 @@ def _read_response(r: _Reader) -> Response:
         sizes = []
     return Response(response_type=resp_type, tensor_names=names,
                     error_message=err, devices=devices, tensor_sizes=sizes,
-                    prescale_factor=prescale, postscale_factor=postscale)
+                    prescale_factor=prescale, postscale_factor=postscale,
+                    wire_dtype=wire_dtype, algorithm=algorithm)
 
 
 def serialize_response_list(rl: ResponseList) -> bytes:

@@ -58,18 +58,30 @@ def disabled_via_env() -> bool:
             in ("0", "false"))
 
 
+def _so_fresh() -> bool:
+    """The built library exists and is no older than its source."""
+    if not os.path.exists(_SO_PATH):
+        return False
+    return not (os.path.exists(_SRC_PATH)
+                and os.path.getmtime(_SRC_PATH)
+                > os.path.getmtime(_SO_PATH))
+
+
 def _build() -> bool:
     if not os.path.isdir(_NATIVE_DIR):
         return False
     # Multiple local ranks may race the first build. Serialize with an
     # flock'd lockfile and have make produce the .so atomically enough
-    # (each rank re-checks existence under the lock before building).
+    # (each rank re-checks FRESHNESS under the lock before building —
+    # a bare existence check here used to defeat the stale-rebuild
+    # path in get(): a source newer than the .so was never recompiled,
+    # so new native entry points silently stayed missing).
     lock_path = os.path.join(_NATIVE_DIR, ".build.lock")
     try:
         import fcntl
         with open(lock_path, "w") as lock:
             fcntl.flock(lock, fcntl.LOCK_EX)
-            if os.path.exists(_SO_PATH):
+            if _so_fresh():
                 return True
             tmp_target = f"libhvdtpu.build{os.getpid()}.so"
             subprocess.run(
@@ -112,6 +124,16 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.hvd_sum_into.restype = ctypes.c_int
     lib.hvd_sum_into.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int]
+    try:
+        # Stale-.so tolerance (see get()): a pre-compression library
+        # lacks the cast symbol; cast_into then reports unavailable
+        # and callers use the numpy fallback.
+        lib.hvd_cast.restype = ctypes.c_int
+        lib.hvd_cast.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int, ctypes.c_int]
+    except AttributeError:
+        pass
     lib.hvd_hmac_sha256.restype = None
     lib.hvd_hmac_sha256.argtypes = [
         u8p, ctypes.c_int, ctypes.c_uint8, u8p, ctypes.c_int64, u8p]
@@ -168,11 +190,7 @@ def get() -> Optional[ctypes.CDLL]:
         _tried = True
         if disabled_via_env():
             return None
-        stale = (os.path.exists(_SO_PATH)
-                 and os.path.exists(_SRC_PATH)
-                 and os.path.getmtime(_SRC_PATH)
-                 > os.path.getmtime(_SO_PATH))
-        if (not os.path.exists(_SO_PATH) or stale) and not _build():
+        if not _so_fresh() and not _build():
             if not os.path.exists(_SO_PATH):
                 hlog.debug("native core unavailable; using Python paths")
                 return None
@@ -288,6 +306,28 @@ def build_status():
     if not compiler_available():
         return False, "no C++ compiler on PATH"
     return False, "build or load failed with a compiler present"
+
+
+def cast_into(src, dst) -> bool:
+    """dst[:] = src with a dtype cast via the native kernel (the
+    wire-compression leg: f32<->bf16/f16). Returns False when the
+    native path cannot serve this pair (caller falls back to numpy
+    casting). An older .so without the symbol degrades the same way —
+    the stale-library contract of get()."""
+    lib = get()
+    if lib is None or not hasattr(lib, "hvd_cast"):
+        return False
+    sc = _DTYPE_CODES.get(str(src.dtype))
+    dc = _DTYPE_CODES.get(str(dst.dtype))
+    if sc is None or dc is None or src.size != dst.size \
+            or not src.flags["C_CONTIGUOUS"] \
+            or not dst.flags["C_CONTIGUOUS"]:
+        return False
+    rc = lib.hvd_cast(
+        src.ctypes.data_as(ctypes.c_void_p),
+        dst.ctypes.data_as(ctypes.c_void_p),
+        src.size, sc, dc)
+    return rc == 0
 
 
 def sum_into(acc, src) -> bool:

@@ -40,8 +40,14 @@ _TAG_RING_DATA = 41
 
 
 class Ring:
-    """Established ring: one channel to the next rank, one from the
-    previous. Single-threaded use per phase (the background loop)."""
+    """Established ring: one channel to the next member, one from the
+    previous. Single-threaded use per phase (the background loop).
+
+    ``rank``/``size`` are POSITIONS within the ring's member list —
+    for the classic whole-world ring they equal world rank/size; for a
+    subset ring (the two-level plane's cross-host ring among local
+    roots) ``ranks`` maps position -> world rank so failure blame
+    still names the real peer."""
 
     # Link-bytes counter (metrics plane): the socket backend installs
     # the real counter when it establishes the ring; the class-level
@@ -49,13 +55,18 @@ class Ring:
     m_link_bytes = NOOP_METRIC
 
     def __init__(self, rank: int, size: int, next_ch: network.Channel,
-                 prev_ch: network.Channel):
+                 prev_ch: network.Channel, ranks: List[int] = None):
         self._rank = rank
         self._size = size
         self._next = next_ch
         self._prev = prev_ch
+        self._ranks = ranks  # position -> world rank (None = identity)
 
-    def _neighbor_error(self, neighbor: int, e: Exception) -> Exception:
+    def _world_rank(self, pos: int) -> int:
+        return self._ranks[pos] if self._ranks is not None else pos
+
+    def _neighbor_error(self, neighbor_pos: int,
+                        e: Exception) -> Exception:
         """A dead ring link is a world-level failure whose origin is
         the NEIGHBOR, not this (healthy, detecting) rank — return the
         structured abort so the runtime fans the right origin_rank
@@ -63,8 +74,9 @@ class Ring:
         from horovod_tpu.common.status import (
             WorldAbortedError, world_abort_message,
         )
+        neighbor = self._world_rank(neighbor_pos)
         cause = (f"ring link to rank {neighbor} failed on "
-                 f"rank {self._rank}: {e}")
+                 f"rank {self._world_rank(self._rank)}: {e}")
         return WorldAbortedError(world_abort_message(neighbor, cause),
                                  origin_rank=neighbor, cause=cause)
 
@@ -160,10 +172,18 @@ class Ring:
 
 
 def establish(controller, secret: bytes = b"",
-              timeout: float = 30.0, hb=None) -> Optional[Ring]:
+              timeout: float = 30.0, hb=None,
+              members: List[int] = None) -> Optional[Ring]:
     """One-time ring rendezvous through the control plane. Must be
     called at the same negotiated-response position on every rank.
     Returns None (on every rank, by agreement) if any rank fails.
+
+    ``members`` restricts the ring to a subset of world ranks (the
+    two-level plane's cross-host ring among LOCAL ROOTS,
+    ops/shm_ops.py) — every rank still runs the control rounds and the
+    agree() vote (skipping them would hang the gather), but only
+    members open listeners and dial links; non-members get None even
+    on success. ``members=None`` is the classic whole-world ring.
 
     ``hb`` is an optional ``(timeout_s, interval_s)`` liveness deadline
     armed on both ring channels: a neighbor that goes silent mid-
@@ -174,19 +194,26 @@ def establish(controller, secret: bytes = b"",
     one extra poll(2) per chunk recv — noise against the memcpy+wire
     cost of the data-plane payloads that ride the ring."""
     rank, size = controller.rank, controller.size
+    members = list(range(size)) if members is None else list(members)
+    is_member = rank in members
+    pos = members.index(rank) if is_member else -1
+    n = len(members)
 
     # Phase A — advertise my data port. This control-plane exchange
     # runs UNCONDITIONALLY on every rank (a rank that skipped it would
     # hang the others in gather), advertising port -1 on local failure
-    # so the whole world skips phase B together.
+    # (port 0 marks a deliberate non-member) so the whole world skips
+    # phase B together.
     srv = None
-    try:
-        srv = network.listen(0)
-        srv.settimeout(timeout)
-        port = srv.getsockname()[1]
-    except Exception as e:
-        hlog.warning(f"ring listen failed on rank {rank}: {e!r}")
-        port = -1
+    port = 0
+    if is_member:
+        try:
+            srv = network.listen(0)
+            srv.settimeout(timeout)
+            port = srv.getsockname()[1]
+        except Exception as e:
+            hlog.warning(f"ring listen failed on rank {rank}: {e!r}")
+            port = -1
     my = json.dumps({"port": port}).encode()
     try:
         gathered = controller.gather_data(my)
@@ -205,14 +232,15 @@ def establish(controller, secret: bytes = b"",
         addrs = None
 
     ring = None
-    local_ok = False
-    if addrs is not None and all(a[1] > 0 for a in addrs):
+    local_ok = not is_member
+    if addrs is not None and is_member \
+            and all(addrs[m][1] > 0 for m in members):
         # Phase B — dial next, accept prev. Every listener predates
         # every dial (the rendezvous was the barrier) so connect-then-
         # accept cannot deadlock; accept's timeout bounds the wait if a
         # neighbor's dial failed, and agree() below restores consensus.
         try:
-            nxt = (rank + 1) % size
+            nxt = members[(pos + 1) % n]
             ip, nport = addrs[nxt]
             if not ip:  # rank 0's data listener sits by the coordinator
                 ip = getattr(controller, "coordinator_addr", "127.0.0.1")
@@ -228,16 +256,16 @@ def establish(controller, secret: bytes = b"",
             if tag != _TAG_RING_HELLO:
                 raise ConnectionError("ring handshake failed")
             prev_rank = json.loads(hello.decode())["rank"]
-            if prev_rank != (rank - 1) % size:
+            if prev_rank != members[(pos - 1) % n]:
                 raise ConnectionError(
                     f"ring neighbor mismatch: expected "
-                    f"{(rank - 1) % size}, got {prev_rank}")
+                    f"{members[(pos - 1) % n]}, got {prev_rank}")
             prev_ch.peer = f"ring rank {prev_rank} ({prev_ch.peer})"
             if hb is not None:
                 hb_timeout, hb_interval = hb
                 next_ch.arm(hb_timeout, hb_interval)
                 prev_ch.arm(hb_timeout, hb_interval)
-            ring = Ring(rank, size, next_ch, prev_ch)
+            ring = Ring(pos, n, next_ch, prev_ch, ranks=members)
             local_ok = True
         except Exception as e:
             hlog.warning(

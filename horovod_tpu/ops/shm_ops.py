@@ -50,6 +50,7 @@ import numpy as np
 
 from horovod_tpu import native as _native
 from horovod_tpu.common import logging as hlog
+from horovod_tpu.common import wire_dtype as _wd
 from horovod_tpu.common.controller import _my_hostname
 from horovod_tpu.common.message import Response, ResponseType
 from horovod_tpu.common.status import Status
@@ -78,7 +79,7 @@ class ShmBackend(CollectiveBackend):
     name = "shm"
 
     def __init__(self, controller, fallback: CollectiveBackend,
-                 config=None):
+                 config=None, secret: bytes = b""):
         self._ctl = controller
         self._fallback = fallback
         self._map: Optional[mmap.mmap] = None
@@ -94,6 +95,22 @@ class ShmBackend(CollectiveBackend):
         from horovod_tpu.common.arena import FusionArena
         self._arena = FusionArena() if self._zero_copy else None
         self._m_regrows = None  # set by attach_metrics
+        self._m_twolevel = None
+        # Two-level cross-host ring among LOCAL ROOTS (ops/ring.py
+        # subset establishment): lazy, once, world-agreed — same
+        # pattern as the socket backend's flat ring.
+        self._secret = secret
+        self._roots_ring = None
+        self._roots_ring_tried = False
+        self._roots_ok = False  # world-identical after first establish
+        # int8 error-feedback residuals for the cross-host leg — the
+        # same rank-local compensation the socket plane keeps, so the
+        # numerics do not silently depend on the transport.
+        self._ef = _wd.ErrorFeedback()
+        self._ring_hb = None
+        if config is not None and config.heartbeat_timeout_s > 0:
+            self._ring_hb = (config.heartbeat_timeout_s,
+                             config.heartbeat_interval_s)
 
     def attach_metrics(self, registry) -> None:
         super().attach_metrics(registry)
@@ -102,23 +119,43 @@ class ShmBackend(CollectiveBackend):
         self._m_regrows = registry.counter(
             "hvd_shm_segment_regrows_total",
             "shared-memory segment re-establishments")
+        self._m_twolevel = registry.counter(
+            "hvd_ops_twolevel_total",
+            "allreduce batches carried by the two-level plane "
+            "(intra-host shm reduce, cross-host ring among local "
+            "roots, intra-host shm broadcast)")
 
     def enabled(self, entries, response) -> bool:
         """World-consistent by construction: topology is identical on
-        every rank, and anything that can genuinely fail per host
+        every rank, the coordinator's ALG_* stamp rides the broadcast
+        response, and anything that can genuinely fail per host
         (segment creation, /dev/shm itself) is decided inside
         establishment by a world-wide agree() vote."""
         t = getattr(self._ctl, "topology", None)
         if not (self._opt_in and not self._dead and t is not None
                 and t.size > 1):
             return False
+        if response is not None \
+                and response.response_type == ResponseType.ALLREDUCE \
+                and response.algorithm in (_wd.ALG_STAR, _wd.ALG_RING):
+            # A stamped FLAT algorithm belongs to the socket plane —
+            # declining here is what makes the coordinator's verdict
+            # (and the autotuner's exploration) actually select it.
+            return False
         if t.local_size == t.size:
             return True  # same-host world: every collective
-        # Multi-host: the hierarchical local-reduce -> cross -> local-
-        # broadcast path (allreduce only), worthwhile when at least one
-        # host runs several ranks.
-        return (max(t.local_sizes) > 1 and response is not None
-                and response.response_type == ResponseType.ALLREDUCE)
+        if not (response is not None
+                and response.response_type == ResponseType.ALLREDUCE):
+            return False
+        if response.algorithm == _wd.ALG_TWOLEVEL:
+            # The two-level plane serves ANY multi-host world (an
+            # all-solo-hosts topology degenerates to the roots ring —
+            # still hierarchical bookkeeping, no local legs).
+            return True
+        # Default routing: the hierarchical local-reduce -> cross ->
+        # local-broadcast path, worthwhile when at least one host runs
+        # several ranks.
+        return max(t.local_sizes) > 1
 
     @property
     def _hier(self) -> bool:
@@ -252,6 +289,12 @@ class ShmBackend(CollectiveBackend):
                 acc += src
 
     def close(self) -> None:
+        if self._roots_ring is not None:
+            try:
+                self._roots_ring.close()
+            except Exception:
+                pass
+            self._roots_ring = None
         if self._map is not None:
             try:
                 self._map.close()
@@ -283,7 +326,8 @@ class ShmBackend(CollectiveBackend):
             return self._fallback.execute_allreduce(entries, response)
         _, stride = seg
         if self._hier:
-            result = self._hier_allreduce(fused, dtype, stride)
+            result = self._hier_allreduce(fused, dtype, stride,
+                                          response)
         elif fused.nbytes >= _PARALLEL_SUM_BYTES:
             result = self._parallel_sum_allreduce(fused, dtype, stride)
         else:
@@ -333,20 +377,118 @@ class ShmBackend(CollectiveBackend):
         self._world_barrier()  # round B: every slice summed
         return self._view(out_off, dtype, fused.size).copy()
 
+    def _roots_ring_for(self):
+        """Cross-host ring among LOCAL ROOTS — the two-level plane's
+        middle leg. Established lazily, ONCE, at a world-consistent
+        response position (every rank runs the rendezvous control
+        rounds; only roots open links). Non-roots get None even on
+        success, so one extra one-time agree() round publishes the
+        verdict to them — ``self._roots_ok`` is world-identical after
+        the first call."""
+        if not self._roots_ring_tried:
+            self._roots_ring_tried = True
+            roots = list(self._ctl.topology.local_roots)
+            from horovod_tpu.ops import ring as _ring
+            self._roots_ring = _ring.establish(
+                self._ctl, self._secret, hb=self._ring_hb,
+                members=roots)
+            member = self._ctl.rank in roots
+            self._roots_ok = self._ctl.agree(
+                (self._roots_ring is not None) if member else True)
+        return self._roots_ring
+
+    def _cross_exchange_star(self, acc, dtype, wire: int,
+                             count: int, key: tuple):
+        """Cross-host leg, star shape: roots funnel their host sums
+        through the coordinator (compressed at the negotiated wire
+        dtype), everyone else rides the rounds with empty payloads so
+        the protocol stays size-independent. Returns the f32 world sum
+        on roots, None elsewhere."""
+        from horovod_tpu.ops import socket_ops as _sops
+        ctl = self._ctl
+        t = ctl.topology
+        lr = t.local_rank
+        wire_nbytes = _wd.compressed_nbytes(
+            wire, count, dtype.itemsize) if wire else 0
+        if lr == 0:
+            # ONE shared compress-leg implementation with the socket
+            # plane (cast/quantize + error feedback + saved/ratio
+            # metrics), so the transports can never drift on numerics
+            # or accounting.
+            payload = _sops.compress_send_payload(
+                acc, wire, self._ef, key) if wire else acc
+        else:
+            payload = b""
+        gathered = ctl.gather_data(payload)  # round 2a
+        # Root membership comes from the topology, not payload lengths,
+        # so the protocol is size-independent.
+        roots = set(t.local_roots)
+        if gathered is not None:  # coordinator (always a local root)
+            peers = [gathered[r] for r in range(1, ctl.size)
+                     if r in roots]
+            if wire:
+                from horovod_tpu.common.network import as_byte_view
+                out_buf = _wd.reduce_wire(payload, peers, wire,
+                                          dtype, count)
+                blob = as_byte_view(out_buf)
+                total = _wd.decompress(out_buf, wire, dtype, count)
+            else:
+                total = payload  # acc, fresh
+                for p in peers:
+                    src = np.frombuffer(p, dtype=dtype)
+                    if not _native.sum_into(total, src):
+                        total += src
+                blob = memoryview(total).cast("B")
+            payloads = [blob if r in roots else b""
+                        for r in range(ctl.size)]
+            payloads[0] = b""  # our own copy is ``total`` already
+            ctl.scatter_data(payloads)  # round 2b
+            return total
+        if self._zero_copy:
+            # Roots receive the world sum straight into a fresh array;
+            # non-roots' empty slice costs nothing.
+            if wire == _wd.WIRE_INT8:
+                flat = np.empty(wire_nbytes if lr == 0 else 0,
+                                np.uint8)
+            elif wire:
+                flat = np.empty(count if lr == 0 else 0,
+                                _wd.wire_np_dtype(wire))
+            else:
+                flat = np.empty(count if lr == 0 else 0, dtype)
+            ctl.scatter_data_into(None, flat)  # round 2b
+            if lr != 0:
+                return None
+            return _wd.decompress(flat, wire, dtype, count) \
+                if wire else flat
+        data = ctl.scatter_data(None)  # round 2b
+        if lr != 0:
+            return None
+        if wire:
+            return _wd.decompress(data, wire, dtype, count)
+        return _np_from_bytes(data, dtype)
+
     def _hier_allreduce(self, fused: np.ndarray, dtype,
-                        stride: int) -> np.ndarray:
+                        stride: int, response: Response) -> np.ndarray:
         """Multi-host allreduce: local shm reduce -> cross-host
         exchange among LOCAL ROOTS only -> local shm broadcast. The
         exact decomposition of the reference's
         ``NCCLHierarchicalAllreduce`` (nccl_operations.cc:167-372:
         intra-node reduce, inter-node exchange on one participant per
         node, intra-node broadcast), with cross-host bytes cut from
-        N*S to K*S for K hosts.
+        N*S to K*S for K hosts — and cut AGAIN by the negotiated wire
+        dtype, applied only to the cross-host leg (intra-host legs
+        move through RAM, where a cast costs more than it saves).
 
-        Three control rounds, identical on every rank:
+        The cross leg has two shapes, selected by the coordinator's
+        ALG_* stamp: the classic star through rank 0 (default), or —
+        ``ALG_TWOLEVEL`` — a reduce-scatter/allgather ring among the
+        local roots (ops/ring.py subset ring), whose per-root wire
+        bytes are 2·S·(K-1)/K instead of the star root's 2·S·(K-1).
+
+        Control rounds, identical on every rank:
           1. barrier — all local slots written;
-          2. data gather (roots carry their host's sum, others empty)
-             + scatter (roots get the world sum back, others empty);
+          2. cross leg (star: gather+scatter rounds; ring: root-to-
+             root links only — no world rounds);
           3. barrier — out regions written; locals read.
         """
         ctl = self._ctl
@@ -359,40 +501,40 @@ class ShmBackend(CollectiveBackend):
             slot[:] = fused
         self._world_barrier()  # round 1: every host's slots complete
 
+        acc = None
         if lr == 0:
             acc = np.array(fused, dtype=dtype, copy=True)
             self._sum_slots(acc, range(1, ls), stride, dtype,
                             fused.size)
-            payload = acc
+
+        wire = response.wire_dtype \
+            if _wd.is_floating(dtype) else _wd.WIRE_NONE
+        twolevel = response.algorithm == _wd.ALG_TWOLEVEL
+        if twolevel:
+            # Every rank reaches this establishment point for the same
+            # response, so the rendezvous rounds stay world-aligned;
+            # an unestablishable ring degrades every rank to the star
+            # exchange together (world-agreed vote).
+            ring = self._roots_ring_for()
+            twolevel = self._roots_ok
+        if twolevel:
+            if self._m_twolevel is not None:
+                self._m_twolevel.inc()
+            result = None
+            if lr == 0:
+                wire = _wd.ring_wire(wire)
+                if wire:
+                    from horovod_tpu.ops import socket_ops as _sops
+                    wbuf = _sops.compress_send_payload(acc, wire)
+                    ring.allreduce_(wbuf)
+                    result = _wd.decompress(wbuf, wire, dtype,
+                                            fused.size)
+                else:
+                    result = ring.allreduce_(acc)
         else:
-            payload = b""
-        gathered = ctl.gather_data(payload)  # round 2a
-        # Root membership comes from the topology, not payload lengths,
-        # so the protocol is size-independent.
-        roots = set(t.local_roots)
-        if gathered is not None:  # coordinator (always a local root)
-            total = acc
-            for r in range(1, ctl.size):
-                if r in roots:
-                    src = np.frombuffer(gathered[r], dtype=dtype)
-                    if not _native.sum_into(total, src):
-                        total += src
-            blob = memoryview(total).cast("B")
-            payloads = [blob if r in roots else b""
-                        for r in range(ctl.size)]
-            payloads[0] = b""  # our own copy is ``total`` already
-            ctl.scatter_data(payloads)  # round 2b
-            result = total
-        elif self._zero_copy:
-            # Roots receive the world sum straight into a fresh array;
-            # non-roots' empty slice costs nothing.
-            flat = np.empty(fused.size if lr == 0 else 0, dtype)
-            ctl.scatter_data_into(None, flat)  # round 2b
-            result = flat if lr == 0 else None
-        else:
-            data = ctl.scatter_data(None)  # round 2b
-            result = (_np_from_bytes(data, dtype)
-                      if lr == 0 else None)
+            result = self._cross_exchange_star(
+                acc, dtype, wire, fused.size,
+                tuple(response.tensor_names))
 
         if lr == 0 and ls > 1:
             # solo hosts have no readers — skip the out-region copy

@@ -27,6 +27,7 @@ from typing import List
 import numpy as np
 
 from horovod_tpu import native as _native
+from horovod_tpu.common import wire_dtype as _wd
 from horovod_tpu.common.arena import FusionArena, concat_into
 from horovod_tpu.common.controller import Controller
 from horovod_tpu.common.message import (
@@ -46,11 +47,50 @@ from horovod_tpu.ops.backend import CollectiveBackend
 # metrics are off/unattached.
 _COPY_METRIC = NOOP_METRIC
 
+# Wire-compression observability, shared by name with the runtime's
+# counters: bytes this rank did NOT put on the wire thanks to the
+# negotiated wire dtype, and the per-op compression ratio.
+_SAVED_METRIC = NOOP_METRIC
+_RATIO_METRIC = NOOP_METRIC
+
 
 def _to_numpy(tensor) -> np.ndarray:
     if isinstance(tensor, np.ndarray):
         return tensor
     return np.asarray(tensor)
+
+
+def record_compression(src_nbytes: int, wire_nbytes: int) -> None:
+    """THE one wire-compression accounting site (saved bytes +
+    ratio): every compress leg — the backends via
+    compress_send_payload, the runtime's spec/native steady packs —
+    ticks through here, so the metric semantics can never drift
+    between planes."""
+    _SAVED_METRIC.inc(max(0, src_nbytes - wire_nbytes))
+    _RATIO_METRIC.observe(wire_nbytes / max(1, src_nbytes))
+
+
+def compress_send_payload(arr: np.ndarray, wire: int, ef=None,
+                          key: tuple = None,
+                          out: np.ndarray = None) -> np.ndarray:
+    """THE one compress-leg implementation every host plane shares:
+    wire-cast (into ``out`` — an arena view — when given) or int8
+    quantize with error feedback, plus the saved-bytes/ratio metrics.
+    One call per payload per op, so the counters stay exact however
+    many planes reuse it."""
+    record_compression(
+        arr.nbytes,
+        _wd.compressed_nbytes(wire, arr.size, arr.dtype.itemsize))
+    if wire == _wd.WIRE_INT8:
+        comp = ef.apply(key, arr) if ef is not None else arr
+        qbuf = _wd.quantize(comp)
+        if ef is not None:
+            ef.update(key, comp, qbuf)
+        return qbuf
+    if out is not None:
+        _wd.cast_into(arr, out)
+        return out
+    return arr.astype(_wd.wire_np_dtype(wire))  # fresh + writable
 
 
 def _np_from_bytes(data: bytes, dtype) -> np.ndarray:
@@ -212,6 +252,12 @@ class SocketBackend(CollectiveBackend):
         self._ring_hb = ((cfg.heartbeat_timeout_s,
                           cfg.heartbeat_interval_s)
                          if cfg.heartbeat_timeout_s > 0 else None)
+        # Wire-compression state: a dedicated arena for compressed
+        # send payloads (the f32 pack arena keeps its layout) and the
+        # int8 error-feedback residual store (rank-local by design —
+        # each rank compensates its OWN quantization error).
+        self._wire_arena = FusionArena()
+        self._ef = _wd.ErrorFeedback()
 
     def enabled(self, entries, response) -> bool:
         return self._ctl.size > 1
@@ -231,11 +277,20 @@ class SocketBackend(CollectiveBackend):
         # Same counter object as the runtime's (registry memoizes by
         # name): the module-level hook lets _np_from_bytes count from
         # shared helpers without threading a backend through.
-        global _COPY_METRIC
+        global _COPY_METRIC, _SAVED_METRIC, _RATIO_METRIC
         _COPY_METRIC = registry.counter(
             "hvd_data_copies_total",
             "payload byte-object copies on fallback data paths "
             "(0 while the zero-copy plane is engaged)")
+        from horovod_tpu.common.metrics import RATIO_BUCKETS
+        _SAVED_METRIC = registry.counter(
+            "hvd_wire_bytes_saved_total",
+            "payload bytes kept OFF the wire by the negotiated "
+            "wire dtype (uncompressed minus wire size, per send)")
+        _RATIO_METRIC = registry.histogram(
+            "hvd_compression_ratio",
+            "wire bytes / uncompressed bytes per compressed payload",
+            RATIO_BUCKETS)
 
     def fused_cycle_reducible(self, nbytes: int) -> bool:
         """Star-bound batches (below the ring threshold) already move
@@ -251,12 +306,25 @@ class SocketBackend(CollectiveBackend):
             self._ring.close()
             self._ring = None
 
-    def _ring_for(self, nbytes: int):
+    def _ring_for(self, nbytes: int, algorithm: int = 0):
         """Ring data plane for large payloads: establish lazily, once,
         at a world-consistent response position (all ranks evaluate the
-        same negotiated size against the same threshold). None => star."""
-        if self._ring_threshold < 0 or nbytes < self._ring_threshold \
-                or self._ctl.size < 3:
+        same negotiated size against the same threshold — and the same
+        coordinator-stamped ALG_* verdict). None => star. A stamped
+        ALG_STAR/ALG_RING overrides the size heuristic; an
+        unestablishable forced ring degrades to the star on every rank
+        together (the establishment vote is world-agreed)."""
+        if algorithm == _wd.ALG_STAR:
+            return None
+        # HOROVOD_TPU_RING_THRESHOLD=-1 is an explicit operator
+        # opt-out (firewalled inter-rank dials, broken fabric): a
+        # stamped ALG_RING must not override it with a surprise
+        # rendezvous — the world degrades to the star together.
+        forced = (algorithm == _wd.ALG_RING and self._ctl.size >= 2
+                  and self._ring_threshold >= 0)
+        if not forced and (
+                self._ring_threshold < 0 or nbytes < self._ring_threshold
+                or self._ctl.size < 3):
             return None
         if not self._ring_tried:
             self._ring_tried = True
@@ -286,10 +354,21 @@ class SocketBackend(CollectiveBackend):
                 arrays, response, self._arena if use_arena else None)
 
         # Large payloads ride the ring (every rank computes the same
-        # negotiated size, so the path choice is world-consistent).
-        ring = self._ring_for(fused.nbytes)
+        # negotiated size against the same threshold AND the same
+        # coordinator-stamped algorithm, so the path choice is
+        # world-consistent). Routing uses UNCOMPRESSED bytes on
+        # purpose — the wire dtype must not flip the route.
+        ring = self._ring_for(fused.nbytes, response.algorithm)
         (self._m_ring_ops if ring is not None
          else self._m_star_ops).inc()
+        wire = response.wire_dtype
+        if wire != _wd.WIRE_NONE:
+            result = self._compressed_allreduce(fused, wire, ring,
+                                                names)
+            with self.activity(names, ACT_MEMCPY_OUT_FUSION_BUFFER,
+                               multi):
+                _unpack_fused(entries, arrays, result, response)
+            return Status.OK()
         if ring is not None:
             # allreduce is not in-place at the API: never mutate a buffer
             # that may alias the caller's tensor.
@@ -333,6 +412,90 @@ class SocketBackend(CollectiveBackend):
         with self.activity(names, ACT_MEMCPY_OUT_FUSION_BUFFER, multi):
             _unpack_fused(entries, arrays, result, response)
         return Status.OK()
+
+    def _compressed_allreduce(self, fused: np.ndarray, wire: int,
+                              ring, names) -> np.ndarray:
+        """Allreduce with the negotiated wire dtype applied to every
+        wire leg: compress AFTER the (prescaled) fusion pack, move and
+        reduce in the wire representation, decompress ONCE into a
+        fresh full-precision result the unpack may alias. The verdict
+        and the route are both world-identical (broadcast response +
+        negotiated sizes), so every rank takes the same branch."""
+        ctl = self._ctl
+        src_dtype = fused.dtype
+        count = fused.size
+        if ring is not None:
+            wire = _wd.ring_wire(wire)
+        wire_nbytes = _wd.compressed_nbytes(wire, count,
+                                            src_dtype.itemsize)
+
+        if wire == _wd.WIRE_INT8:
+            # Error feedback (Deep Gradient Compression): add last
+            # step's quantization residual before quantizing, keep
+            # this step's error for the next one. Rank-local state by
+            # design — each rank compensates its own error.
+            qbuf = compress_send_payload(fused, wire, self._ef,
+                                         tuple(names))
+            if ctl.is_coordinator:
+                if self._zero_copy:
+                    outs = [None] * ctl.size
+                    for r in range(1, ctl.size):
+                        outs[r] = self._gather_arena.typed(
+                            (r - 1) * wire_nbytes, np.uint8,
+                            wire_nbytes)
+                    ctl.gather_data_into(qbuf, outs)
+                    peers = outs[1:]
+                else:
+                    peers = ctl.gather_data(qbuf)[1:]
+                out_buf = _wd.reduce_wire(qbuf, peers, wire,
+                                          src_dtype, count)
+                ctl.broadcast_data(out_buf)
+                return _wd.dequantize(out_buf, src_dtype, count)
+            if self._zero_copy:
+                ctl.gather_data_into(qbuf, None)
+                rbuf = np.empty(wire_nbytes, np.uint8)
+                ctl.broadcast_data_into(None, rbuf)
+            else:
+                ctl.gather_data(qbuf)
+                rbuf = ctl.broadcast_data(None)
+            return _wd.dequantize(rbuf, src_dtype, count)
+
+        # Cast wires (bf16/fp16): reduction happens IN the wire dtype
+        # (native hvd_sum_into converts pairwise through f32), exactly
+        # like the native steady coordinator — the Python and C legs
+        # are numerically interchangeable. The wire arena is safe for
+        # the ring leg too: the ring mutates the WIRE buffer in place,
+        # but outputs alias only the fresh decompressed result.
+        np_wire = _wd.wire_np_dtype(wire)
+        warr = compress_send_payload(
+            fused, wire,
+            out=self._wire_arena.typed(0, np_wire, count)
+            if self._zero_copy else None)
+        if ring is not None:
+            result_wire = ring.allreduce_(warr)
+            return _wd.decompress(result_wire, wire, src_dtype, count)
+        if ctl.is_coordinator:
+            acc = np.array(warr, copy=True)
+            if self._zero_copy:
+                outs = [None] * ctl.size
+                for r in range(1, ctl.size):
+                    outs[r] = self._gather_arena.typed(
+                        (r - 1) * wire_nbytes, np_wire, count)
+                ctl.gather_data_into(warr, outs)
+                peers = outs[1:]
+            else:
+                peers = ctl.gather_data(warr)[1:]
+            _wd.reduce_wire(acc, peers, wire, src_dtype, count)
+            ctl.broadcast_data(acc)
+            return _wd.decompress(acc, wire, src_dtype, count)
+        if self._zero_copy:
+            ctl.gather_data_into(warr, None)
+            rarr = np.empty(count, np_wire)
+            ctl.broadcast_data_into(None, rarr)
+        else:
+            ctl.gather_data(warr)
+            rarr = ctl.broadcast_data(None)
+        return _wd.decompress(rarr, wire, src_dtype, count)
 
     # -- allgather (multi-entry: fused responses) ------------------------
     def execute_allgather(self, entries, response: Response) -> Status:

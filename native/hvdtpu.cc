@@ -497,6 +497,78 @@ int recv_expected(int fd, uint8_t want_tag,
 // dtype code -> element size (codes as for hvd_sum_into).
 const int kDtypeSize[] = {4, 8, 4, 8, 1, 2, 2};
 
+// Scalar fp16/bf16 <-> f32 conversions shared by the reduction kernel
+// (hvd_sum_into) and the wire-compression cast (hvd_cast). fp16 via
+// f32 round-trip (reference: common/half.cc:42-77, scalar path — no
+// F16C dependence); bf16 is the upper 16 bits of an f32 with
+// round-to-nearest-even on the way down.
+inline float half_to_float(uint16_t v) {
+  uint32_t sign = uint32_t(v & 0x8000u) << 16;
+  uint32_t exp = (v >> 10) & 0x1f;
+  uint32_t man = v & 0x3ffu;
+  uint32_t f;
+  if (exp == 0) {
+    if (man == 0) {
+      f = sign;
+    } else {
+      exp = 127 - 15 + 1;
+      while (!(man & 0x400u)) { man <<= 1; exp--; }
+      man &= 0x3ffu;
+      f = sign | (exp << 23) | (man << 13);
+    }
+  } else if (exp == 31) {
+    f = sign | 0x7f800000u | (man << 13);
+  } else {
+    f = sign | ((exp - 15 + 127) << 23) | (man << 13);
+  }
+  float out;
+  memcpy(&out, &f, 4);
+  return out;
+}
+
+inline uint16_t float_to_half(float x) {
+  uint32_t f;
+  memcpy(&f, &x, 4);
+  uint32_t sign = (f >> 16) & 0x8000u;
+  int32_t exp = int32_t((f >> 23) & 0xff) - 127 + 15;
+  uint32_t man = f & 0x7fffffu;
+  if (((f >> 23) & 0xff) == 0xff && man != 0)
+    return uint16_t(sign | 0x7e00u);  // NaN stays NaN, not Inf
+  if (exp <= 0) {
+    if (exp < -10) return uint16_t(sign);
+    man |= 0x800000u;
+    uint32_t shift = uint32_t(14 - exp);
+    uint32_t half_man = man >> shift;
+    // round to nearest even
+    uint32_t rem = man & ((1u << shift) - 1);
+    uint32_t halfway = 1u << (shift - 1);
+    if (rem > halfway || (rem == halfway && (half_man & 1)))
+      half_man++;
+    return uint16_t(sign | half_man);
+  }
+  if (exp >= 31) return uint16_t(sign | 0x7c00u);
+  uint32_t half = sign | (uint32_t(exp) << 10) | (man >> 13);
+  uint32_t rem = man & 0x1fffu;
+  if (rem > 0x1000u || (rem == 0x1000u && (half & 1))) half++;
+  return uint16_t(half);
+}
+
+inline float bf16_to_float(uint16_t v) {
+  uint32_t f = uint32_t(v) << 16;
+  float out;
+  memcpy(&out, &f, 4);
+  return out;
+}
+
+inline uint16_t float_to_bf16(float x) {
+  uint32_t f;
+  memcpy(&f, &x, 4);
+  if ((f & 0x7fffffffu) > 0x7f800000u)
+    return uint16_t((f >> 16) | 0x0040u);  // quiet NaN
+  uint32_t rounding = 0x7fffu + ((f >> 16) & 1u);
+  return uint16_t((f + rounding) >> 16);
+}
+
 }  // namespace
 
 // ---------------------------------------------------------------------
@@ -627,91 +699,60 @@ int hvd_sum_into(void* acc, const void* src, int64_t count, int dtype) {
       return 0;
     }
     case 5: {
-      // fp16 via f32 round-trip (reference: common/half.cc:42-77
-      // scalar path; no F16C dependence).
+      // fp16 accumulated via the shared f32 round-trip helpers.
       uint16_t* a = static_cast<uint16_t*>(acc);
       const uint16_t* s = static_cast<const uint16_t*>(src);
-      auto h2f = [](uint16_t v) -> float {
-        uint32_t sign = uint32_t(v & 0x8000u) << 16;
-        uint32_t exp = (v >> 10) & 0x1f;
-        uint32_t man = v & 0x3ffu;
-        uint32_t f;
-        if (exp == 0) {
-          if (man == 0) {
-            f = sign;
-          } else {
-            exp = 127 - 15 + 1;
-            while (!(man & 0x400u)) { man <<= 1; exp--; }
-            man &= 0x3ffu;
-            f = sign | (exp << 23) | (man << 13);
-          }
-        } else if (exp == 31) {
-          f = sign | 0x7f800000u | (man << 13);
-        } else {
-          f = sign | ((exp - 15 + 127) << 23) | (man << 13);
-        }
-        float out;
-        memcpy(&out, &f, 4);
-        return out;
-      };
-      auto f2h = [](float x) -> uint16_t {
-        uint32_t f;
-        memcpy(&f, &x, 4);
-        uint32_t sign = (f >> 16) & 0x8000u;
-        int32_t exp = int32_t((f >> 23) & 0xff) - 127 + 15;
-        uint32_t man = f & 0x7fffffu;
-        if (((f >> 23) & 0xff) == 0xff && man != 0)
-          return uint16_t(sign | 0x7e00u);  // NaN stays NaN, not Inf
-        if (exp <= 0) {
-          if (exp < -10) return uint16_t(sign);
-          man |= 0x800000u;
-          uint32_t shift = uint32_t(14 - exp);
-          uint32_t half_man = man >> shift;
-          // round to nearest even
-          uint32_t rem = man & ((1u << shift) - 1);
-          uint32_t halfway = 1u << (shift - 1);
-          if (rem > halfway || (rem == halfway && (half_man & 1)))
-            half_man++;
-          return uint16_t(sign | half_man);
-        }
-        if (exp >= 31) return uint16_t(sign | 0x7c00u);
-        uint32_t half = sign | (uint32_t(exp) << 10) | (man >> 13);
-        uint32_t rem = man & 0x1fffu;
-        if (rem > 0x1000u || (rem == 0x1000u && (half & 1))) half++;
-        return uint16_t(half);
-      };
       for (int64_t i = 0; i < count; i++)
-        a[i] = f2h(h2f(a[i]) + h2f(s[i]));
+        a[i] = float_to_half(half_to_float(a[i]) + half_to_float(s[i]));
       return 0;
     }
     case 6: {
-      // bfloat16 — the TPU-native wire/accumulate dtype: upper 16 bits
-      // of an f32. Accumulate in f32, round to nearest-even on the way
-      // back (role-parity with the fp16 sum above; reference analog:
-      // common/half.cc:42-77).
+      // bfloat16 — the TPU-native wire/accumulate dtype: accumulate
+      // in f32, round to nearest-even on the way back (role-parity
+      // with the fp16 sum above; reference analog: common/half.cc).
       uint16_t* a = static_cast<uint16_t*>(acc);
       const uint16_t* s = static_cast<const uint16_t*>(src);
-      auto b2f = [](uint16_t v) -> float {
-        uint32_t f = uint32_t(v) << 16;
-        float out;
-        memcpy(&out, &f, 4);
-        return out;
-      };
-      auto f2b = [](float x) -> uint16_t {
-        uint32_t f;
-        memcpy(&f, &x, 4);
-        if ((f & 0x7fffffffu) > 0x7f800000u)
-          return uint16_t((f >> 16) | 0x0040u);  // quiet NaN
-        uint32_t rounding = 0x7fffu + ((f >> 16) & 1u);
-        return uint16_t((f + rounding) >> 16);
-      };
       for (int64_t i = 0; i < count; i++)
-        a[i] = f2b(b2f(a[i]) + b2f(s[i]));
+        a[i] = float_to_bf16(bf16_to_float(a[i]) + bf16_to_float(s[i]));
       return 0;
     }
     default:
       return -EINVAL;
   }
+}
+
+int hvd_cast(const void* src, void* dst, int64_t count, int src_dtype,
+             int dst_dtype) {
+  // The wire-compression cast leg: f32 <-> bf16/f16, the pairs the
+  // negotiated wire dtypes need on the zero-copy steady path (pack
+  // compresses straight into the fusion arena; decompress lands in a
+  // fresh output buffer). Unsupported pairs return -EINVAL and the
+  // caller falls back to numpy's casting machinery.
+  if (src_dtype == 0 && dst_dtype == 6) {
+    const float* s = static_cast<const float*>(src);
+    uint16_t* d = static_cast<uint16_t*>(dst);
+    for (int64_t i = 0; i < count; i++) d[i] = float_to_bf16(s[i]);
+    return 0;
+  }
+  if (src_dtype == 6 && dst_dtype == 0) {
+    const uint16_t* s = static_cast<const uint16_t*>(src);
+    float* d = static_cast<float*>(dst);
+    for (int64_t i = 0; i < count; i++) d[i] = bf16_to_float(s[i]);
+    return 0;
+  }
+  if (src_dtype == 0 && dst_dtype == 5) {
+    const float* s = static_cast<const float*>(src);
+    uint16_t* d = static_cast<uint16_t*>(dst);
+    for (int64_t i = 0; i < count; i++) d[i] = float_to_half(s[i]);
+    return 0;
+  }
+  if (src_dtype == 5 && dst_dtype == 0) {
+    const uint16_t* s = static_cast<const uint16_t*>(src);
+    float* d = static_cast<float*>(dst);
+    for (int64_t i = 0; i < count; i++) d[i] = half_to_float(s[i]);
+    return 0;
+  }
+  return -EINVAL;
 }
 
 void hvd_hmac_sha256(const uint8_t* key, int key_len, uint8_t tag,

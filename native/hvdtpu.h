@@ -139,6 +139,14 @@ void hvd_unpack(const void* src, const int64_t* nbytes, int n,
 // (f16 summed via f32 conversion; reference: common/half.cc:42-77).
 int hvd_sum_into(void* acc, const void* src, int64_t count, int dtype);
 
+// Elementwise dtype cast (the wire-compression leg: gradients are
+// compressed into the fusion arena on send and decompressed into
+// fresh outputs on receive). Supported pairs: f32<->bf16 (0<->6) and
+// f32<->f16 (0<->5); anything else returns -EINVAL and the caller
+// uses the numpy fallback. src and dst must not overlap.
+int hvd_cast(const void* src, void* dst, int64_t count, int src_dtype,
+             int dst_dtype);
+
 // ---- self-test helpers ----------------------------------------------
 // HMAC-SHA256 of (tag|payload) into out[32] — lets Python verify the
 // embedded SHA implementation against hashlib.

@@ -1321,6 +1321,177 @@ def scenario_bf16_host_path(hvd, rank, size):
     np.testing.assert_allclose(np.asarray(b, np.float32), 1.0)
 
 
+def _metric_value(hvd, name: str) -> float:
+    rec = hvd.metrics()["local"].get(name)
+    if rec is None:
+        return 0.0
+    return rec["v"] if "v" in rec else rec.get("count", 0)
+
+
+def scenario_compression_steady_zero_copy(hvd, rank, size):
+    """Compressed steady state end to end (HOROVOD_COMPRESSION=bf16 +
+    metrics armed + shm/ring off by the pytest wrapper): a steady
+    grouped-allreduce loop of bf16-exact values must (a) stay exact,
+    (b) keep riding the fused speculative round (and the native
+    zero-copy cycle when the library is loaded) with the COMPRESSED
+    payload — hvd_data_copies_total delta stays 0, proving the
+    ISSUE 9 contract that compression composes with the PR 6 plane —
+    and (c) report wire bytes actually saved."""
+    from horovod_tpu.common import basics as _b
+    from horovod_tpu import native as _nat
+
+    ssum = sum(range(1, size + 1))
+    # Small integers: exactly representable in bf16, so the compressed
+    # world is assertable bit-for-bit.
+    xs = [np.full(256 + i, float(rank + 1) * (i + 1), np.float32)
+          for i in range(6)]
+
+    def step():
+        hs = hvd.grouped_allreduce_async(xs, average=False, name="cz")
+        for i, h in enumerate(hs):
+            np.testing.assert_allclose(np.asarray(hvd.synchronize(h)),
+                                       ssum * (i + 1.0))
+
+    for _ in range(5):
+        step()
+    hvd.barrier(name="cz.bar")
+    rt = _b.runtime()
+    s0 = rt.negotiation_cache_stats()
+    copies0 = _metric_value(hvd, "hvd_data_copies_total")
+    saved0 = _metric_value(hvd, "hvd_wire_bytes_saved_total")
+    for _ in range(25):
+        step()
+    s1 = rt.negotiation_cache_stats()
+    copies1 = _metric_value(hvd, "hvd_data_copies_total")
+    saved1 = _metric_value(hvd, "hvd_wire_bytes_saved_total")
+    assert s1["spec_cycles"] > s0["spec_cycles"], (rank, s0, s1)
+    if _nat.get() is not None:
+        assert s1["native_steady_cycles"] > s0["native_steady_cycles"], \
+            (rank, s0, s1)
+    assert copies1 - copies0 == 0, (rank, copies0, copies1)
+    assert saved1 > saved0, (rank, saved0, saved1)
+    # bf16 halves the payload: per fused step the saving is half the
+    # uncompressed fused bytes
+    per_step = sum(x.nbytes for x in xs) // 2
+    assert saved1 - saved0 >= 20 * per_step, (rank, saved0, saved1)
+
+
+def scenario_compression_hetero(hvd, rank, size):
+    """Heterogeneous compression knobs (the pytest wrapper proposes
+    bf16 on ONE rank only, or on all — same scenario both ways): the
+    coordinator resolves every batch to the common denominator, and a
+    world whose verdict is `none` must be BIT-EXACT with a fresh
+    all-none world replaying the same submissions — the wrapper runs
+    both worlds and compares the files byte-for-byte."""
+    rng = np.random.RandomState(1000 + rank)
+    outs = []
+    for step in range(8):
+        x = rng.randn(777).astype(np.float32)
+        outs.append(np.asarray(
+            hvd.allreduce(x, average=False, name=f"hx.{step}")))
+    g = hvd.allgather(np.asarray([[float(rank)]], np.float32),
+                      name="hx.ag")
+    outs.append(np.asarray(g))
+    out_path = os.environ.get("HVD_COMPRESSION_OUT")
+    if rank == 0 and out_path:
+        np.save(out_path, np.concatenate([o.reshape(-1) for o in outs]))
+    # a bf16-proposing rank in a mixed world must see an uncompressed
+    # verdict: zero wire bytes saved anywhere
+    if os.environ.get("HOROVOD_TPU_METRICS") == "1":
+        assert _metric_value(hvd, "hvd_wire_bytes_saved_total") == 0, \
+            rank
+
+
+def scenario_twolevel_allreduce(hvd, rank, size):
+    """Two-level hierarchical allreduce on a (fake) multi-host world
+    (HOROVOD_TWO_LEVEL=1 + HOROVOD_COMPRESSION=bf16 + metrics armed by
+    the wrapper): intra-host shm reduce, cross-host ring among local
+    roots, intra-host shm broadcast. Values are bf16-exact so the
+    compressed cross leg is assertable exactly; the per-algorithm op
+    counter proves the plane actually carried the batches."""
+    ssum = sum(range(1, size + 1))
+    for step in range(6):
+        x = np.full(2048, float(rank + 1), np.float32)
+        out = hvd.allreduce(x, average=False, name=f"tl.{step}")
+        np.testing.assert_allclose(np.asarray(out), ssum)
+    # a bandwidth-bound op through the same plane
+    big = np.full(1 << 18, float(rank + 1), np.float32)
+    out = hvd.allreduce(big, average=False, name="tl.big")
+    np.testing.assert_allclose(np.asarray(out), ssum)
+    # non-allreduce collectives keep their own planes alongside
+    g = hvd.allgather(np.full((2, 2), float(rank), np.float32),
+                      name="tl.ag")
+    assert np.asarray(g).shape == (2 * size, 2)
+    assert _metric_value(hvd, "hvd_ops_twolevel_total") >= 7, rank
+    # Only LOCAL ROOTS put bytes on the cross-host leg — they alone
+    # save wire bytes; a leaf's counter staying 0 is the proof that
+    # intra-host legs (RAM) are deliberately not compressed.
+    saved = _metric_value(hvd, "hvd_wire_bytes_saved_total")
+    if hvd.local_rank() == 0:
+        assert saved > 0, rank
+    else:
+        assert saved == 0, (rank, saved)
+
+
+def scenario_compression_train_parity(hvd, rank, size):
+    """Convergence-parity leg (ISSUE 9): train the toy TransformerLM
+    from models/ data-parallel for a fixed schedule, gradients
+    allreduced at this world's HOROVOD_COMPRESSION; rank 0 writes the
+    loss trajectory for the pytest wrapper to compare across wire
+    dtypes (none vs bf16 vs int8+error-feedback)."""
+    import jax
+    import jax.numpy as jnp
+    from horovod_tpu.models.transformer import (
+        TransformerConfig, TransformerLM, lm_loss,
+    )
+
+    cfg = TransformerConfig(vocab_size=64, num_layers=2, num_heads=2,
+                            head_dim=8, max_seq_len=16,
+                            dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    data_rng = np.random.RandomState(4242 + rank)  # per-rank shards
+    # FIXED batch per rank (memorization task): loss must fall
+    # monotonically-ish within the short schedule, giving the parity
+    # comparison a real training signal instead of noise-floor drift.
+    tokens = jnp.asarray(data_rng.randint(0, 64, (4, 16)), jnp.int32)
+    params = model.init(jax.random.key(0), tokens)  # identical ranks
+
+    @jax.jit
+    def loss_grads(p, t):
+        def f(p):
+            return lm_loss(model.apply(p, t), t)
+        return jax.value_and_grad(f)(p)
+
+    lr = 0.1
+    losses = []
+    for step in range(10):
+        t = tokens
+        loss, g = loss_grads(params, t)
+        flat = [np.asarray(x, np.float32)
+                for x in jax.tree_util.tree_leaves(g)]
+        # SAME group name every step: the steady-state fast path (and
+        # with it the compressed spec cycle) engages mid-run
+        outs = hvd.grouped_allreduce(flat, average=True, name="gp")
+        new_flat = [p - lr * jnp.asarray(gavg)
+                    for p, gavg in zip(jax.tree_util.tree_leaves(params),
+                                       outs)]
+        params = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(params), new_flat)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)), (rank, losses)
+    # world-averaged final loss so every rank contributes to the
+    # parity number the wrapper compares
+    final = np.asarray(hvd.allreduce(
+        np.asarray([losses[-1]], np.float64), average=True,
+        name="gp.final"))
+    out_path = os.environ.get("HVD_COMPRESSION_OUT")
+    if rank == 0 and out_path:
+        import json
+        with open(out_path, "w") as f:
+            json.dump({"final_loss": float(final[0]),
+                       "losses": losses}, f)
+
+
 def scenario_rank_death(hvd, rank, size):
     """A rank dying abruptly mid-job must surface on the survivors as
     a clean shutdown error on the next collective — never a hang

@@ -299,6 +299,41 @@ def test_wire_protocol_scopes_to_wire_modules(tmp_path):
                          name="codec.py") == []
 
 
+BAD_WIRE_CODES = """
+    WIRE_NONE = 0
+    WIRE_BF16 = 1
+    WIRE_FP16 = 1
+    ALG_DEFAULT = 0
+    ALG_RING = 300
+"""
+
+GOOD_WIRE_CODES = """
+    WIRE_NONE = 0
+    WIRE_BF16 = 1
+    WIRE_NAMES = 1  # name tables are exempt, not codes
+    ALG_DEFAULT = 0
+    ALG_STAR = 1
+"""
+
+
+def test_wire_protocol_code_family_collision_fires(tmp_path):
+    """The negotiated-attribute families (WIRE_*/ALG_* — the wire
+    dtype and algorithm bytes Requests/Responses carry) must stay
+    pairwise distinct per family and u8-ranged."""
+    fs = _lint_snippet(tmp_path, BAD_WIRE_CODES, "wire-protocol",
+                       name="wire_dtype.py")
+    msgs = "\n".join(f.message for f in fs)
+    assert "WIRE_BF16 and WIRE_FP16 share byte value" in msgs
+    assert "ALG_RING = 300 does not fit the u8" in msgs
+
+
+def test_wire_protocol_code_family_clean(tmp_path):
+    # same family value reused across DIFFERENT families is fine
+    # (WIRE_BF16 == ALG_STAR == 1): the families ride distinct bytes
+    assert _lint_snippet(tmp_path, GOOD_WIRE_CODES, "wire-protocol",
+                         name="wire_dtype.py") == []
+
+
 # -- native-codec -----------------------------------------------------------
 
 _NATIVE_HEADER = """

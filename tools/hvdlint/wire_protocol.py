@@ -28,6 +28,14 @@ classes, each one this repo has actually shipped and review-fixed:
 4. **Kind coverage.** Each ``FRAME_*`` constant must appear in at
    least one ``serialize_*`` and one ``parse_*`` function — a kind
    only one direction knows is an orphan discriminator.
+
+5. **Code-family distinctness.** Single-byte negotiated-attribute
+   code families (``WIRE_*`` wire dtypes, ``ALG_*`` algorithm stamps —
+   common/wire_dtype.py) must be pairwise distinct within their
+   family and fit a u8: these ride Request/Response frames as raw
+   bytes, and two names sharing a value silently alias two different
+   negotiated verdicts (the compression analog of a FRAME_*
+   collision).
 """
 
 from __future__ import annotations
@@ -198,6 +206,36 @@ def _check_module(src: SourceFile) -> List[Finding]:
                         f"int.from_bytes over a raw slice in {fn.name} "
                         f"without a length guard — a short buffer "
                         f"silently decodes a WRONG value"))
+
+    # 5 — negotiated-attribute code families: WIRE_* / ALG_* bytes
+    # distinct within each family and u8-ranged
+    for family in ("WIRE_", "ALG_"):
+        values: Dict[int, str] = {}
+        for node in src.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            cname = node.targets[0].id
+            if not cname.startswith(family) or cname.endswith("NAMES"):
+                continue
+            if not (isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, int)):
+                continue
+            v = node.value.value
+            if not 0 <= v <= 255:
+                findings.append(Finding(
+                    NAME, src.path, node.lineno,
+                    f"negotiated-attribute code {cname} = {v} does "
+                    f"not fit the u8 the wire carries"))
+            elif v in values:
+                findings.append(Finding(
+                    NAME, src.path, node.lineno,
+                    f"negotiated-attribute codes {values[v]} and "
+                    f"{cname} share byte value {v:#04x} — two "
+                    f"verdict names would alias on the wire"))
+            else:
+                values[v] = cname
 
     # 4 — kind coverage: every FRAME_* referenced by both directions
     refs: Dict[str, set] = {name: set() for name in frames}
