@@ -566,6 +566,207 @@ def _zero_copy_bench_section(np_: int) -> dict:
                 iso_ratios[len(iso_ratios) // 2], 2)}
 
 
+OVERLAP_BENCH_TENSORS = 16
+OVERLAP_BENCH_BUCKETS = 4
+OVERLAP_BENCH_STEPS = 50
+# 256 KiB/tensor -> 4 MiB/step: payload work (HMAC + memcpy) must
+# dominate the fixed per-round protocol cost, or bucketing's extra
+# rounds eat the overlap on a 1-core host (measured crossover ~64 KiB).
+OVERLAP_BENCH_ELEMS = 65536
+
+
+def worker_overlap(rank: int, size: int) -> None:
+    """Overlap-tier section: a steady training-shaped loop whose
+    backward pass is modeled by injected compute (sleep — it releases
+    the GIL exactly like device compute does) producing gradient
+    BUCKETS progressively. Two program shapes, selected by
+    OVERLAP_BENCH_MODE:
+
+    * ``bucketed`` — the overlap tier's contract: each bucket is
+      submitted the moment its compute slice ends (ready-order
+      dispatch), so its cycle negotiates + reduces on the in-flight
+      runner while later slices still compute. Step time tends to
+      compute + one bucket's wire time.
+    * ``flat`` — today's synchronous pattern: the single grouped
+      submission needs the WHOLE gradient set, so it happens after
+      all compute and the full wire time lands on the critical path.
+
+    Identical tensors, bytes and injected compute either way
+    (OVERLAP_BENCH_COMPUTE_US total per step, calibrated by the
+    orchestrator to the measured wire time — the regime the tier
+    targets). Reports median step time plus the engagement counters
+    (overlap cycles, mean hvd_overlap_fraction, data copies, wire
+    bytes saved)."""
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics as _b
+
+    hvd.init()
+    mode = os.environ.get("OVERLAP_BENCH_MODE", "bucketed")
+    compute_us = int(os.environ.get("OVERLAP_BENCH_COMPUTE_US", "0"))
+    k = OVERLAP_BENCH_BUCKETS
+    per = OVERLAP_BENCH_TENSORS // k
+    xs = [np.full(OVERLAP_BENCH_ELEMS, float(rank + 1) * (i + 1),
+                  np.float32)
+          for i in range(OVERLAP_BENCH_TENSORS)]
+    buckets = [xs[i * per:(i + 1) * per] for i in range(k)]
+    slice_s = compute_us / 1e6 / k
+    ssum = sum(range(1, size + 1))
+
+    def step():
+        handles = []
+        if mode == "bucketed":
+            for i, bucket in enumerate(buckets):
+                if slice_s:
+                    time.sleep(slice_s)  # bucket i's backward slice
+                handles.extend(hvd.grouped_allreduce_async(
+                    bucket, average=False, name=f"ov{i}"))
+        else:
+            for _ in range(k):
+                if slice_s:
+                    time.sleep(slice_s)  # same producer timeline
+            handles.extend(hvd.grouped_allreduce_async(
+                xs, average=False, name="ovf"))
+        for h in handles:
+            hvd.synchronize(h)
+
+    for _ in range(8):
+        step()
+    hvd.barrier(name="ovb.bar")
+    rt = _b.runtime()
+    s0 = rt.negotiation_cache_stats()
+    m0 = hvd.metrics()["local"]
+    times = []
+    for _ in range(OVERLAP_BENCH_STEPS):
+        t0 = time.perf_counter()
+        step()
+        times.append(time.perf_counter() - t0)
+    s1 = rt.negotiation_cache_stats()
+    m1 = hvd.metrics()["local"]
+    # correctness spot check of the steady-state values
+    out = hvd.grouped_allreduce(xs, average=False, name="ovchk")
+    for i in range(OVERLAP_BENCH_TENSORS):
+        assert abs(float(np.asarray(out[i])[0])
+                   - ssum * (i + 1)) < 1e-3
+
+    def _delta(name):
+        return (m1.get(name, {"v": 0.0})["v"]
+                - m0.get(name, {"v": 0.0})["v"])
+
+    frac = m1.get("hvd_overlap_fraction")
+    f0 = m0.get("hvd_overlap_fraction")
+    mean_frac = None
+    if frac and frac.get("count", 0) > (f0 or {}).get("count", 0):
+        dc = frac["count"] - (f0 or {"count": 0, "sum": 0.0})["count"]
+        ds = frac["sum"] - (f0 or {"count": 0, "sum": 0.0})["sum"]
+        mean_frac = round(ds / max(1, dc), 3)
+    _, med, _ = _quantiles(times)
+    report = {
+        "mode": mode,
+        "tensors_per_step": OVERLAP_BENCH_TENSORS,
+        "buckets": k if mode == "bucketed" else 1,
+        "bytes_per_tensor": OVERLAP_BENCH_ELEMS * 4,
+        "compute_us_per_step": compute_us,
+        "steps": OVERLAP_BENCH_STEPS,
+        "us_per_step": round(med * 1e6, 1),
+        "overlap_cycles": (s1.get("overlap_cycles", 0)
+                           - s0.get("overlap_cycles", 0)),
+        "native_steady_cycles": (s1.get("native_steady_cycles", 0)
+                                 - s0.get("native_steady_cycles", 0)),
+        "spec_cycles": s1["spec_cycles"] - s0["spec_cycles"],
+        "overlap_fraction_mean": mean_frac,
+        "data_copies": int(_delta("hvd_data_copies_total")),
+        "wire_bytes_saved": int(_delta("hvd_wire_bytes_saved_total")),
+    }
+    if rank == 0:
+        print("RESULT " + json.dumps(report), flush=True)
+    hvd.shutdown()
+
+
+def _overlap_bench_section(np_: int) -> dict:
+    """`--overlap`: A/B the overlap tier against the synchronous
+    steady path with injected per-step compute CALIBRATED to the
+    measured wire time (the acceptance regime: compute comparable to
+    comm). Protocols as for --steady-only: isolated alternating legs
+    (the honest number on a host that cannot truly run two worlds
+    side by side) plus simultaneous pairs, and one compressed leg
+    proving compression + chunked transfer stay engaged per bucket."""
+    import threading
+    on_env = {"HOROVOD_TPU_SHM": "0",
+              "HOROVOD_TPU_RING_THRESHOLD": "-1",
+              "HOROVOD_TPU_METRICS": "1",
+              "HOROVOD_OVERLAP_INFLIGHT": "2",
+              "OVERLAP_BENCH_MODE": "bucketed"}
+    off_env = dict(on_env, HOROVOD_OVERLAP_INFLIGHT="0",
+                   OVERLAP_BENCH_MODE="flat")
+
+    # Calibrate: the flat leg's step with zero injected compute IS
+    # the steady wire+protocol time; inject that much compute.
+    probe = _run_world("overlap", np_, timeout=600.0,
+                       extra_env=dict(off_env,
+                                      OVERLAP_BENCH_COMPUTE_US="0"))
+    compute_us = max(500, int(probe["us_per_step"]))
+    on_env["OVERLAP_BENCH_COMPUTE_US"] = str(compute_us)
+    off_env["OVERLAP_BENCH_COMPUTE_US"] = str(compute_us)
+
+    iso_ons, iso_offs, iso_ratios = [], [], []
+    for rep in range(3):
+        a = _run_world("overlap", np_, timeout=600.0, extra_env=on_env)
+        b = _run_world("overlap", np_, timeout=600.0,
+                       extra_env=off_env)
+        iso_ons.append(a)
+        iso_offs.append(b)
+        iso_ratios.append(b["us_per_step"] / a["us_per_step"])
+    ons, offs, ratios = [], [], []
+    for rep in range(2):
+        pair = {}
+
+        def _go(key, env):
+            pair[key] = _run_world("overlap", np_, timeout=600.0,
+                                   extra_env=env)
+
+        ta = threading.Thread(target=_go, args=("on", on_env))
+        tb = threading.Thread(target=_go, args=("off", off_env))
+        ta.start()
+        tb.start()
+        ta.join()
+        tb.join()
+        ons.append(pair["on"])
+        offs.append(pair["off"])
+        ratios.append(pair["off"]["us_per_step"]
+                      / pair["on"]["us_per_step"])
+    comp = _run_world(
+        "overlap", np_, timeout=600.0,
+        extra_env=dict(on_env, HOROVOD_COMPRESSION="bf16",
+                       HOROVOD_OVERLAP_CHUNK_BYTES="4096"))
+    iso_ons.sort(key=lambda d: d["us_per_step"])
+    iso_offs.sort(key=lambda d: d["us_per_step"])
+    iso_ratios.sort()
+    ratios.sort()
+    med_on = iso_ons[len(iso_ons) // 2]
+    sec = {"world_size": np_,
+           "cores": os.cpu_count(),
+           "compute_us_per_step": compute_us,
+           "wire_probe_us_per_step": probe["us_per_step"],
+           "overlap_on": med_on,
+           "overlap_off": iso_offs[len(iso_offs) // 2],
+           "isolated_ratios": [round(r, 2) for r in iso_ratios],
+           "isolated_speedup": round(
+               iso_ratios[len(iso_ratios) // 2], 2),
+           "pair_ratios": [round(r, 2) for r in ratios],
+           "pair_speedup": round(
+               sorted(ratios)[len(ratios) // 2], 2) if ratios else None,
+           "compressed_on": comp,
+           "overlap_fraction": med_on.get("overlap_fraction_mean"),
+           "zero_copies": med_on.get("data_copies") == 0,
+           "meets_1_3x": None,
+           "meets_fraction_50pct": None}
+    sec["meets_1_3x"] = sec["isolated_speedup"] >= 1.3
+    f = sec["overlap_fraction"]
+    sec["meets_fraction_50pct"] = (f is not None and f >= 0.5)
+    return sec
+
+
 def _metrics_bench_section(np_: int) -> dict:
     """Metrics-plane overhead A/B on the PR 3 steady bucket (the
     worker_cache loop: 64 x 4 KiB grouped allreduce per step, cache
@@ -1329,7 +1530,7 @@ def main() -> None:
                              "bcast_render", "ragged_allgather",
                              "overhead", "autotune_value", "cache",
                              "elastic", "compression",
-                             "compression_autotune"])
+                             "compression_autotune", "overlap"])
     ap.add_argument("--rank", type=int)
     ap.add_argument("--size", type=int)
     ap.add_argument("--skip-variants", action="store_true",
@@ -1350,6 +1551,13 @@ def main() -> None:
                          "re-rendezvous gap, us/op after the shrink; "
                          "recovery asserted < 2x heartbeat timeout) "
                          "and merge it into RESULTS_cpu.json")
+    ap.add_argument("--overlap", action="store_true",
+                    help="run just the overlap-tier A/B (bucketed "
+                         "ready-order dispatch + in-flight cycles vs "
+                         "the synchronous steady path, injected "
+                         "compute calibrated to wire time; isolated + "
+                         "simultaneous-pair protocols) and merge it "
+                         "into RESULTS_cpu.json")
     ap.add_argument("--compression", action="store_true",
                     help="run just the wire-compression/two-level "
                          "grid ((algorithm x dtype x bucket) medians "
@@ -1370,6 +1578,7 @@ def main() -> None:
          "elastic": worker_elastic,
          "compression": worker_compression,
          "compression_autotune": worker_compression_autotune,
+         "overlap": worker_overlap,
          "overhead": worker_overhead}[args.worker](
              args.rank, args.size)
         return
@@ -1422,6 +1631,29 @@ def main() -> None:
             json.dump(merged, fh, indent=2)
             fh.write("\n")
         print(f"merged compression into {results_path}")
+        return
+
+    if args.overlap:
+        print(f"== overlap tier A/B (np={np_}, compute ~= wire) ==",
+              flush=True)
+        ov = _overlap_bench_section(np_)
+        print(f"  overlap {ov['overlap_on']['us_per_step']} us/step "
+              f"vs flat {ov['overlap_off']['us_per_step']} us/step   "
+              f"isolated speedup {ov['isolated_speedup']}x "
+              f"(>=1.3 pass={ov['meets_1_3x']})   overlap fraction "
+              f"{ov['overlap_fraction']} "
+              f"(>=0.5 pass={ov['meets_fraction_50pct']})   "
+              f"zero copies={ov['zero_copies']}", flush=True)
+        try:
+            with open(results_path) as fh:
+                merged = json.load(fh)
+        except (OSError, ValueError):
+            merged = {}
+        merged["overlap"] = ov
+        with open(results_path, "w") as fh:
+            json.dump(merged, fh, indent=2)
+            fh.write("\n")
+        print(f"merged overlap into {results_path}")
         return
 
     if args.steady_only:

@@ -133,6 +133,36 @@ class Config:
     # residuals, Deep Gradient Compression style).
     compression: str = "none"
 
+    # Overlap tier (docs/performance.md Layer 5): bucketed ready-order
+    # dispatch + asynchronous in-flight steady cycles that hide
+    # collective wire time under backward compute (DDP-bucket /
+    # ByteScheduler lineage). HOROVOD_OVERLAP_BUCKETS splits every
+    # grouped allreduce into that many size-balanced buckets (0 =
+    # derive from HOROVOD_OVERLAP_BYTES; both 0 = bucketing off), each
+    # negotiated and reduced as its OWN fused speculative / native
+    # zero-copy cycle, so early buckets ride the wire while the
+    # training thread still computes later gradients.
+    # HOROVOD_OVERLAP_BYTES is the target bucket payload size when
+    # deriving the count. All knobs are rank-local scheduling only —
+    # the wire protocol is unchanged, so heterogeneous worlds degrade
+    # to the synchronous path instead of diverging.
+    overlap_buckets: int = 0
+    overlap_bucket_bytes: int = 0
+    # Asynchronous in-flight steady cycles: up to this many zero-copy
+    # native steady cycles may be outstanding on the overlap runner
+    # thread while the background loop packs the next bucket and the
+    # training thread computes (handles complete out of band;
+    # synchronize() only blocks on the tail bucket). 0 keeps every
+    # cycle synchronous in the background loop. Needs the native
+    # zero-copy plane; falls back silently without it.
+    overlap_inflight: int = 2
+    # Chunked pipelined transfer: the native steady worker splits a
+    # compressed fused arena into wire chunks of this size and
+    # interleaves the hvd_cast compression of chunk i+1 with the
+    # kernel-buffered transmission of chunk i (one fused cast+HMAC
+    # pass when frame auth is armed). 0 disables the chunk loop.
+    overlap_chunk_bytes: int = 1024 * 1024
+
     # Two-level hierarchical allreduce (intra-host shm reduce ->
     # cross-host ring among local roots -> intra-host shm broadcast;
     # reference analog: NCCLHierarchicalAllreduce). HOROVOD_TWO_LEVEL=1
@@ -310,6 +340,14 @@ class Config:
         # uncompressed: wire_code_of raises naming the knob.
         from horovod_tpu.common import wire_dtype as _wdt
         _wdt.wire_code_of(c.compression)
+        c.overlap_buckets = _env_int("HOROVOD_OVERLAP_BUCKETS",
+                                     c.overlap_buckets)
+        c.overlap_bucket_bytes = _env_int("HOROVOD_OVERLAP_BYTES",
+                                          c.overlap_bucket_bytes)
+        c.overlap_inflight = _env_int("HOROVOD_OVERLAP_INFLIGHT",
+                                      c.overlap_inflight)
+        c.overlap_chunk_bytes = _env_int("HOROVOD_OVERLAP_CHUNK_BYTES",
+                                         c.overlap_chunk_bytes)
         c.two_level = _env_bool("HOROVOD_TWO_LEVEL", c.two_level)
         c.two_level_threshold_bytes = _env_int(
             "HOROVOD_TWO_LEVEL_THRESHOLD", c.two_level_threshold_bytes)

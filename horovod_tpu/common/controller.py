@@ -18,6 +18,7 @@ hostname exactly like the reference's ``MPI_Comm_split_type(SHARED)`` +
 from __future__ import annotations
 
 import errno
+import ipaddress
 import json
 import os
 import select
@@ -228,19 +229,43 @@ def pack_frames(frames: List[bytes]) -> bytes:
 
 
 def unpack_frames(blob: bytes) -> List[bytes]:
-    """Inverse of :func:`pack_frames`."""
-    (n,) = _PACK_COUNT.unpack_from(blob, 0)
-    off = _PACK_COUNT.size
-    out: List[bytes] = []
-    for _ in range(n):
-        (ln,) = _PACK_LEN.unpack_from(blob, off)
-        off += _PACK_LEN.size
-        out.append(bytes(blob[off:off + ln]))
-        off += ln
+    """Inverse of :func:`pack_frames`. An aggregate truncated
+    mid-header raises ConnectionError like every other malformed
+    control frame — the relay error handling (and the fail-fast blame
+    machinery behind it) is written around the ConnectionError family,
+    and a raw ``struct.error`` would escape it."""
+    try:
+        (n,) = _PACK_COUNT.unpack_from(blob, 0)
+        off = _PACK_COUNT.size
+        out: List[bytes] = []
+        for _ in range(n):
+            (ln,) = _PACK_LEN.unpack_from(blob, off)
+            off += _PACK_LEN.size
+            if off + ln > len(blob):
+                raise ConnectionError(
+                    f"aggregate frame truncated: slot of {ln} bytes "
+                    f"at offset {off} overruns {len(blob)}-byte blob")
+            out.append(bytes(blob[off:off + ln]))
+            off += ln
+    except struct.error as e:
+        raise ConnectionError(
+            f"aggregate frame truncated mid-header: {e}") from e
     if off != len(blob):
         raise ConnectionError(
             f"aggregate frame has {len(blob) - off} trailing bytes")
     return out
+
+
+def _dialable_leaf_ip(ip: str) -> bool:
+    """True when a leaf's observed connect address is worth recording
+    as its dialable override. Loopback means shared-netns (the root
+    channel's IP answers for the leaf) — and that includes IPv6
+    ``::1``, which a prefix test on ``127.`` would wrongly record as
+    a dialable address. Unparseable strings stay excluded."""
+    try:
+        return not ipaddress.ip_address(ip).is_loopback
+    except ValueError:
+        return False
 
 
 def _accept_handshakes(server, secret: bytes, deadline: float,
@@ -951,7 +976,7 @@ class TcpCoordinator(Controller):
                     f"expected leaf-IP report from rank {root}, got "
                     f"tag {tag}")
             for r, ip in json.loads(data.decode())["leaf_ips"].items():
-                if not ip.startswith("127."):
+                if _dialable_leaf_ip(ip):
                     self._peer_ip_override[int(r)] = ip
 
     @staticmethod

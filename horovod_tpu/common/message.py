@@ -336,16 +336,22 @@ class ResponseList:
     """
 
     __slots__ = ("responses", "shutdown", "tuned_cycle_time_ms",
-                 "tuned_fusion_threshold_bytes")
+                 "tuned_fusion_threshold_bytes",
+                 "tuned_overlap_buckets")
 
     def __init__(self, responses: List[Response] | None = None,
                  shutdown: bool = False,
                  tuned_cycle_time_ms: float = 0.0,
-                 tuned_fusion_threshold_bytes: int = 0):
+                 tuned_fusion_threshold_bytes: int = 0,
+                 tuned_overlap_buckets: int = -1):
         self.responses = responses if responses is not None else []
         self.shutdown = shutdown
         self.tuned_cycle_time_ms = tuned_cycle_time_ms
         self.tuned_fusion_threshold_bytes = tuned_fusion_threshold_bytes
+        # Autotuned overlap bucket count (-1 = no verdict; 0 = tuned
+        # off). Rides next to the fusion/cycle trailer so every rank
+        # adopts the coordinator's bucket plan on the same verdict.
+        self.tuned_overlap_buckets = tuned_overlap_buckets
 
     def add_response(self, resp: Response) -> None:
         self.responses.append(resp)
@@ -356,4 +362,6 @@ class ResponseList:
                 and self.tuned_cycle_time_ms == other.tuned_cycle_time_ms
                 and self.tuned_fusion_threshold_bytes
                     == other.tuned_fusion_threshold_bytes
+                and self.tuned_overlap_buckets
+                    == other.tuned_overlap_buckets
                 and self.responses == other.responses)

@@ -1,0 +1,274 @@
+"""Overlap tier: bucketed ready-order dispatch + in-flight steady cycles.
+
+Every perf layer so far made the steady collective *cycle* cheaper
+(PR 3 one round-trip, PR 6 zero-copy native, PR 9 wire compression),
+but the step stayed strictly sequential: backward finishes, then ONE
+blocking fused cycle runs, so wire time adds linearly to compute time.
+This module is the scheduling half of the fix (the DDP-bucket /
+ByteScheduler lineage — Li et al., VLDB 2020; Peng et al., SOSP 2019):
+
+- :func:`plan_buckets` splits a grouped gradient submission into K
+  size-balanced CONTIGUOUS buckets (contiguity preserves ready order —
+  gradients become available back-to-front, and a bucket is enqueued
+  the moment its members exist). Each bucket negotiates and reduces as
+  its own fused speculative / native zero-copy cycle, so early buckets
+  ride the wire while the training thread still computes later
+  gradients.
+
+- :class:`OverlapRunner` drives up to ``HOROVOD_OVERLAP_INFLIGHT``
+  native steady cycles from a dedicated completion thread with the GIL
+  released: the background loop *submits* a packed cycle and
+  immediately returns to building the next bucket's frame; handles
+  complete out of band when the loop drains finished outcomes, so
+  ``synchronize()`` only ever blocks on the tail bucket.
+
+Thread-ownership contract (what keeps the response cache coherent):
+the runner thread ONLY performs wire I/O (``steady_spec_cycle`` — a
+single C call per cycle). Every world-replicated mutation (cache LRU
+touches, steady-mask bookkeeping, entry pops and completion callbacks)
+happens on the background thread when it drains the runner's outcome
+queue, in submission order. Cycles are strictly FIFO on the wire — one
+native call at a time — so world-coherent cycle ordering is exactly
+the submission order, which is the (world-identical) program order of
+the bucketed enqueues. Any deviation outcome stalls the runner; the
+background loop resolves it through the classic protocol machinery and
+requeues cancelled (never-sent) cycles, so the wire never interleaves.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import List, Optional
+
+from horovod_tpu.common import lockdep
+
+# Steady predictor slots are capped (runtime keeps the most recent
+# masks); more buckets than this could never all stay steady at once.
+MAX_BUCKETS = 8
+
+
+def plan_buckets(nbytes_list: List[int], nbuckets: int,
+                 bucket_bytes: int) -> Optional[List[int]]:
+    """Split a gradient set into contiguous size-balanced buckets.
+
+    Returns the list of bucket END indices (``[e0, e1, ...]``, each
+    exclusive; the last equals ``len(nbytes_list)``), or None when
+    bucketing is off or degenerate (fewer than 2 buckets). A pure
+    function of per-tensor byte sizes — identical on every rank for
+    the same submission, which is what keeps the per-bucket
+    negotiation masks world-identical.
+
+    ``nbuckets`` > 0 forces the count; otherwise it derives from
+    ``bucket_bytes`` (total / target, DDP's ``bucket_cap_mb`` shape).
+    Both 0/unset means off. The count is clamped to [2, MAX_BUCKETS]
+    and to the tensor count.
+    """
+    n = len(nbytes_list)
+    total = sum(nbytes_list)
+    if n < 2 or total <= 0:
+        return None
+    if nbuckets <= 0:
+        if bucket_bytes <= 0:
+            return None
+        nbuckets = (total + bucket_bytes - 1) // bucket_bytes
+    k = min(int(nbuckets), MAX_BUCKETS, n)
+    if k < 2:
+        # A submission smaller than one bucket target stays whole —
+        # force-splitting it would only multiply protocol rounds.
+        return None
+    # Greedy boundary walk: close a bucket once its cumulative bytes
+    # reach the next j*total/k threshold, keeping every bucket
+    # non-empty and leaving at least one tensor per remaining bucket.
+    ends: List[int] = []
+    acc = 0
+    for i, nb in enumerate(nbytes_list):
+        acc += nb
+        remaining_slots = k - len(ends) - 1
+        if remaining_slots <= 0:
+            break
+        if acc * k >= total * (len(ends) + 1) \
+                and (n - (i + 1)) >= remaining_slots:
+            ends.append(i + 1)
+    ends.append(n)
+    return ends if len(ends) >= 2 else None
+
+
+class InflightCycle:
+    """One submitted steady cycle: the packed plan plus everything the
+    background loop needs to apply its verdict at drain time."""
+
+    __slots__ = ("plan", "bufs", "bit_requests", "inflight", "seq",
+                 "t_submit", "t_start", "t_done", "outcome",
+                 "blocked_wait")
+
+    def __init__(self, plan, bufs, bit_requests, inflight, seq: int):
+        self.plan = plan
+        self.bufs = bufs
+        self.bit_requests = bit_requests
+        self.inflight = inflight  # [(Response, entries, arrays)]
+        self.seq = seq
+        self.t_submit = time.monotonic()
+        self.t_start = 0.0
+        self.t_done = 0.0
+        self.outcome = None       # ("done", segs) | ("frame", ...) |
+        #                           ("fallback", ...) | ("none", None)
+        #                           | ("error", BaseException)
+        self.blocked_wait = 0.0   # bg-thread wall time spent waiting
+
+
+class OverlapRunner:
+    """FIFO completion thread for in-flight native steady cycles.
+
+    ``run_fn(plan, bufs)`` is ``controller.steady_spec_cycle`` — wire
+    I/O only, GIL released inside the native call. The runner executes
+    submitted cycles strictly in order; outcomes park on a completion
+    deque the background loop drains. A non-"done" outcome (deviation,
+    unsupported probe, transport error) STALLS the runner: no further
+    pending cycle is started until the background loop resolves it and
+    calls :meth:`cancel_pending` — the wire therefore never carries a
+    classic round interleaved with a later speculative frame.
+    """
+
+    def __init__(self, run_fn, max_inflight: int, on_complete=None):
+        self._run_fn = run_fn
+        self._max = max(1, int(max_inflight))
+        self._on_complete = on_complete  # e.g. runtime._wake.set
+        self._lock = lockdep.lock("overlap.OverlapRunner._lock")
+        self._cv = threading.Condition(self._lock)
+        self._pending: "collections.deque[InflightCycle]" = \
+            collections.deque()
+        self._completed: "collections.deque[InflightCycle]" = \
+            collections.deque()
+        self._active: Optional[InflightCycle] = None
+        self._stalled = False
+        self._stopped = False
+        self._cycles_total = 0
+        self._thread = threading.Thread(target=self._loop,
+                                        name="hvd-overlap",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- background-loop API (never called from the runner thread) -----
+    @property
+    def outstanding(self) -> int:
+        """In-flight plus undrained completions — anything whose
+        verdict the background loop has not applied yet."""
+        with self._lock:
+            return (len(self._pending) + len(self._completed)
+                    + (1 if self._active else 0))
+
+    @property
+    def cycles_total(self) -> int:
+        return self._cycles_total
+
+    @property
+    def stalled(self) -> bool:
+        with self._lock:
+            return self._stalled
+
+    def submit(self, cycle: InflightCycle) -> None:
+        """Enqueue a cycle; blocks while the in-flight window is full
+        or while the same plan is still in flight (its arena views are
+        the send buffers on the wire)."""
+        with self._cv:
+            while not self._stopped and not self._stalled and (
+                    len(self._pending) + (1 if self._active else 0)
+                    >= self._max
+                    or self._plan_busy_locked(cycle.plan)):
+                self._cv.wait(0.05)
+            if self._stopped or self._stalled:
+                # Caller drains/handles the stall; never silently drop.
+                raise RuntimeError("overlap runner unavailable")
+            self._pending.append(cycle)
+            self._cv.notify_all()
+
+    def _plan_busy_locked(self, plan) -> bool:
+        if self._active is not None and self._active.plan is plan:
+            return True
+        return any(c.plan is plan for c in self._pending) \
+            or any(c.plan is plan for c in self._completed)
+
+    def pop_completed(self) -> Optional[InflightCycle]:
+        with self._cv:
+            if not self._completed:
+                return None
+            c = self._completed.popleft()
+            self._cv.notify_all()
+            return c
+
+    def wait_completed(self, timeout: float) -> Optional[InflightCycle]:
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while not self._completed:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._stopped:
+                    return None
+                self._cv.wait(min(remaining, 0.05))
+            c = self._completed.popleft()
+            self._cv.notify_all()
+            return c
+
+    def cancel_pending(self) -> List[InflightCycle]:
+        """Remove every never-started cycle (their frames were NEVER
+        sent — safe to requeue) and clear a deviation stall. The
+        active cycle, if any, still completes and parks its outcome."""
+        with self._cv:
+            cancelled = list(self._pending)
+            self._pending.clear()
+            self._stalled = False
+            self._cv.notify_all()
+            return cancelled
+
+    def stop(self, timeout: float = 5.0) -> List[InflightCycle]:
+        """Teardown: stop accepting work, wake the thread, join, and
+        hand back everything undrained (pending + completed) so the
+        caller can fail their entries."""
+        with self._cv:
+            self._stopped = True
+            leftovers = list(self._pending)
+            self._pending.clear()
+            self._cv.notify_all()
+        self._thread.join(timeout)
+        with self._cv:
+            leftovers.extend(self._completed)
+            self._completed.clear()
+        return leftovers
+
+    # -- runner thread -------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stopped and (
+                        self._stalled or not self._pending):
+                    self._cv.wait(0.05)
+                if self._stopped:
+                    return
+                cycle = self._pending.popleft()
+                self._active = cycle
+                self._cv.notify_all()
+            cycle.t_start = time.monotonic()
+            try:
+                outcome = self._run_fn(cycle.plan, cycle.bufs)
+                if outcome is None:
+                    cycle.outcome = ("none", None)
+                else:
+                    cycle.outcome = outcome
+            except BaseException as e:  # parked; re-raised at drain
+                cycle.outcome = ("error", e)
+            cycle.t_done = time.monotonic()
+            with self._cv:
+                self._active = None
+                self._completed.append(cycle)
+                self._cycles_total += 1
+                if cycle.outcome[0] != "done":
+                    # Deviation/error: hold the wire until the
+                    # background loop resolves it classically.
+                    self._stalled = True
+                self._cv.notify_all()
+            if self._on_complete is not None:
+                try:
+                    self._on_complete()
+                except Exception:
+                    pass

@@ -134,6 +134,45 @@ class _BucketTuner:
             for i, (a, w) in enumerate(self.plan))
 
 
+class _OverlapTuner:
+    """Measured sweep over overlap bucket counts — the second discrete
+    grid phase, run AFTER the wire sweep and BEFORE the Bayesian phase
+    (speculation must stay live while it measures: the overlap tier IS
+    a property of the fused speculative regime). Same protocol as
+    _BucketTuner: each candidate scored by the MAX of its samples over
+    two interleaved passes (throttle bursts only deflate throughput),
+    argmax wins. The active candidate rides the ResponseList trailer so
+    every rank splits its submissions identically; transient mismatch
+    during adoption merely degrades those cycles to the classic path."""
+
+    _PASSES = 2
+
+    def __init__(self, candidates):
+        self._candidates = list(candidates)
+        self._ci = 0
+        self._pass = 0
+        self._scores = [float("-inf")] * len(self._candidates)
+        self.done = len(self._candidates) < 2
+        self.choice = self._candidates[0] if self._candidates else 0
+
+    def current(self) -> int:
+        return self._candidates[self._ci]
+
+    def feed(self, score: float, traffic: int) -> None:
+        if self.done or traffic <= 0:
+            return  # a lull says nothing about the candidate
+        self._scores[self._ci] = max(score, self._scores[self._ci])
+        self._ci += 1
+        if self._ci >= len(self._candidates):
+            self._ci = 0
+            self._pass += 1
+            if self._pass >= self._PASSES:
+                best = max(range(len(self._candidates)),
+                           key=lambda i: self._scores[i])
+                self.choice = self._candidates[best]
+                self.done = True
+
+
 class ParameterManager:
     def __init__(self, config, controller):
         self._is_coordinator = controller.rank == 0
@@ -161,6 +200,11 @@ class ParameterManager:
         nb = len(BUCKET_BOUNDS) + 1
         self._bucket_plan = [(_wd.ALG_DEFAULT, None)] * nb
         self._bucket_tuner = None
+        # Overlap bucket-count grid (configure_overlap): None until
+        # armed; workers adopt the coordinator's active/settled value
+        # from the ResponseList trailer (apply_synced).
+        self._overlap_tuner = None
+        self._overlap_current = None
         self._bucket_bytes = [0] * nb
         self._bucket_mark = [0] * nb
         # per-sample accumulation
@@ -198,6 +242,40 @@ class ParameterManager:
         if len(combos) > 1:
             self._bucket_tuner = _BucketTuner(
                 combos, len(BUCKET_BOUNDS) + 1)
+
+    def configure_overlap(self, armed: bool) -> None:
+        """Add the overlap bucket count to the discrete grid
+        (coordinator only, and only when the overlap tier can engage):
+        candidates 0 (off), 2, 4, 8 buckets, measured after the wire
+        sweep settles and scored by the same bytes/µs stream."""
+        if not armed or not self._is_coordinator or not self._tuning:
+            return
+        self._overlap_tuner = _OverlapTuner([0, 2, 4, 8])
+
+    def overlap_buckets(self):
+        """The bucket count the overlap dispatcher should use right
+        now, or None when the tuner never armed (static knobs rule).
+        Coordinator: the candidate under measurement, then the settled
+        argmax. Workers: the value adopted from the trailer."""
+        t = self._overlap_tuner
+        if t is not None:
+            if t.done:
+                return t.choice
+            # Only measure once the wire sweep settled: both grids
+            # share the score stream, and interleaving them would
+            # attribute one dimension's effect to the other.
+            wt = self._bucket_tuner
+            if wt is None or wt.done:
+                return t.current()
+            return None
+        return self._overlap_current
+
+    @property
+    def tuned_overlap_buckets(self) -> int:
+        """Trailer value the coordinator stamps each cycle: the active
+        candidate/settled choice, or -1 (no verdict) while unarmed."""
+        v = self.overlap_buckets() if self._is_coordinator else None
+        return -1 if v is None else int(v)
 
     def plan(self, nbytes: int):
         """-> (ALG_* code, wire cap or None) for one fused batch —
@@ -251,7 +329,12 @@ class ParameterManager:
         if not self._is_coordinator or not self._tuning:
             return True
         t = self._bucket_tuner
-        return t is not None and not t.done
+        if t is not None and not t.done:
+            return True
+        # The overlap grid ALSO needs live speculation: its candidates
+        # are properties of the fused speculative regime.
+        ot = self._overlap_tuner
+        return ot is not None and not ot.done
 
     # -- values consumed by the runtime ---------------------------------
     @property
@@ -268,15 +351,20 @@ class ParameterManager:
         return float(self._current[1])
 
     def apply_synced(self, fusion_threshold_bytes: int,
-                     cycle_time_ms: float) -> None:
+                     cycle_time_ms: float,
+                     overlap_buckets: int = -1) -> None:
         """Workers adopt the coordinator's tuned values (reference:
         SyncParams, parameter_manager.cc:64-78). The untuned-trailer
         sentinel is cycle_time_ms == 0: real tuned cycle times are
         bounded >= 1 ms, while a FUSION threshold of 0 MB is a
-        legitimate tuned value (fusion off) and must still be adopted."""
+        legitimate tuned value (fusion off) and must still be adopted.
+        ``overlap_buckets`` uses -1 as its sentinel (0 = tuned OFF is
+        a legitimate verdict)."""
         if not self._is_coordinator and cycle_time_ms > 0:
             self._current = np.asarray(
                 [fusion_threshold_bytes / _MB, cycle_time_ms])
+        if not self._is_coordinator and overlap_buckets >= 0:
+            self._overlap_current = overlap_buckets
 
     # -- sampling --------------------------------------------------------
     def on_cycle(self, nbytes: int) -> None:
@@ -319,6 +407,19 @@ class ParameterManager:
                 self._bucket_plan = list(t.plan)
                 hlog.info("autotune wire plan settled: "
                           + t.describe())
+            return
+
+        # Phase 2 — overlap bucket-count grid (speculation stays live;
+        # see spec_safe). Scored by total traffic: bucketing reshapes
+        # every allreduce, not one size bucket.
+        ot = self._overlap_tuner
+        if ot is not None and not ot.done:
+            total = sum(self._bucket_bytes) - sum(self._bucket_mark)
+            self._bucket_mark = list(self._bucket_bytes)
+            ot.feed(sample_score, total)
+            if ot.done:
+                hlog.info(f"autotune overlap bucket count settled: "
+                          f"{ot.choice}")
             return
 
         self._samples_taken += 1

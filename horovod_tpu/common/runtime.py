@@ -31,6 +31,7 @@ from horovod_tpu.common import faults
 from horovod_tpu.common import lockdep
 from horovod_tpu.common import logging as hlog
 from horovod_tpu.common import metrics as hmetrics
+from horovod_tpu.common import overlap as hoverlap
 from horovod_tpu.common import steady as hsteady
 from horovod_tpu.common import wire
 from horovod_tpu.common import wire_dtype as _wd
@@ -229,6 +230,11 @@ class Runtime:
                 self._wire_propose, self._multi_host, controller.size,
                 shm_enabled=config.shm_enabled,
                 ring_allowed=config.ring_threshold_bytes >= 0)
+            # Overlap bucket count joins the discrete grid (measured
+            # between the wire sweep and the BO phase) only when the
+            # overlap tier can actually engage on this rank.
+            parameter_manager.configure_overlap(
+                config.overlap_inflight > 0)
         else:
             self._wire_policy = _wd.StaticWirePolicy(
                 config.two_level, config.two_level_threshold_bytes,
@@ -275,6 +281,54 @@ class Runtime:
                                   and self._spec_ok
                                   and controller.steady_native_ready())
         self._send_arena = harena.FusionArena()
+        # -- overlap tier (HOROVOD_OVERLAP_*, common/overlap.py) -------
+        # Bucketed ready-order dispatch + in-flight steady cycles: the
+        # background loop SUBMITS packed zero-copy cycles to a
+        # dedicated completion thread and immediately returns to
+        # building the next bucket's frame, so collective wire time
+        # hides under backward compute. Rank-local scheduling only —
+        # the wire protocol is unchanged, heterogeneous knobs degrade
+        # to the synchronous path. Cycles stay strictly FIFO on the
+        # wire (one native call at a time on the runner thread), and
+        # every world-replicated mutation still happens on THIS
+        # thread, at drain, in submission order.
+        self._overlap: Optional[hoverlap.OverlapRunner] = None
+        self._overlap_chunk = max(0, config.overlap_chunk_bytes)
+        if config.overlap_inflight > 0 and self._steady_native_ok:
+            self._overlap = hoverlap.OverlapRunner(
+                controller.steady_spec_cycle,
+                config.overlap_inflight,
+                on_complete=self._wake.set)
+        self._overlap_seq = 0
+        self._overlap_hold_deadline = None  # empty-queue hold expiry
+        self._overlap_cycles = 0  # completed overlapped cycles
+        self._overlap_buckets_submitted = 0
+        # Submission-ordered masks of cycles in flight on the runner:
+        # the world-coherent cycle ORDER — every rank submits the same
+        # masks in the same (program) order, and verdicts apply in
+        # that order at drain. Mutated only on broadcast-driven paths.
+        self._inflight_masks: List[int] = []  # hvdlint: world-replicated
+        # Steady predictor depth: each overlap bucket needs its own
+        # steady mask to stay resident or speculation thrashes. Any
+        # bucketing source counts — the static knob, a byte-derived
+        # count, or the autotuner's choice (armed via overlap_inflight)
+        # — and all of them are bounded by MAX_BUCKETS, so size for
+        # that worst case whenever bucketing can engage at all.
+        self._steady_cap = (2 * hoverlap.MAX_BUCKETS
+                            if (self._overlap is not None
+                                or config.overlap_buckets > 0
+                                or config.overlap_bucket_bytes > 0
+                                or config.overlap_inflight > 0)
+                            else 8)
+        # Intended bucket name-sets from bucketed grouped submissions
+        # (rank-local scheduling hint; identical everywhere because
+        # the split is a pure function of the identical submission):
+        # _split_buckets peels pops at these boundaries from the very
+        # first cycle, so each bucket negotiates — and learns its
+        # steady mask — separately even when the training thread gets
+        # ahead of the wire. Snapshot-swapped, never mutated in place
+        # (enqueue threads write, the background thread reads).
+        self._bucket_sets: frozenset = frozenset()
         # (mask, threshold) -> SteadyPlan, valid for one cache epoch.
         self._steady_plans: Dict[tuple, hsteady.SteadyPlan] = {}
         self._steady_plan_epoch = -1
@@ -335,6 +389,22 @@ class Runtime:
             "hvd_compression_ratio",
             "wire bytes / uncompressed bytes per compressed payload",
             hmetrics.RATIO_BUCKETS)
+        # Overlap-tier plane (docs/performance.md Layer 5).
+        self._m_overlap_fraction = reg.histogram(
+            "hvd_overlap_fraction",
+            "per overlapped cycle: fraction of its wire time hidden "
+            "under compute (1.0 = the loop never blocked on it)",
+            hmetrics.RATIO_BUCKETS)
+        self._m_inflight = reg.gauge(
+            "hvd_inflight_cycles",
+            "steady cycles outstanding on the overlap runner",
+            agg=hmetrics.AGG_MAX)
+        self._m_overlap_buckets = reg.counter(
+            "hvd_overlap_buckets_total",
+            "gradient buckets submitted by bucketed grouped dispatch")
+        self._m_overlap_cycles = reg.counter(
+            "hvd_overlap_cycles_total",
+            "steady cycles completed through the overlap runner")
         self._m_cache_hits = reg.counter("hvd_cache_hits_total")
         self._m_cache_misses = reg.counter("hvd_cache_misses_total")
         self._m_cache_evictions = reg.counter(
@@ -635,7 +705,18 @@ class Runtime:
             return
         self._teardown_started = True
         self._done.set()
-        # Native steady state first: the plans' cached ctypes bundles
+        # Overlap runner first: its thread may sit inside a native
+        # cycle against channels about to close — stop accepting work,
+        # let the armed recv deadline return the call, and join. Any
+        # undrained cycle's entries are still tabled (pops happen at
+        # drain), so the pop_all below fails them with the terminal
+        # status like everything else in flight.
+        if self._overlap is not None:
+            try:
+                self._overlap.stop()
+            except Exception:
+                pass  # stage-guarded: plans must still drop
+        # Native steady state next: the plans' cached ctypes bundles
         # bind file descriptors and arena generations of the world
         # that just died — drop them before anything that could raise,
         # so a resumed (elastic) process can never replay a stale
@@ -728,6 +809,24 @@ class Runtime:
     # negotiation + data round for the remainder.
     _BURST_HOLD_S = 0.02
 
+    def _bounded_hold_s(self, multiple: float, floor_s: float,
+                        cycle_ms: Optional[float] = None) -> float:
+        """A hold/wait budget derived from the cycle time, clamped as
+        a WHOLE under heartbeat_timeout/4: a silently-holding rank
+        sends no frames, and its only proof of life is its next one —
+        every hold in this loop must stay far under the peer-death
+        deadline, whatever HOROVOD_CYCLE_TIME is set to. THE one
+        budget rule for the burst hold, the steady idle hold and the
+        overlap empty-queue hold. ``cycle_ms`` overrides the config
+        value where the autotuner's tuned cycle time governs."""
+        if cycle_ms is None:
+            cycle_ms = self.config.cycle_time_ms
+        hold = max(multiple * cycle_ms / 1000.0, floor_s)
+        hb = self.config.heartbeat_timeout_s
+        if hb > 0:
+            hold = min(hold, hb / 4.0)
+        return hold
+
     def _build_request_frame(self, requests: List[Request],
                              shutting_down: bool):
         """Partition this cycle's requests into cache-bitmask bits and
@@ -819,15 +918,8 @@ class Runtime:
 
         if not fragment() or seen <= self._requeued_names:
             return requests
-        hold = max(2 * self.config.cycle_time_ms / 1000.0,
-                   self._BURST_HOLD_S)
-        hb = self.config.heartbeat_timeout_s
-        if hb > 0:
-            # A holding rank sends no frames; like the idle hold, stay
-            # far under the heartbeat deadline or a huge cycle_time
-            # could make a healthy holder look dead to its peers.
-            hold = min(hold, hb / 4.0)
-        deadline = time.monotonic() + hold
+        deadline = time.monotonic() + self._bounded_hold_s(
+            2, self._BURST_HOLD_S)
         while True:
             # Event-driven, not polled: clear BEFORE draining so an
             # enqueue that lands between the drain and the wait still
@@ -970,9 +1062,17 @@ class Runtime:
                 else:
                     segments.append((numpy_dtype_to_datatype(dtype),
                                      dtype, src_nbytes, None))
-            splan = hsteady.SteadyPlan(cache.epoch, cache.nslots,
-                                       hit_mask, segments,
-                                       self._send_arena)
+            # In-flight overlap pipelines cycles of DIFFERENT plans:
+            # each plan then owns a private arena so the packed send
+            # bytes of a submitted cycle can never be overwritten by
+            # the next bucket's pack (the runner additionally blocks
+            # same-plan resubmission while its views are on the wire).
+            arena = (harena.FusionArena() if self._overlap is not None
+                     else self._send_arena)
+            splan = hsteady.SteadyPlan(
+                cache.epoch, cache.nslots, hit_mask, segments, arena,
+                chunk_bytes=(0 if self.controller.is_coordinator
+                             else self._overlap_chunk))
             if len(self._steady_plans) >= 64:
                 self._steady_plans.clear()
             self._steady_plans[key] = splan
@@ -1017,6 +1117,227 @@ class Runtime:
         ctl.broadcast_responses(reply)
         return meta
 
+    # -- overlap tier (common/overlap.py) --------------------------------
+    def overlap_bucket_plan(self, nbytes_list):
+        """Bucket END indices for one grouped submission (ops layer),
+        or None when bucketing is off. A pure function of the
+        per-tensor sizes plus world-identical knobs/tuned values, so
+        every rank splits the same submission the same way."""
+        cfg = self.config
+        k = cfg.overlap_buckets
+        pm = self.parameter_manager
+        if pm is not None:
+            tuned = pm.overlap_buckets()
+            if tuned is not None:
+                k = tuned
+                if k <= 0:
+                    return None
+        return hoverlap.plan_buckets(nbytes_list, k,
+                                     cfg.overlap_bucket_bytes)
+
+    def note_overlap_buckets(self, n: int) -> None:
+        self._overlap_buckets_submitted += n
+
+    def note_bucket_names(self, names) -> None:
+        """Record one intended bucket's name set (called by the ops
+        layer per bucketed enqueue_group, any thread): the background
+        loop splits pops at these boundaries so each bucket
+        negotiates as its own cycle. Bounded; snapshot-swapped.
+        No-op unless the overlap runner is armed — without it, merged
+        pops fusing into one batch is the cheaper outcome."""
+        if self._overlap is None:
+            return
+        s = frozenset(names)
+        cur = self._bucket_sets
+        if s in cur:
+            return
+        if len(cur) >= 4 * hoverlap.MAX_BUCKETS:
+            cur = frozenset()
+        self._bucket_sets = cur | {s}
+
+    def _split_buckets(self, requests: List[Request]) -> List[Request]:
+        """Bucketed steady dispatch: when one pop caught SEVERAL
+        complete steady buckets back-to-back (the training thread got
+        ahead of the wire), peel off the FIRST bucket and requeue the
+        rest — each bucket must ride its OWN fused cycle, or the
+        union would negotiate as one unknown mask and the per-bucket
+        speculation (and the overlap pipeline with it) would unlearn.
+        Grouped enqueues are atomic, so pops only ever see whole
+        buckets; the requeued remainder is re-popped next iteration
+        (which immediately follows — submits count as activity)."""
+        if self._overlap is None or len(requests) < 2:
+            return requests
+        # Only INTENDED bucket sets split pops — never learned steady
+        # sets: a per-tensor submission flow (torch-style hooks) may
+        # transiently grant a lone tensor, and splitting on that
+        # learned singleton would fragment its future fused batches.
+        split_sets = self._bucket_sets
+        if not split_sets:
+            return requests
+        seen = set()
+        for k, r in enumerate(requests):
+            seen.add(r.tensor_name)
+            if k + 1 < len(requests) \
+                    and frozenset(seen) in split_sets:
+                self.tensor_table.requeue(requests[k + 1:])
+                if not self._wake.is_set():
+                    self._wake.set()
+                return requests[:k + 1]
+        return requests
+
+    @world_coherent
+    def _submit_overlap_cycle(self, splan, bit_requests) -> bool:
+        """Hand a packed steady cycle to the overlap runner. Returns
+        False (leaving speculative state intact for the synchronous
+        path) when the runner cannot accept — a deviation stalled it
+        between the loop's drain and this submit, or teardown began.
+        @world_coherent: the in-flight mask sequence only ever grows
+        here, from a world-identically-built plan in program order."""
+        spec = self._spec_steady
+        inflight = self._spec_inflight
+        self._spec_steady = None
+        self._spec_inflight = None
+        if spec is None or inflight is None:
+            return False
+        plan, bufs = spec
+        self._overlap_seq += 1
+        cyc = hoverlap.InflightCycle(plan, bufs, bit_requests,
+                                     inflight, self._overlap_seq)
+        try:
+            self._overlap.submit(cyc)
+        except RuntimeError:
+            self._spec_steady = spec
+            self._spec_inflight = inflight
+            return False
+        self._inflight_masks.append(plan.mask)
+        if self.timeline.enabled:
+            self.timeline.async_start("cycle", "OVERLAP", cyc.seq)
+        return True
+
+    def _drain_overlap(self, block: bool = False) -> None:
+        """Apply finished overlapped cycles in submission order.
+        ``block=True`` waits until NOTHING is outstanding — the wire
+        is quiesced and every verdict applied (the precondition for
+        any classic round). Runs only on the background thread."""
+        runner = self._overlap
+        if runner is None:
+            return
+        while True:
+            cyc = runner.pop_completed()
+            if cyc is None:
+                if not block or not runner.outstanding:
+                    return
+                t0 = time.monotonic()
+                cyc = runner.wait_completed(0.25)
+                if cyc is None:
+                    continue
+                cyc.blocked_wait += time.monotonic() - t0
+            self._finish_overlap_cycle(cyc)
+
+    def _finish_overlap_cycle(self, cyc) -> None:
+        """Apply one runner outcome — the bg-thread half of an
+        overlapped cycle. \"done\" outcomes take the fused-grant fast
+        path; anything else resolves through the classic machinery
+        after cancelling (and requeueing) every never-sent cycle, so
+        the wire order every rank observes stays identical."""
+        kind, val = cyc.outcome
+        if self.timeline.enabled:
+            self.timeline.async_end("cycle", "OVERLAP", cyc.seq)
+        if kind == "done":
+            self._native_steady_cycles += 1
+            self._overlap_cycles += 1
+            if self._metrics_on:
+                dur = max(cyc.t_done - cyc.t_start, 1e-9)
+                self._m_overlap_fraction.observe(
+                    max(0.0, 1.0 - cyc.blocked_wait / dur))
+            if self.controller.is_coordinator:
+                self.timeline.negotiate_cached(fused=True)
+                self._check_stall(self._message_table,
+                                  self.controller.size)
+            meta = CacheCycleResponse(
+                epoch=cyc.plan.epoch, nslots=cyc.plan.nslots,
+                grant_mask=cyc.plan.mask, spec_payload=val)
+            self._apply_overlap_verdict(cyc, meta)
+            return
+        # Deviation / error: no later frame was sent (the runner
+        # stalls), so cancel the queued cycles and put their requests
+        # back — every rank that overlapped does the same at the same
+        # verdict, and ranks that never overlapped have them queued
+        # anyway; the next cycle re-bids them identically everywhere.
+        cancelled = self._overlap.cancel_pending()
+        for c in cancelled:
+            self._unwind_cancelled_cycle(c)
+        if kind == "error":
+            err = val
+            if isinstance(err, WorldAbortedError):
+                entries = [e for (_r, es, _a) in cyc.inflight
+                           for e in es]
+                popped = self.tensor_table.pop_entries(
+                    [e.tensor_name for e in entries]) or entries
+                self._drop_inflight_mask(cyc.plan.mask)
+                raise self._data_plane_abort(
+                    popped, err.origin_rank,
+                    getattr(err, "cause", str(err)))
+            self._drop_inflight_mask(cyc.plan.mask)
+            raise err
+        ctl = self.controller
+        if kind == "none":
+            # Support probe raced: run the cycle classically from the
+            # serialized frame (byte-identical to the native send).
+            payload = cyc.plan.frame_bytes(cyc.bufs)
+            gathered = ctl.gather_requests(payload)
+            if ctl.is_coordinator:
+                reply, meta = self._coordinate_cycle(gathered)
+                ctl.broadcast_responses(reply)
+            else:
+                meta = wire.parse_cycle_response(
+                    ctl.broadcast_responses(None))
+        elif kind == "frame":
+            meta = wire.parse_cycle_response(val)
+        else:
+            assert kind == "fallback"
+            reply, meta = self._coordinate_cycle(val)
+            ctl.broadcast_responses(reply)
+        self._apply_overlap_verdict(cyc, meta)
+
+    @world_coherent
+    def _apply_overlap_verdict(self, cyc, meta) -> None:
+        """Apply a drained cycle's broadcast verdict exactly as the
+        synchronous path would: restore ITS speculative in-flight
+        state, run the shared cached-cycle apply, and execute whatever
+        classic responses the verdict carried."""
+        self._spec_inflight = cyc.inflight
+        self._drop_inflight_mask(cyc.plan.mask)
+        try:
+            resp_list = self._apply_cached_cycle(meta,
+                                                 cyc.bit_requests)
+        finally:
+            self._spec_inflight = None
+        if self.parameter_manager is not None:
+            self.parameter_manager.apply_synced(
+                resp_list.tuned_fusion_threshold_bytes,
+                resp_list.tuned_cycle_time_ms,
+                resp_list.tuned_overlap_buckets)
+        self._perform_operations(resp_list)
+
+    @world_coherent
+    def _unwind_cancelled_cycle(self, cyc) -> None:
+        """A cancelled cycle's frame was never sent: its entries stay
+        tabled, its requests go back on the queue (they are cache hits
+        and re-bid next cycle), and its mask leaves the in-flight
+        sequence — identically on every rank that overlapped."""
+        self._drop_inflight_mask(cyc.plan.mask)
+        reqs = [req for _slot, req in cyc.bit_requests]
+        if reqs:
+            self.tensor_table.requeue(reqs)
+
+    @world_coherent
+    def _drop_inflight_mask(self, mask: int) -> None:
+        try:
+            self._inflight_masks.remove(mask)
+        except ValueError:
+            pass
+
     def _record_signature(self, req: Request) -> None:
         if req.request_type not in CACHEABLE_REQUESTS:
             return
@@ -1058,6 +1379,12 @@ class Runtime:
                 raise err
         self.timeline.mark_cycle_start()
 
+        if self._overlap is not None and self._overlap.outstanding:
+            # Apply finished overlapped cycles (and resolve a parked
+            # deviation) BEFORE building this cycle's frame — their
+            # verdicts move the cache state the frame build reads.
+            self._drain_overlap(block=self._overlap.stalled)
+
         requests = self.tensor_table.pop_messages()
         if requests and self._cache is not None:
             if self._metrics_on:
@@ -1066,25 +1393,82 @@ class Runtime:
                 self._m_burst_hold_s.inc(time.monotonic() - tb)
             else:
                 requests = self._absorb_burst(requests)
+            requests = self._split_buckets(requests)
         shutting_down = self._shutdown_requested.is_set()
+
+        if (self._overlap is not None and not requests
+                and not shutting_down
+                and (self._overlap.outstanding or self._steady)):
+            # Overlap regime with nothing local to negotiate: hold for
+            # work instead of initiating an empty classic round. A
+            # wake from a runner completion is NOT work — without this
+            # hold, completion wakes leak empty frames into the world
+            # rounds, misalign them across ranks, and every
+            # speculative bid that lands in such a round dies as a
+            # dead grant. Bounded like the steady idle hold (far under
+            # the heartbeat deadline) so stall detection, full-path
+            # peers and shutdown all keep their liveness: at expiry
+            # the empty round proceeds after all.
+            now = time.monotonic()
+            if self._overlap_hold_deadline is None:
+                self._overlap_hold_deadline = now + \
+                    self._bounded_hold_s(8, self._STEADY_IDLE_S)
+            if now < self._overlap_hold_deadline:
+                self._wake.wait(self._overlap_hold_deadline - now)
+                self._wake.clear()
+                self._drain_overlap(block=False)
+                return True
+            self._overlap_hold_deadline = None
+        elif requests:
+            self._overlap_hold_deadline = None
+
         payload, bit_requests = self._build_request_frame(
             requests, shutting_down)
 
         if self._metrics_on:
             tn = time.monotonic()
+        submitted = False
+        meta = None
+        if not isinstance(payload, hsteady.SteadyPlan) \
+                and self._overlap is not None \
+                and self._overlap.outstanding:
+            # Classic frame while cycles are in flight: the wire must
+            # quiesce first (cycles are strictly ordered), and the
+            # drained verdicts may have moved cache state or requeued
+            # cancelled buckets — rebuild the frame afterwards.
+            self._drain_overlap(block=True)
+            requests.extend(self.tensor_table.pop_messages())
+            payload, bit_requests = self._build_request_frame(
+                requests, shutting_down)
         if isinstance(payload, hsteady.SteadyPlan):
-            # Zero-copy steady step: negotiation + data plane in ONE
-            # native call (deviations rejoin the classic path inside).
-            # An abort raised from inside the C loop must leave no
-            # in-flight speculative state behind: elastic recovery
-            # re-enters a fresh cycle loop, and stale inflight entries
-            # would satisfy the next spec verdict with dead arrays.
-            try:
-                meta = self._native_steady_cycle(payload)
-            except BaseException:
-                self._spec_inflight = None
-                self._spec_steady = None
-                raise
+            if self._overlap is not None:
+                submitted = self._submit_overlap_cycle(payload,
+                                                       bit_requests)
+                if not submitted:
+                    # Runner stalled or stopped under us: quiesce, then
+                    # run this cycle synchronously — the wire is ours
+                    # again once the drain returns. The drain applies
+                    # OTHER cycles' verdicts, whose apply path clears
+                    # the speculative in-flight state — save THIS
+                    # unsent cycle's across it.
+                    spec_save = (self._spec_steady,
+                                 self._spec_inflight)
+                    self._drain_overlap(block=True)
+                    self._spec_steady, self._spec_inflight = spec_save
+            if not submitted:
+                # Zero-copy steady step: negotiation + data plane in
+                # ONE native call (deviations rejoin the classic path
+                # inside). An abort raised from inside the C loop must
+                # leave no in-flight speculative state behind: elastic
+                # recovery re-enters a fresh cycle loop, and stale
+                # inflight entries would satisfy the next spec verdict
+                # with dead arrays.
+                try:
+                    meta = self._native_steady_cycle(payload)
+                except BaseException:
+                    self._spec_inflight = None
+                    self._spec_steady = None
+                    raise
         else:
             gathered = self.controller.gather_requests(payload)
             if self.controller.is_coordinator:
@@ -1095,6 +1479,21 @@ class Runtime:
                 meta = wire.parse_cycle_response(data)
         if self._metrics_on:
             self._m_negotiation_s.observe(time.monotonic() - tn)
+
+        if submitted:
+            # The cycle completes out of band; its verdict applies at
+            # a later drain, in submission order. Handles resolve
+            # then — synchronize() only ever blocks on the tail
+            # bucket. Treat the submit as activity and loop
+            # immediately: the next bucket may already be queued.
+            self._idle_cycles = 0
+            if self.parameter_manager is not None:
+                self.parameter_manager.on_cycle(self._cycle_bytes)
+                self._cycle_bytes = 0
+            if self._metrics_on:
+                self._m_cycle_s.observe(time.monotonic() - t0)
+                self._maybe_publish_metrics()
+            return True
 
         if isinstance(meta, CacheCycleResponse):
             resp_list = self._apply_cached_cycle(meta, bit_requests)
@@ -1118,7 +1517,8 @@ class Runtime:
         if self.parameter_manager is not None:
             self.parameter_manager.apply_synced(
                 resp_list.tuned_fusion_threshold_bytes,
-                resp_list.tuned_cycle_time_ms)
+                resp_list.tuned_cycle_time_ms,
+                resp_list.tuned_overlap_buckets)
             self.parameter_manager.on_cycle(self._cycle_bytes)
             self._cycle_bytes = 0
             cycle_time_ms = self.parameter_manager.cycle_time_ms()
@@ -1168,16 +1568,8 @@ class Runtime:
                 # the only cost is bounded frame latency on a world
                 # where OTHER ranks are active while this one idles —
                 # and their grants were blocked on this rank anyway.
-                hold = max(8 * cycle_time_ms / 1000.0,
-                           self._STEADY_IDLE_S)
-                hb = self.config.heartbeat_timeout_s
-                if hb > 0:
-                    # the cap bounds the WHOLE hold, including the
-                    # cycle-time-derived term, or a large
-                    # HOROVOD_CYCLE_TIME could silently eat the
-                    # heartbeat deadline
-                    hold = min(hold, hb / 4.0)
-                sleep_s = max(sleep_s, hold)
+                sleep_s = max(sleep_s, self._bounded_hold_s(
+                    8, self._STEADY_IDLE_S, cycle_ms=cycle_time_ms))
                 idle_hold = True
         backoff_ms = self.config.idle_backoff_ms
         if backoff_ms > 0 and self._idle_cycles > self._IDLE_GRACE:
@@ -1393,7 +1785,7 @@ class Runtime:
                     cache.entry(s).name
                     for s in self._iter_slots(meta.grant_mask))
                 self._steady.move_to_end(meta.grant_mask)
-                if len(self._steady) > 8:
+                if len(self._steady) > self._steady_cap:
                     self._steady.popitem(last=False)
             elif meta.grant_mask or inner.responses \
                     or meta.invalid_mask:
@@ -1649,6 +2041,12 @@ class Runtime:
         self._m_spec_bids.set_total(self._spec_bids)
         self._m_spec_denials.set_total(self._spec_denials_total)
         self._m_native_steady.set_total(self._native_steady_cycles)
+        self._m_overlap_cycles.set_total(self._overlap_cycles)
+        self._m_overlap_buckets.set_total(
+            self._overlap_buckets_submitted)
+        self._m_inflight.set(
+            self._overlap.outstanding if self._overlap is not None
+            else 0)
         self._m_arena_bytes.set(harena.total_bytes())
         self._m_queue_depth.set(len(self.tensor_table))
         self._m_lock_inversions.set_total(lockdep.inversion_count())
@@ -1733,6 +2131,10 @@ class Runtime:
                 "spec_cycles": self._spec_cycles,
                 "spec_bids": self._spec_bids,
                 "native_steady_cycles": self._native_steady_cycles,
+                "overlap_cycles": self._overlap_cycles,
+                "overlap_inflight": (self._overlap.outstanding
+                                     if self._overlap is not None
+                                     else 0),
                 "epoch": c.epoch}
 
     def _cache_stats_line(self) -> str:
@@ -1743,7 +2145,8 @@ class Runtime:
                 f"({s['hit_rate']:.1%} hit rate), "
                 f"{s['cached_cycles']} fully cached cycles "
                 f"({s['spec_cycles']} fused single-round, "
-                f"{s['native_steady_cycles']} native zero-copy), "
+                f"{s['native_steady_cycles']} native zero-copy, "
+                f"{s['overlap_cycles']} overlapped), "
                 f"{s['entries']}/{s['capacity']} slots")
 
     def _check_stall(self, table: MessageTable, size: int) -> None:
@@ -1859,6 +2262,8 @@ class Runtime:
                 self.parameter_manager.cycle_time_ms()
             resp_list.tuned_fusion_threshold_bytes = \
                 self.parameter_manager.fusion_threshold_bytes()
+            resp_list.tuned_overlap_buckets = \
+                self.parameter_manager.tuned_overlap_buckets
         elif self._cache is not None:
             # Cached-cycle replay re-fuses granted slots on every rank
             # with this threshold; broadcast the coordinator's value

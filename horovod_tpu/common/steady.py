@@ -52,16 +52,25 @@ class SteadyPlan:
                  "seg_np_dtypes", "seg_nbytes", "seg_counts",
                  "seg_codes", "seg_src_dtypes", "prefix", "seg_hdrs",
                  "payload_nbytes", "arena", "send_views",
-                 "stage_views", "native_ok", "cache")
+                 "stage_views", "native_ok", "cache", "chunk_bytes",
+                 "chunked")
 
     def __init__(self, epoch: int, nslots: int, mask: int,
-                 segments, arena: FusionArena):
+                 segments, arena: FusionArena, chunk_bytes: int = 0):
         """``segments``: [(DataType, np_dtype, nbytes, src_np_dtype),
         ...] in replay-plan order, where ``np_dtype``/``nbytes``
         describe the ON-WIRE representation and ``src_np_dtype`` names
         the tensors' real dtype when a negotiated wire dtype
         compresses this segment (None = uncompressed; a legacy
-        3-tuple means the same)."""
+        3-tuple means the same).
+
+        ``chunk_bytes`` > 0 arms chunked pipelined transfer on the
+        worker half (HOROVOD_OVERLAP_CHUNK_BYTES): pack leaves
+        compressed segments in their full-precision staging views and
+        ``hvd_steady_worker_chunked`` casts them chunk-by-chunk
+        interleaved with the send — compression of chunk i+1 overlaps
+        the kernel-buffered transmission of chunk i. Wire bytes are
+        identical either way."""
         self.epoch = epoch
         self.nslots = nslots
         self.mask = mask
@@ -118,6 +127,33 @@ class SteadyPlan:
                 stages.append(arena.typed(soff, src, count))
                 soff += count * src.itemsize
         self.stage_views = stages
+        # Chunked pipelined transfer engages only when a segment
+        # actually compresses (src dtype present), the knob is armed,
+        # and the native library exports the chunked entry point —
+        # every other combination keeps the classic one-shot send.
+        self.chunk_bytes = int(chunk_bytes)
+        self.chunked = False
+        if self.chunk_bytes > 0 and any(
+                s is not None for s in self.seg_src_dtypes):
+            lib = _native.get()
+
+            def _castable(src, wire_code):
+                # hvd_cast only speaks f32 <-> bf16/f16 (codes 0 <->
+                # 6/5); any other pair (e.g. float64 sources) must
+                # keep the Python cast + classic one-shot send, or
+                # the chunk loop would -EINVAL mid-frame and abort a
+                # healthy world.
+                if src is None:
+                    return True
+                return (_native._DTYPE_CODES.get(str(src)) == 0
+                        and wire_code in (5, 6))
+
+            self.chunked = (lib is not None
+                            and hasattr(lib, "hvd_steady_worker_chunked")
+                            and all(
+                                _castable(s, c) for s, c in
+                                zip(self.seg_src_dtypes,
+                                    self.seg_codes)))
         # Role-specific ctypes bundles attached by the controllers;
         # dies with the plan (plans are epoch-memoized in the runtime).
         self.cache: Dict = {}
@@ -154,7 +190,10 @@ class SteadyPlan:
                 continue
             # Compressed segment: concat + prescale in the tensors'
             # real dtype (staging), one cast into the wire view — the
-            # native hvd_cast kernel when it speaks the pair.
+            # native hvd_cast kernel when it speaks the pair. With the
+            # chunked worker armed the cast is DEFERRED: the native
+            # send loop casts chunk-by-chunk interleaved with the
+            # wire (frame_bytes materializes it for fallback paths).
             stage = self.stage_views[j] if use_arena \
                 else np.empty(self.seg_counts[j], src_dt)
             concat_into(flats, stage)
@@ -163,14 +202,27 @@ class SteadyPlan:
                 np.multiply(stage, np.asarray(f, src_dt), out=stage)
             dst = self.send_views[j] if use_arena \
                 else np.empty(self.seg_counts[j], npdt)
-            _wd.cast_into(stage, dst)
+            if not (self.chunked and use_arena):
+                _wd.cast_into(stage, dst)
             bufs.append(dst)
         return bufs
+
+    def materialize_wire(self) -> None:
+        """Deferred-cast fallback: fill the wire views from staging —
+        exactly the bytes the chunked native send would have produced
+        (one cast pass; chunking never changes wire bytes)."""
+        from horovod_tpu.common import wire_dtype as _wd
+        for j, src in enumerate(self.seg_src_dtypes):
+            if src is not None:
+                _wd.cast_into(self.stage_views[j], self.send_views[j])
 
     def frame_bytes(self, bufs: List[np.ndarray]) -> bytes:
         """Serialize a full CACHED_SPEC frame from packed buffers —
         byte-identical to wire.serialize_cycle_request. Fallback paths
         only (the native path never materializes the frame)."""
+        if self.chunked and any(b is v for b, v in
+                                zip(bufs, self.send_views)):
+            self.materialize_wire()
         parts = [self.prefix]
         for h, b in zip(self.seg_hdrs, bufs):
             parts.append(h)
@@ -258,13 +310,44 @@ def run_worker_cycle(lib, plan: SteadyPlan, fd: int, secret: bytes,
     dev_buf = _u8p()
     dev_len = ctypes.c_int64()
     dev_tag = ctypes.c_uint8()
-    rc = lib.hvd_steady_worker(
-        fd, req_tag, resp_tag, c["prefix"], len(plan.prefix),
-        c["hdr_ptrs"], c["hdr_lens"], send_ptrs, recv_ptrs,
-        c["seg_lens"], plan.nseg, b["secret"], len(secret),
-        b["skip"], b["nskip"], timeout_ms, interval_ms,
-        ctypes.byref(dev_buf), ctypes.byref(dev_len),
-        ctypes.byref(dev_tag))
+    if plan.chunked and send_ptrs is b["send_ptrs"]:
+        # Chunked pipelined send: staging holds the full-precision
+        # bytes; the C loop casts wire chunks interleaved with the
+        # send (one fused cast+HMAC pass when frame auth is armed).
+        ch = plan.cache.get("chunked")
+        if ch is None:
+            ch = {
+                "stage_ptrs": (ctypes.c_void_p * plan.nseg)(*[
+                    0 if v is None else v.ctypes.data
+                    for v in plan.stage_views]),
+                "stage_codes": (ctypes.c_int * plan.nseg)(*[
+                    -1 if s is None
+                    else _native._DTYPE_CODES[str(s)]
+                    for s in plan.seg_src_dtypes]),
+            }
+            plan.cache["chunked"] = ch
+        rc = lib.hvd_steady_worker_chunked(
+            fd, req_tag, resp_tag, c["prefix"], len(plan.prefix),
+            c["hdr_ptrs"], c["hdr_lens"], send_ptrs,
+            ch["stage_ptrs"], ch["stage_codes"],
+            plan.chunk_bytes, recv_ptrs,
+            c["seg_lens"], c["seg_codes"], plan.nseg,
+            b["secret"], len(secret),
+            b["skip"], b["nskip"], timeout_ms, interval_ms,
+            ctypes.byref(dev_buf), ctypes.byref(dev_len),
+            ctypes.byref(dev_tag))
+    else:
+        if plan.chunked:
+            # Defensive repack outside the arena: the deferred cast
+            # never ran — materialize the wire views it would target.
+            plan.materialize_wire()
+        rc = lib.hvd_steady_worker(
+            fd, req_tag, resp_tag, c["prefix"], len(plan.prefix),
+            c["hdr_ptrs"], c["hdr_lens"], send_ptrs, recv_ptrs,
+            c["seg_lens"], plan.nseg, b["secret"], len(secret),
+            b["skip"], b["nskip"], timeout_ms, interval_ms,
+            ctypes.byref(dev_buf), ctypes.byref(dev_len),
+            ctypes.byref(dev_tag))
     if rc == 0:
         return DONE, plan.result_segments(result)
     if rc == 1:

@@ -32,7 +32,8 @@ Layout (all little-endian):
                 | u32 ndev | i32 devices[ndev]
                 | u32 nsz  | i64 tensor_sizes[nsz]
   ResponseList := u8 shutdown | f64 tuned_cycle_time_ms
-                | i64 tuned_fusion_threshold_bytes | u32 n | Response[n]
+                | i64 tuned_fusion_threshold_bytes
+                | i64 tuned_overlap_buckets | u32 n | Response[n]
 """
 
 from __future__ import annotations
@@ -265,6 +266,7 @@ def serialize_response_list(rl: ResponseList) -> bytes:
     w.u8(1 if rl.shutdown else 0)
     w.f64(rl.tuned_cycle_time_ms)
     w.i64(rl.tuned_fusion_threshold_bytes)
+    w.i64(rl.tuned_overlap_buckets)
     w.u32(len(rl.responses))
     for resp in rl.responses:
         _write_response(w, resp)
@@ -277,10 +279,12 @@ def parse_response_list(data: bytes,
     shutdown = bool(r.u8())
     tuned_cycle = r.f64()
     tuned_fusion = r.i64()
+    tuned_overlap = r.i64()
     n = r.u32()
     return ResponseList([_read_response(r) for _ in range(n)], shutdown,
                         tuned_cycle_time_ms=tuned_cycle,
-                        tuned_fusion_threshold_bytes=tuned_fusion)
+                        tuned_fusion_threshold_bytes=tuned_fusion,
+                        tuned_overlap_buckets=tuned_overlap)
 
 
 # ---------------------------------------------------------------------------
@@ -488,6 +492,7 @@ def serialize_cycle_response(obj) -> bytes:
     w.u8(1 if rl.shutdown else 0)
     w.f64(rl.tuned_cycle_time_ms)
     w.i64(rl.tuned_fusion_threshold_bytes)
+    w.i64(rl.tuned_overlap_buckets)
     w.u32(len(rl.responses))
     for resp in rl.responses:
         _write_response(w, resp)
@@ -518,10 +523,12 @@ def parse_cycle_response(data: bytes):
     shutdown = bool(r.u8())
     tuned_cycle = r.f64()
     tuned_fusion = r.i64()
+    tuned_overlap = r.i64()
     n = r.u32()
     rl = ResponseList([_read_response(r) for _ in range(n)], shutdown,
                       tuned_cycle_time_ms=tuned_cycle,
-                      tuned_fusion_threshold_bytes=tuned_fusion)
+                      tuned_fusion_threshold_bytes=tuned_fusion,
+                      tuned_overlap_buckets=tuned_overlap)
     return CacheCycleResponse(epoch=epoch, nslots=nslots,
                               grant_mask=grant, invalid_mask=invalid,
                               response_list=rl)

@@ -95,12 +95,24 @@ def DistributedOptimizer(tx, op: int = _spmd.Average,
 def allreduce_gradients(grads, op: int = Average,
                         compression=Compression.none):
     """Synchronously average a host-side gradient pytree through the
-    background runtime (negotiation + fusion + timeline). One async
-    enqueue per leaf, then a drain — the reference's hook-then-
-    synchronize flow (reference: horovod/torch/__init__.py:95-147)."""
+    background runtime (negotiation + fusion + timeline) — the
+    reference's hook-then-synchronize flow
+    (reference: horovod/torch/__init__.py:95-147).
+
+    The uncompressed path submits the leaves as ONE grouped
+    allreduce, which the overlap tier (HOROVOD_OVERLAP_BUCKETS /
+    HOROVOD_OVERLAP_BYTES, docs/performance.md Layer 5) splits into
+    ready-order buckets: jax gradient leaves are futures, so early
+    buckets negotiate and ride the wire while backward compute for
+    later leaves is still running, and the tail ``synchronize`` drain
+    only ever blocks on the last bucket."""
     import jax
 
     leaves, treedef = jax.tree_util.tree_flatten(grads)
+    if compression is Compression.none:
+        handles = grouped_allreduce_async(leaves, name="grad", op=op)
+        outs = [synchronize(h) for h in handles]
+        return jax.tree_util.tree_unflatten(treedef, outs)
     handles = []
     for i, g in enumerate(leaves):
         comp, ctx = compression.compress(g)

@@ -163,6 +163,25 @@ def _configure(lib: ctypes.CDLL) -> None:
         u8p, ctypes.c_int,
         ctypes.c_int, ctypes.c_int,
         u8pp, i64p, u8p]
+    try:
+        # Stale-.so tolerance (see get()): a pre-overlap library lacks
+        # the chunked entry; SteadyPlan.chunked then stays False and
+        # the classic one-shot worker carries the cycle.
+        lib.hvd_steady_worker_chunked.restype = ctypes.c_int
+        lib.hvd_steady_worker_chunked.argtypes = [
+            ctypes.c_int, ctypes.c_uint8, ctypes.c_uint8,
+            u8p, ctypes.c_int64,
+            u8pp, i64p,
+            vpp, vpp,
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int64,
+            vpp,
+            i64p, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+            u8p, ctypes.c_int,
+            u8p, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int,
+            u8pp, i64p, u8p]
+    except AttributeError:
+        pass
     lib.hvd_steady_coord.restype = ctypes.c_int
     lib.hvd_steady_coord.argtypes = [
         ctypes.POINTER(ctypes.c_int), ctypes.c_int,

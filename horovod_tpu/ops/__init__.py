@@ -207,6 +207,7 @@ def grouped_allreduce_async(tensors, average: Optional[bool] = None,
     check_scale = (resolved_op == Average or prescale_factor != 1.0
                    or postscale_factor != 1.0)
     inspected = []
+    nbytes_list = []
     for t in tensors:
         # Unsupported payloads AND unsupported dtypes must raise before
         # any enqueue — numpy_dtype_to_datatype is what the enqueue
@@ -217,6 +218,10 @@ def grouped_allreduce_async(tensors, average: Optional[bool] = None,
             _check_scalable_dtype(t, resolved_op, prescale_factor,
                                   postscale_factor, "grouped_allreduce")
         inspected.append((payload, ctx, device, dtype, shape, ready_fn))
+        numel = 1
+        for d in shape:
+            numel *= int(d)
+        nbytes_list.append(numel * np_dtype.itemsize)
 
     rt = basics.runtime()
     mark_done = rt.handle_manager.mark_done
@@ -235,12 +240,46 @@ def grouped_allreduce_async(tensors, average: Optional[bool] = None,
         entry.callback = callback
         items.append((entry, dtype, shape))
 
-    status = rt.enqueue_group(RequestType.ALLREDUCE, items,
-                              prescale_factor, post)
-    if not status.ok():
-        # Nothing was inserted (all-or-nothing): fail every handle.
-        for h in handles:
-            rt.handle_manager.mark_done(h, status, None)
+    # Overlap tier (HOROVOD_OVERLAP_BUCKETS/_BYTES, docs/performance.md
+    # Layer 5): split the group into size-balanced CONTIGUOUS buckets,
+    # each enqueued as its OWN atomic negotiation batch — early buckets
+    # negotiate and reduce while the caller's later gradients are still
+    # materializing (jax leaves are futures: the data plane's
+    # np.asarray blocks per bucket, so dispatch follows readiness).
+    # Tensor names are identical either way, so bucketing never changes
+    # numerics — only the fused-batch boundaries.
+    bucket_ends = rt.overlap_bucket_plan(nbytes_list)
+    if bucket_ends is None:
+        # With the overlap runner armed, every grouped call is itself
+        # a dispatch unit: record its name set so the background loop
+        # peels multi-group pops at group boundaries and each group
+        # rides its own in-flight cycle (callers doing their own
+        # ready-order bucketing get pipelining without the splitter).
+        rt.note_bucket_names(
+            entry.tensor_name for entry, _d, _s in items)
+        status = rt.enqueue_group(RequestType.ALLREDUCE, items,
+                                  prescale_factor, post)
+        if not status.ok():
+            # Nothing was inserted (all-or-nothing): fail every handle.
+            for h in handles:
+                rt.handle_manager.mark_done(h, status, None)
+        return handles
+    rt.note_overlap_buckets(len(bucket_ends))
+    start = 0
+    for end in bucket_ends:
+        rt.note_bucket_names(
+            entry.tensor_name for entry, _d, _s in items[start:end])
+        status = rt.enqueue_group(RequestType.ALLREDUCE,
+                                  items[start:end],
+                                  prescale_factor, post)
+        if not status.ok():
+            # All-or-nothing PER BUCKET: earlier buckets are already
+            # in flight (peers expect them); fail this bucket's
+            # handles and keep submitting the rest so the world stays
+            # in lockstep on every other bucket.
+            for h in handles[start:end]:
+                rt.handle_manager.mark_done(h, status, None)
+        start = end
     return handles
 
 

@@ -62,6 +62,14 @@ def ragged_psum_wins(sizes, slice_numels, world_size: int) -> bool:
         padded_elems += world_size * m * sn
         # psum buffer: true rows + one max-block of overlap slack
         psum_elems += (sum(rows) + m) * sn
+    if psum_elems > np.iinfo(np.int32).max:
+        # The psum rendering scatters blocks at element offsets that
+        # must index its assembled buffer; past int32 range a
+        # 32-bit offset (jax canonicalizes int64 down without
+        # jax_enable_x64) would silently wrap and corrupt the
+        # output — the padded all_gather has no such offsets, so it
+        # carries oversized buffers regardless of skew.
+        return False
     return 2 * psum_elems < padded_elems
 
 
@@ -460,7 +468,11 @@ class XlaMeshBackend(CollectiveBackend):
             rank_offsets.append(offs)
             total += (acc + m) * sn   # true rows + overlap slack
         flat = (jnp.concatenate(flats) if len(flats) > 1 else flats[0])
-        offs_const = np.asarray(rank_offsets, np.int32)  # [E, size]
+        # int64: ragged_psum_wins guarantees the total fits int32, but
+        # the OFFSET arithmetic above (cumulative products) must never
+        # wrap while computing it; with jax_enable_x64 the wide dtype
+        # survives into the scatter as well.
+        offs_const = np.asarray(rank_offsets, np.int64)  # [E, size]
         block_lens = [m * sn for m, sn in zip(max_dim0s, slice_numels)]
 
         def body(x):

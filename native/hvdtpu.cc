@@ -850,6 +850,150 @@ int hvd_steady_worker(int fd, uint8_t req_tag, uint8_t resp_tag,
   }
 }
 
+// dtype-code itemsize (codes as hvd_sum_into/hvd_cast).
+static int64_t code_itemsize(int code) {
+  switch (code) {
+    case 0: return 4;   // f32
+    case 1: return 8;   // f64
+    case 2: return 4;   // i32
+    case 3: return 8;   // i64
+    case 4: return 1;   // u8
+    case 5: return 2;   // f16
+    case 6: return 2;   // bf16
+    default: return 0;
+  }
+}
+
+int hvd_steady_worker_chunked(int fd, uint8_t req_tag, uint8_t resp_tag,
+                              const uint8_t* prefix, int64_t prefix_len,
+                              const uint8_t* const* seg_hdrs,
+                              const int64_t* seg_hdr_lens,
+                              const void* const* send_ptrs,
+                              const void* const* stage_ptrs,
+                              const int* stage_codes,
+                              int64_t chunk_bytes,
+                              void* const* recv_ptrs,
+                              const int64_t* seg_lens,
+                              const int* wire_codes, int nseg,
+                              const uint8_t* secret, int secret_len,
+                              const uint8_t* skip_tags, int nskip,
+                              int timeout_ms, int interval_ms,
+                              uint8_t** dev_buf, int64_t* dev_len,
+                              uint8_t* dev_tag) {
+  if (chunk_bytes <= 0) chunk_bytes = 1 << 20;
+  int64_t total = prefix_len;
+  for (int j = 0; j < nseg; j++) total += seg_hdr_lens[j] + seg_lens[j];
+  if (uint64_t(total) > 0xffffffffull) return -EMSGSIZE;
+  uint8_t hdr[5];
+  uint32_t n32 = uint32_t(total);
+  memcpy(hdr, &n32, 4);  // little-endian hosts only (x86/arm64)
+  hdr[4] = req_tag;
+  int rc;
+  if (secret_len > 0) {
+    // The digest precedes the payload on the wire, so a cast-during-
+    // send cannot start until the HMAC over the CAST bytes is known:
+    // fuse the cast and HMAC into ONE cache-warm pass per chunk, then
+    // ship the whole frame with a single vectored send.
+    Hmac h(secret, size_t(secret_len));
+    h.update(&req_tag, 1);
+    if (prefix_len) h.update(prefix, size_t(prefix_len));
+    for (int j = 0; j < nseg; j++) {
+      if (seg_hdr_lens[j]) h.update(seg_hdrs[j], size_t(seg_hdr_lens[j]));
+      if (!seg_lens[j]) continue;
+      if (stage_ptrs[j] == nullptr || stage_codes[j] < 0) {
+        h.update(send_ptrs[j], size_t(seg_lens[j]));
+        continue;
+      }
+      int64_t wisz = code_itemsize(wire_codes[j]);
+      int64_t sisz = code_itemsize(stage_codes[j]);
+      if (!wisz || !sisz) return -EINVAL;
+      int64_t count = seg_lens[j] / wisz;
+      int64_t step = chunk_bytes / wisz;
+      if (step < 1) step = 1;
+      for (int64_t done = 0; done < count; done += step) {
+        int64_t c = count - done < step ? count - done : step;
+        rc = hvd_cast(
+            static_cast<const char*>(stage_ptrs[j]) + done * sisz,
+            const_cast<char*>(
+                static_cast<const char*>(send_ptrs[j])) + done * wisz,
+            c, stage_codes[j], wire_codes[j]);
+        if (rc) return rc;
+        h.update(static_cast<const char*>(send_ptrs[j]) + done * wisz,
+                 size_t(c * wisz));
+      }
+    }
+    uint8_t digest[32];
+    h.final(digest);
+    std::vector<struct iovec> iov;
+    iov.reserve(size_t(2 * nseg) + 3);
+    iov.push_back({hdr, 5});
+    iov.push_back({digest, 32});
+    if (prefix_len)
+      iov.push_back({const_cast<uint8_t*>(prefix), size_t(prefix_len)});
+    for (int j = 0; j < nseg; j++) {
+      if (seg_hdr_lens[j])
+        iov.push_back({const_cast<uint8_t*>(seg_hdrs[j]),
+                       size_t(seg_hdr_lens[j])});
+      if (seg_lens[j])
+        iov.push_back({const_cast<void*>(send_ptrs[j]),
+                       size_t(seg_lens[j])});
+    }
+    rc = sendv_all(fd, iov.data(), int(iov.size()));
+    if (rc) return rc;
+  } else {
+    // No frame auth: true pipelining — cast chunk i+1 while the
+    // kernel transmits chunk i (sendmsg returns once the bytes are
+    // socket-buffered; the NIC drains asynchronously).
+    rc = write_all(fd, hdr, 5);
+    if (rc) return rc;
+    if (prefix_len) {
+      rc = write_all(fd, prefix, size_t(prefix_len));
+      if (rc) return rc;
+    }
+    for (int j = 0; j < nseg; j++) {
+      if (seg_hdr_lens[j]) {
+        rc = write_all(fd, seg_hdrs[j], size_t(seg_hdr_lens[j]));
+        if (rc) return rc;
+      }
+      if (!seg_lens[j]) continue;
+      if (stage_ptrs[j] == nullptr || stage_codes[j] < 0) {
+        rc = write_all(fd, static_cast<const uint8_t*>(send_ptrs[j]),
+                       size_t(seg_lens[j]));
+        if (rc) return rc;
+        continue;
+      }
+      int64_t wisz = code_itemsize(wire_codes[j]);
+      int64_t sisz = code_itemsize(stage_codes[j]);
+      if (!wisz || !sisz) return -EINVAL;
+      int64_t count = seg_lens[j] / wisz;
+      int64_t step = chunk_bytes / wisz;
+      if (step < 1) step = 1;
+      for (int64_t done = 0; done < count; done += step) {
+        int64_t c = count - done < step ? count - done : step;
+        char* dst = const_cast<char*>(
+            static_cast<const char*>(send_ptrs[j])) + done * wisz;
+        rc = hvd_cast(
+            static_cast<const char*>(stage_ptrs[j]) + done * sisz,
+            dst, c, stage_codes[j], wire_codes[j]);
+        if (rc) return rc;
+        rc = write_all(fd, reinterpret_cast<const uint8_t*>(dst),
+                       size_t(c * wisz));
+        if (rc) return rc;
+      }
+    }
+  }
+  // Receive half: identical to hvd_steady_worker.
+  Deadline dl{timeout_ms, interval_ms > 0 ? interval_ms : 1, nullptr};
+  while (true) {
+    rc = recv_expected(fd, resp_tag, prefix, prefix_len, seg_hdrs,
+                       seg_hdr_lens, recv_ptrs, seg_lens, nseg,
+                       secret, secret_len, skip_tags, nskip, &dl,
+                       dev_buf, dev_len, dev_tag);
+    if (rc == RX_SKIP) continue;
+    return rc;  // RX_MATCH (0), RX_DEV (1) or negative errno
+  }
+}
+
 int hvd_steady_coord(const int* fds, int n, uint8_t req_tag,
                      uint8_t resp_tag,
                      const uint8_t* prefix, int64_t prefix_len,

@@ -99,6 +99,34 @@ int hvd_steady_worker(int fd, uint8_t req_tag, uint8_t resp_tag,
                       uint8_t** dev_buf, int64_t* dev_len,
                       uint8_t* dev_tag);
 
+// Chunked-pipelined worker half (the overlap tier's transfer stage,
+// HOROVOD_OVERLAP_CHUNK_BYTES): same frame, same wire bytes, but
+// compressed segments are cast from their full-precision staging
+// buffers chunk-by-chunk interleaved with the send — compression of
+// chunk i+1 overlaps the kernel-buffered transmission of chunk i
+// (with frame auth armed the cast and HMAC fuse into one cache-warm
+// pass and the frame then goes out in one vectored send, since the
+// digest must precede the payload). stage_ptrs[j] == NULL means
+// segment j is pre-cast in send_ptrs[j] (stage_codes[j] = -1);
+// wire_codes give each segment's on-wire dtype (hvd_cast codes).
+// Receive half and return contract identical to hvd_steady_worker.
+int hvd_steady_worker_chunked(int fd, uint8_t req_tag, uint8_t resp_tag,
+                              const uint8_t* prefix, int64_t prefix_len,
+                              const uint8_t* const* seg_hdrs,
+                              const int64_t* seg_hdr_lens,
+                              const void* const* send_ptrs,
+                              const void* const* stage_ptrs,
+                              const int* stage_codes,
+                              int64_t chunk_bytes,
+                              void* const* recv_ptrs,
+                              const int64_t* seg_lens,
+                              const int* wire_codes, int nseg,
+                              const uint8_t* secret, int secret_len,
+                              const uint8_t* skip_tags, int nskip,
+                              int timeout_ms, int interval_ms,
+                              uint8_t** dev_buf, int64_t* dev_len,
+                              uint8_t* dev_tag);
+
 // Coordinator half: poll-gather one speculative frame per peer
 // (payload must match prefix/seg_hdrs byte-for-byte; segment data
 // lands in peer_seg_ptrs[i*nseg + j]), reduce every peer's segments

@@ -878,6 +878,179 @@ def scenario_native_hetero(hvd, rank, size):
     _assert_cache_coherent(hvd, rank, size, "nh.fp")
 
 
+def scenario_overlap_steady(hvd, rank, size):
+    """Overlap tier end to end (HOROVOD_OVERLAP_* armed by the pytest
+    wrapper): a bucketed grouped-allreduce training loop must
+    (a) return exact sums every step, (b) split each step into
+    multiple buckets (hvd_overlap_buckets_total advancing) that each
+    learn their own steady mask, (c) complete steady cycles through
+    the in-flight overlap runner (overlap_cycles advancing), and
+    (d) preserve the zero-copy property: hvd_data_copies_total does
+    not move once steady. With HOROVOD_COMPRESSION=bf16 the values
+    here are small integers (exactly representable), so compression
+    (and the chunked native send with a small
+    HOROVOD_OVERLAP_CHUNK_BYTES) keeps the asserts exact."""
+    from horovod_tpu import native as _nat
+    from horovod_tpu.common import basics as _b
+
+    ssum = sum(range(1, size + 1))
+    xs = [np.full(192 + 16 * i, float(rank + 1) * (i + 1), np.float32)
+          for i in range(16)]
+
+    def step():
+        hs = hvd.grouped_allreduce_async(xs, average=False, name="ov")
+        return [np.asarray(hvd.synchronize(h)) for h in hs]
+
+    for _ in range(8):
+        step()  # warmup: every bucket learns its steady mask
+    hvd.barrier(name="ov.bar")
+    s0 = _cache_runtime_stats(hvd)
+    c0 = hvd.metrics()["local"].get("hvd_data_copies_total",
+                                    {"v": 0.0})["v"]
+    for it in range(25):
+        res = step()
+        for i, r in enumerate(res):
+            np.testing.assert_allclose(r, ssum * (i + 1.0))
+    s1 = _cache_runtime_stats(hvd)
+    c1 = hvd.metrics()["local"].get("hvd_data_copies_total",
+                                    {"v": 0.0})["v"]
+    rt = _b.runtime()
+    k = int(os.environ.get("HOROVOD_OVERLAP_BUCKETS", "0"))
+    if k > 1:
+        # bucketed dispatch engaged: the submission really split
+        m = hvd.metrics()["local"]
+        assert m.get("hvd_overlap_buckets_total",
+                     {"v": 0.0})["v"] > 0, m
+        # each bucket holds its own steady mask
+        assert len(rt._steady) >= 2, (rank, len(rt._steady))
+    native_on = (_nat.get() is not None
+                 and os.environ.get("HOROVOD_TPU_ZERO_COPY", "1")
+                 != "0")
+    if native_on and int(os.environ.get(
+            "HOROVOD_OVERLAP_INFLIGHT", "0")) > 0:
+        # in-flight cycles engaged and zero-copy preserved
+        assert s1["overlap_cycles"] > s0["overlap_cycles"], (
+            rank, s0, s1)
+        assert c1 - c0 == 0, (rank, c0, c1)
+    assert s1["cached_cycles"] > s0["cached_cycles"] \
+        or s1["spec_cycles"] > s0["spec_cycles"], (rank, s0, s1)
+    _assert_cache_coherent(hvd, rank, size, "ov.fp")
+
+
+def scenario_overlap_bitexact(hvd, rank, size):
+    """Bucketed training must be BIT-exact vs an unbucketed replay:
+    run the same deterministic step stream twice in one world — first
+    with the wrapper-armed bucket knobs, then with bucketing turned
+    off on every rank at the same point — and require bitwise-equal
+    outputs. Values are rounding-sensitive f32 fractions, so any
+    reduction-order change WOULD show: bucketing only moves fused
+    batch boundaries, never the per-element rank-ascending sum."""
+    from horovod_tpu.common import basics as _b
+
+    xs = [np.full(128 + 8 * i, 0.1 * (rank + 1) * (i + 1), np.float32)
+          for i in range(12)]
+
+    def phase(tag, steps=10):
+        outs = None
+        for _ in range(steps):
+            hs = hvd.grouped_allreduce_async(xs, average=False,
+                                             name=f"bx.{tag}")
+            outs = [np.asarray(hvd.synchronize(h)) for h in hs]
+        return outs
+
+    a = phase("bucketed")
+    hvd.barrier(name="bx.bar")
+    # Same point on every rank: later submissions stop bucketing.
+    _b.runtime().config.overlap_buckets = 0
+    _b.runtime().config.overlap_bucket_bytes = 0
+    b = phase("flat")
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    _assert_cache_coherent(hvd, rank, size, "bx.fp")
+
+
+def scenario_overlap_hetero(hvd, rank, size):
+    """Heterogeneous HOROVOD_OVERLAP_* knobs (set per-rank by the
+    pytest wrapper): ranks disagree on bucket count and in-flight
+    depth, so per-cycle hit masks differ — grants degrade to the
+    intersection, speculation backs off where peers answer
+    classically, and the world must stay EXACT and cache-coherent
+    (degrade-to-synchronous, never diverge)."""
+    ssum = sum(range(1, size + 1))
+    xs = [np.full(160 + 8 * i, float(rank + 1) * (i + 1), np.float32)
+          for i in range(12)]
+    for _ in range(20):
+        hs = hvd.grouped_allreduce_async(xs, average=False, name="oh")
+        res = [np.asarray(hvd.synchronize(h)) for h in hs]
+        for i, r in enumerate(res):
+            np.testing.assert_allclose(r, ssum * (i + 1.0))
+    _assert_cache_coherent(hvd, rank, size, "oh.fp")
+
+
+def scenario_overlap_sigkill(hvd, rank, size):
+    """SIGKILL a rank while buckets are IN FLIGHT on the overlap
+    runner (fault spec fires at an op index deep in bucketed steady
+    state): survivors must raise WorldAbortedError naming the dead
+    rank within the heartbeat deadline — the PR 2 fail-fast invariant
+    holds when the native cycle runs on the completion thread."""
+    import time
+    from horovod_tpu.common.status import WorldAbortedError
+
+    victim = 1
+    deadline = float(os.environ["HOROVOD_HEARTBEAT_TIMEOUT"]) + 12.0
+    xs = [np.full(128 + 8 * i, float(rank + 1), np.float32)
+          for i in range(16)]
+    t0 = time.monotonic()
+    aborted = None
+    while True:
+        try:
+            hs = hvd.grouped_allreduce_async(xs, average=False,
+                                             name="ok.steady")
+            for h in hs:
+                hvd.synchronize(h)
+        except WorldAbortedError as e:
+            aborted = e
+            break
+        assert time.monotonic() - t0 < deadline, (
+            f"rank {rank}: collectives kept succeeding {deadline}s "
+            f"after the fault")
+    assert aborted.origin_rank == victim, (rank, str(aborted))
+    assert f"rank {victim}" in str(aborted), str(aborted)
+    assert time.monotonic() - t0 < deadline
+    stats = _cache_runtime_stats(hvd)
+    assert stats["cached_cycles"] >= 5 or stats["spec_cycles"] >= 5, \
+        stats
+    hvd.shutdown()
+
+
+def scenario_overlap_sever(hvd, rank, size):
+    """Severed control link mid-overlapped-cycle: rank 1's upward
+    channel closes while the overlap runner drives native cycles;
+    survivors must abort with a structured WorldAbortedError within
+    the deadline (the runner's parked transport error feeds the same
+    world-convergent blame path as the synchronous one)."""
+    import time
+    from horovod_tpu.common.status import WorldAbortedError
+
+    deadline = float(os.environ["HOROVOD_HEARTBEAT_TIMEOUT"]) + 12.0
+    xs = [np.full(128, float(rank + 1), np.float32) for _ in range(8)]
+    t0 = time.monotonic()
+    while True:
+        try:
+            hs = hvd.grouped_allreduce_async(xs, average=False,
+                                             name="os.steady")
+            for h in hs:
+                hvd.synchronize(h)
+        except WorldAbortedError as e:
+            assert e.origin_rank >= -1, str(e)
+            break
+        assert time.monotonic() - t0 < deadline, (
+            f"rank {rank}: collectives kept succeeding {deadline}s "
+            f"after the sever")
+    assert time.monotonic() - t0 < deadline
+    hvd.shutdown()
+
+
 def scenario_abort_sigkill_native_steady(hvd, rank, size):
     """SIGKILL a rank squarely mid-NATIVE-steady-cycle (fault spec
     fires at an op index reached deep in zero-copy steady state, so

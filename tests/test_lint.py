@@ -466,7 +466,8 @@ def test_native_codec_real_tree_mirror():
         decls = parse_header(fh.read())
     # every entry point this PR leans on is visible to the analyzer
     for fn in ("hvd_sendv", "hvd_recv_into", "hvd_steady_worker",
-               "hvd_steady_coord", "hvd_sum_into"):
+               "hvd_steady_worker_chunked", "hvd_steady_coord",
+               "hvd_sum_into", "hvd_cast"):
         assert fn in decls, fn
     fs = lint_paths([os.path.join(REPO, "horovod_tpu")],
                     ["native-codec"])
@@ -626,6 +627,50 @@ def test_world_coherence_real_elastic_membership_is_anchored():
     ].decorators = set()
     fs = world_coherence.run(p)
     assert any("Membership" in f.message
+               and "world-replicated" in f.message for f in fs), fs
+
+
+# A rank-local mutation of an overlap in-flight cycle table — the
+# divergence class the overlap tier must never allow: one rank
+# reordering (or locally appending to) its submitted-cycle sequence
+# outside the world-identically-built submission path, which would
+# desynchronize the strictly-FIFO wire order peers rely on.
+BAD_OVERLAP_COHERENCE = """
+    class Runtime:
+        def __init__(self):
+            self._inflight_masks = []  # hvdlint: world-replicated
+
+        def requeue_priority(self, mask):
+            # rank-LOCAL reorder: jumps a cycle ahead of the FIFO
+            self._inflight_masks.insert(0, mask)
+"""
+
+
+def test_world_coherence_fires_on_local_overlap_mutation(tmp_path):
+    fs = _lint_snippet(tmp_path, BAD_OVERLAP_COHERENCE,
+                       "world-coherence")
+    msgs = "\n".join(f.message for f in fs)
+    assert "world-replicated" in msgs \
+        and "requeue_priority" in msgs, fs
+
+
+def test_world_coherence_real_overlap_inflight_is_anchored():
+    """The REAL overlap submit path must carry the @world_coherent
+    anchor — stripping it (and the drain-side mutators coverage could
+    flow through) fails the tree, proving the in-flight cycle
+    sequence only ever moves in the world-identical program order."""
+    from tools.hvdlint import world_coherence
+    p = Project([os.path.join(REPO, "horovod_tpu")])
+    qn = "horovod_tpu.common.runtime.Runtime._submit_overlap_cycle"
+    assert qn in p.index.functions, sorted(
+        k for k in p.index.functions if "overlap" in k)[:20]
+    for fn in ("_submit_overlap_cycle", "_apply_overlap_verdict",
+               "_unwind_cancelled_cycle", "_drop_inflight_mask"):
+        p.index.functions[
+            f"horovod_tpu.common.runtime.Runtime.{fn}"
+        ].decorators = set()
+    fs = world_coherence.run(p)
+    assert any("_inflight_masks" in f.message
                and "world-replicated" in f.message for f in fs), fs
 
 

@@ -777,6 +777,80 @@ def test_ring_data_plane_with_hier_controller():
             "HOROVOD_HOSTNAME": f"fakehost{rank // 2}"})
 
 
+# -- overlap tier (HOROVOD_OVERLAP_*: bucketed ready-order dispatch +
+# in-flight steady cycles + chunked pipelined transfer;
+# docs/performance.md Layer 5). Rank-local scheduling only — the wire
+# protocol is unchanged, so heterogeneous knobs must degrade to the
+# synchronous path instead of diverging.
+
+_OVERLAP_ENV = {
+    "HOROVOD_TPU_SHM": "0",
+    "HOROVOD_TPU_METRICS": "1",
+    "HOROVOD_OVERLAP_BUCKETS": "4",
+    "HOROVOD_OVERLAP_INFLIGHT": "2",
+}
+
+
+def test_overlap_steady_socket():
+    """Bucketed grouped allreduce at ws=4: exact sums, multiple
+    steady masks, overlap cycles advancing through the in-flight
+    runner, hvd_data_copies_total still zero once steady."""
+    run_scenario("overlap_steady", 4, timeout=120.0,
+                 extra_env=dict(_OVERLAP_ENV))
+
+
+def test_overlap_steady_compressed_chunked():
+    """Same loop under bf16 wire compression with a tiny chunk size:
+    every steady cycle rides hvd_steady_worker_chunked (cast
+    interleaved with the send) and the values — small integers,
+    exactly representable in bf16 — stay exact."""
+    run_scenario("overlap_steady", 4, timeout=120.0,
+                 extra_env=dict(_OVERLAP_ENV,
+                                HOROVOD_COMPRESSION="bf16",
+                                HOROVOD_OVERLAP_CHUNK_BYTES="512"))
+
+
+def test_overlap_bitexact_vs_flat():
+    """Bucketed ws=4 training is bit-exact vs an unbucketed replay of
+    the same step stream (rounding-sensitive f32 values)."""
+    run_scenario("overlap_bitexact", 4, timeout=120.0,
+                 extra_env=dict(_OVERLAP_ENV))
+
+
+def test_overlap_hetero_knobs_degrade():
+    """Ranks disagree on every overlap knob: bucket counts differ,
+    one rank runs fully synchronous — grants degrade to mask
+    intersections and results stay exact and cache-coherent."""
+    run_scenario(
+        "overlap_hetero", 4, timeout=120.0,
+        extra_env=dict(_OVERLAP_ENV),
+        per_rank_env=lambda rank: {
+            1: {"HOROVOD_OVERLAP_INFLIGHT": "0",
+                "HOROVOD_OVERLAP_BUCKETS": "0"},
+            2: {"HOROVOD_OVERLAP_BUCKETS": "2"},
+        }.get(rank, {}))
+
+
+def test_overlap_sigkill_mid_inflight():
+    """SIGKILL rank 1 deep in bucketed steady state — buckets are in
+    flight on the overlap runner when the victim dies. Survivors must
+    raise WorldAbortedError naming rank 1 within the deadline."""
+    run_scenario(
+        "overlap_sigkill", 3, timeout=60.0,
+        extra_env=dict(_OVERLAP_ENV, **_HB_ENV,
+                       HOROVOD_FAULT_SPEC="rank=1:kill:op=60"),
+        expect_rc={1: _SIGKILL_RC})
+
+
+def test_overlap_sever_mid_inflight():
+    """Severed control link while the overlap runner drives native
+    cycles: survivors converge on a structured world abort."""
+    run_scenario(
+        "overlap_sever", 3, timeout=60.0,
+        extra_env=dict(_OVERLAP_ENV, **_HB_ENV,
+                       HOROVOD_FAULT_SPEC="rank=1:sever:cycle=40"))
+
+
 # -- elastic worlds (HOROVOD_ELASTIC=1; survive preemption and -------
 # re-rendezvous instead of aborting — docs/fault_tolerance.md). The
 # victims die by fault injection; the SURVIVORS must re-form a smaller
@@ -803,6 +877,23 @@ def test_elastic_shrink_survives_sigkill(plane):
         extra["HOROVOD_TPU_SHM"] = "0"
     run_scenario("elastic_shrink", 4, timeout=120.0, extra_env=extra,
                  expect_rc={3: _SIGKILL_RC})
+
+
+def test_elastic_resize_mid_overlap():
+    """Elastic shrink with the overlap tier armed: the kill lands
+    while steady cycles run on the in-flight runner; teardown must
+    drain the runner cleanly (no wedged completion thread, no stale
+    plan replay) and the shrunk world keeps computing exact
+    collectives through a fresh runtime."""
+    run_scenario(
+        "elastic_shrink", 4, timeout=120.0,
+        extra_env=dict(_ELASTIC_ENV,
+                       HOROVOD_FAULT_SPEC="rank=3:kill:op=12",
+                       HOROVOD_TPU_METRICS="1",
+                       HOROVOD_TPU_SHM="0",
+                       HOROVOD_OVERLAP_INFLIGHT="2",
+                       HOROVOD_OVERLAP_BUCKETS="4"),
+        expect_rc={3: _SIGKILL_RC})
 
 
 def test_elastic_coordinator_death_reelects():
