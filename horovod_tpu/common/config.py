@@ -210,6 +210,22 @@ class Config:
     timeline_path: str = ""
     timeline_mark_cycles: bool = False
 
+    # World trace plane (TPU-native extension; docs/tracing.md).
+    # HOROVOD_TPU_TRACE=<path> arms clock-aligned cross-rank tracing:
+    # every rank batches its cycle/exec spans into TAG_TRACE frames
+    # that ride the control tree out-of-band like METRICS frames, and
+    # rank 0 writes ONE merged Chrome-trace file at <path> with a
+    # track per rank, timestamps corrected into the coordinator clock
+    # and the world cycle number on every span. Must be set on every
+    # rank (hvdtpurun --trace plumbs it). Empty disables — the
+    # disabled path installs only no-op collector hooks.
+    # (The flight recorder is separate and ON by default:
+    # HOROVOD_TPU_FLIGHT / _FLIGHT_EVENTS / _FLIGHT_DIR are read by
+    # common/trace.py at first use, deliberately not Config fields —
+    # the recorder must survive elastic re-inits, like lockdep.)
+    trace_path: str = ""
+    trace_interval_s: float = 1.0
+
     # Metrics plane (TPU-native extension; the reference has no live
     # observability at all — timeline/stall/autotune are post-hoc).
     # HOROVOD_TPU_METRICS=1 arms per-rank counters/gauges/histograms
@@ -371,6 +387,9 @@ class Config:
         c.timeline_path = os.environ.get("HOROVOD_TIMELINE", "")
         c.timeline_mark_cycles = _env_bool(
             "HOROVOD_TIMELINE_MARK_CYCLES", c.timeline_mark_cycles)
+        c.trace_path = os.environ.get("HOROVOD_TPU_TRACE", "")
+        c.trace_interval_s = _env_float(
+            "HOROVOD_TPU_TRACE_INTERVAL", c.trace_interval_s)
         c.metrics_enabled = _env_bool("HOROVOD_TPU_METRICS",
                                       c.metrics_enabled)
         c.metrics_interval_s = _env_float(

@@ -80,6 +80,9 @@ TAG_ABORT = 6       # world abort notice (heartbeat.encode_abort)
 TAG_METRICS = 7     # upward metrics snapshot (wire.*_metrics_frame) —
                     # out-of-band like PING: absorbed wherever a
                     # control frame is awaited, never negotiated
+TAG_TRACE = 8       # upward trace-span batch (wire.*_trace_frame,
+                    # common/trace.py) — out-of-band like METRICS;
+                    # carries the worker half of the clock-sync echo
 
 
 def _dead_peers(channels: Dict[int, "network.Channel"]) -> List[int]:
@@ -181,6 +184,13 @@ def _maybe_ping(ctl, channels: Dict[int, "network.Channel"],
         return
     ctl._last_ping = now
     ctl._ping_seq += 1
+    if sender_rank == 0:
+        # Clock-sync t1: the coordinator clock is the world's
+        # reference frame, so only rank 0's beacons are recorded
+        # (common/trace.py ClockSync; local-root beacons carry their
+        # own clocks and would poison the table).
+        from horovod_tpu.common import trace as htrace
+        htrace.clock().ping_sent(ctl._ping_seq, now)
     payload = heartbeat.encode_ping(sender_rank, ctl._ping_seq)
     for ch in channels.values():
         try:
@@ -316,13 +326,21 @@ class _NativeFanout:
     per-channel Python loops."""
 
     def __init__(self, lib, ctypes_mod, channels: Dict[int, "network.Channel"],
-                 secret: bytes, hb=None, on_metrics=None):
+                 secret: bytes, hb=None, on_metrics=None,
+                 on_trace=None):
         self._lib = lib
         self._ct = ctypes_mod
         # callable(rank, payload) fired when a TAG_METRICS frame
         # arrives in a gather slice (the sender stays pending — its
         # real cycle frame is still owed). None drops such frames.
         self._on_metrics = on_metrics
+        # Same contract for TAG_TRACE frames (common/trace.py).
+        self._on_trace = on_trace
+        # rank -> CLOCK_MONOTONIC completion stamp of its frame in
+        # the LAST gather (from the native arrive array) — read by
+        # the coordinator's straggler attribution right after
+        # gather() returns; reset per gather.
+        self.last_arrivals: Dict[int, float] = {}
         self.ranks = sorted(channels)
         fds = [channels[r].sock.fileno() for r in self.ranks]
         self._fd_list = fds
@@ -348,7 +366,8 @@ class _NativeFanout:
         self._hb = hb
 
     @classmethod
-    def create(cls, channels, secret: bytes, hb=None, on_metrics=None):
+    def create(cls, channels, secret: bytes, hb=None, on_metrics=None,
+               on_trace=None):
         if not channels:
             return None
         from horovod_tpu import native
@@ -357,7 +376,7 @@ class _NativeFanout:
             return None
         import ctypes
         return cls(lib, ctypes, channels, secret, hb=hb,
-                   on_metrics=on_metrics)
+                   on_metrics=on_metrics, on_trace=on_trace)
 
     def _as_u8(self, data):
         """bytes/buffer → ctypes u8 array at memcpy speed (never a
@@ -378,6 +397,7 @@ class _NativeFanout:
         ct = self._ct
         u8p = ct.POINTER(ct.c_uint8)
         out: Dict[int, bytes] = {}
+        self.last_arrivals = {}
         pending = list(range(len(self.ranks)))
         if self._hb is None:
             timeout_ms, deadline = -1, None
@@ -393,12 +413,13 @@ class _NativeFanout:
             bufs = (u8p * n)()
             lens = (ct.c_int64 * n)()
             tags = (ct.c_uint8 * n)()
+            arrive = (ct.c_double * n)()
             still: List[int] = []
             absorbed = False  # out-of-band frames harvested this slice
             try:
                 rc = self._lib.hvd_gather_frames(
                     fds, n, self._secret_buf, len(self._secret),
-                    bufs, lens, tags, timeout_ms)
+                    bufs, lens, tags, timeout_ms, arrive)
                 if rc in (-errno.EAGAIN, -errno.EWOULDBLOCK) \
                         and self._hb is not None:
                     # SO_RCVTIMEO (armed by Channel.arm on these same
@@ -445,11 +466,23 @@ class _NativeFanout:
                         absorbed = True
                         still.append(i)
                         continue
+                    if tags[j] == TAG_TRACE:
+                        # Same out-of-band contract as METRICS: absorb
+                        # (or drop, without a sink) and keep the
+                        # sender pending.
+                        if self._on_trace is not None:
+                            self._on_trace(r, ct.string_at(bufs[j],
+                                                           lens[j]))
+                        absorbed = True
+                        still.append(i)
+                        continue
                     if tags[j] != expect_tag:
                         raise ConnectionError(
                             f"expected tag {expect_tag} from rank {r}, "
                             f"got {tags[j]}")
                     out[r] = ct.string_at(bufs[j], lens[j])
+                    if arrive[j]:
+                        self.last_arrivals[r] = arrive[j]
             finally:
                 for j in range(n):
                     if bufs[j]:
@@ -576,6 +609,34 @@ class Controller:
     # WorldAggregator exists; frames arriving earlier are dropped
     # (best-effort totals — the next interval resends them).
     metrics_sink = None
+    # -- world trace plane (common/trace.py) -----------------------------
+    # Rank-0 sink for TRACE frames: callable(owner_rank, payload),
+    # set by the runtime once its WorldTraceWriter exists. TAG_TRACE
+    # frames are absorbed on EVERY recv path regardless (dropped
+    # without a sink) — a rank with tracing armed must never be able
+    # to kill a world whose coordinator has it off.
+    trace_sink = None
+    # True once attach_trace ran: workers then note coordinator PINGs
+    # for the clock-sync echo (an extra decode per rare ping).
+    _trace_on = False
+    # Rank-0 arrival hook: callable({rank: monotonic stamp}) fired
+    # per negotiation gather when the runtime armed straggler
+    # attribution (metrics or trace plane on). None keeps the
+    # disabled gather free of clock reads.
+    _on_arrivals = None
+
+    def attach_trace(self, on_arrivals=None) -> None:
+        """Arm trace-plane hooks: worker-side PING noting (clock
+        echo), and — on the coordinator — per-gather arrival stamps
+        fed to ``on_arrivals``."""
+        self._trace_on = True
+        if on_arrivals is not None:
+            self._on_arrivals = on_arrivals
+
+    def send_trace(self, payload: bytes) -> None:
+        """Best-effort upward TRACE frame (workers; a hierarchical
+        local root concatenates its host's sections first). Never
+        raises — same contract as send_metrics."""
     # Control-plane byte counters + liveness tracking, installed by
     # attach_metrics. The class-attribute defaults keep every
     # unattached (metrics-off) path at a no-op method call.
@@ -921,7 +982,8 @@ class TcpCoordinator(Controller):
         if self._size > 1:
             self._fanout = _NativeFanout.create(self._channels,
                                                 self._secret, hb=hb,
-                                                on_metrics=self._on_metrics)
+                                                on_metrics=self._on_metrics,
+                                                on_trace=self._on_trace_frame)
         hlog.debug(f"coordinator up: {self._size} ranks, "
                    f"{self.topology.cross_size} hosts, "
                    f"fan-in {len(self._channels)}", rank=0)
@@ -1055,6 +1117,16 @@ class TcpCoordinator(Controller):
         if sink is not None:
             sink(r, payload)
 
+    def _on_trace_frame(self, r: int, payload: bytes) -> None:
+        """A TRACE frame from owner channel ``r``: liveness, then the
+        runtime's WorldTraceWriter (dropped without one — a worker
+        with tracing armed must never hurt an unarmed coordinator)."""
+        if self._metrics_on:
+            self._last_seen[r] = time.monotonic()
+        sink = self.trace_sink
+        if sink is not None:
+            sink(r, payload)
+
     def peer_heartbeat_ages(self) -> Dict[int, float]:
         # list() snapshots the dict atomically under the GIL — the
         # background loop inserts new peers while user threads
@@ -1080,6 +1152,9 @@ class TcpCoordinator(Controller):
                 continue
             if tag == TAG_METRICS:
                 self._on_metrics(r, data)
+                continue
+            if tag == TAG_TRACE:
+                self._on_trace_frame(r, data)
                 continue
             if tag == TAG_ABORT:
                 origin, cause = heartbeat.decode_abort(data)
@@ -1112,9 +1187,25 @@ class TcpCoordinator(Controller):
         tag — a data-plane payload may begin with any byte."""
         out: List[bytes] = [b""] * self._size
         out[0] = payload
+        # Straggler attribution (common/trace.py): stamp per-owner
+        # arrival times on request gathers when the runtime armed it.
+        # Rank 0's own frame "arrives" at gather start — the baseline
+        # every lag is measured against. The native fanout stamps at
+        # true frame completion (in C); the Python fallback stamps as
+        # its sequential recv loop returns, which is best-effort for
+        # frames that were already buffered. The hook is captured ONCE
+        # — the trace-overhead toggle bench re-points it from another
+        # thread mid-gather, and check-then-recheck would call None.
+        on_arrivals = self._on_arrivals
+        track = (expect_tag == TAG_REQUESTS
+                 and on_arrivals is not None)
+        arrivals: Optional[Dict[int, float]] = \
+            {0: time.monotonic()} if track else None
         try:
             if self._fanout is not None:
                 gathered = self._fanout.gather(expect_tag)
+                if track:
+                    arrivals.update(self._fanout.last_arrivals)
                 if self._metrics_on:
                     now = time.monotonic()
                     rx = 0
@@ -1129,6 +1220,8 @@ class TcpCoordinator(Controller):
             else:
                 for r, ch in self._channels.items():
                     out[r] = self._recv_ctrl(r, ch, expect_tag)
+                    if track:
+                        arrivals[r] = time.monotonic()
                 if self._metrics_on:
                     self._m_ctrl_rx.inc(sum(
                         len(out[r]) for r in self._channels))
@@ -1136,6 +1229,8 @@ class TcpCoordinator(Controller):
             raise
         except (ConnectionError, OSError) as e:
             self._raise_transport(e)
+        if track:
+            on_arrivals(arrivals)
         return self._expand(out,
                             allow_combined=(expect_tag == TAG_REQUESTS))
 
@@ -1230,6 +1325,10 @@ class TcpCoordinator(Controller):
             if tag == TAG_METRICS:
                 self._on_metrics(r, spill if spill is not None
                                  else bytes(view[:n]))
+                continue
+            if tag == TAG_TRACE:
+                self._on_trace_frame(r, spill if spill is not None
+                                     else bytes(view[:n]))
                 continue
             if tag == TAG_ABORT:
                 origin, cause = heartbeat.decode_abort(
@@ -1346,6 +1445,9 @@ class TcpCoordinator(Controller):
             if tag == TAG_METRICS:
                 self._on_metrics(ranks[idx], payload)
                 return True
+            if tag == TAG_TRACE:
+                self._on_trace_frame(ranks[idx], payload)
+                return True
             return False
 
         kind, val = _steady.run_coord_cycle(
@@ -1354,6 +1456,17 @@ class TcpCoordinator(Controller):
             self._steady_on_idle if hb is not None else None,
             self._steady_scratch, on_oob)
         if kind == _steady.DONE:
+            segs, arrive = val
+            on_arrivals = self._on_arrivals  # one read; see _gather_frames
+            if on_arrivals is not None:
+                # The native steady gather stamps per-peer arrivals in
+                # C (CLOCK_MONOTONIC); 0.0 marks a frame absorbed in a
+                # previous resumed slice — skip it rather than invent
+                # a lag. Rank 0's own contribution is "already there".
+                arrivals = {r: t for r, t in zip(ranks, arrive) if t}
+                if arrivals:
+                    arrivals[0] = min(arrivals.values())
+                    on_arrivals(arrivals)
             if self._metrics_on:
                 now = time.monotonic()
                 nbytes = plan.payload_nbytes
@@ -1361,7 +1474,7 @@ class TcpCoordinator(Controller):
                     self._last_seen[r] = now
                 self._m_ctrl_rx.inc(nbytes * len(ranks))
                 self._m_ctrl_tx.inc(nbytes * len(ranks))
-            return ("done", val)
+            return ("done", segs)
         if kind == _steady.DEV:
             idx, tag, payload, done, peer_views = val
             if tag == TAG_ABORT:
@@ -1508,6 +1621,11 @@ class TcpWorker(Controller):
         # root's own snapshot into ONE frame upward (send_metrics) so
         # coordinator metrics fan-in scales with hosts, like CACHED_AGG.
         self._child_metrics: Dict[int, bytes] = {}
+        # Accumulated leaf TRACE frames (NOT latest-wins: spans are
+        # one-shot deltas — every frame must forward exactly once).
+        # Concatenated into this root's own frame by send_trace;
+        # bounded so a wedged upward channel cannot grow it forever.
+        self._child_trace: List[bytes] = []
         # liveness timestamps for peer_heartbeat_ages (metrics only)
         self._up_seen = time.monotonic()
         self._child_seen: Dict[int, float] = {}
@@ -1530,7 +1648,8 @@ class TcpWorker(Controller):
         if self._children:
             self._child_fanout = _NativeFanout.create(
                 self._children, secret, hb=hb,
-                on_metrics=self._on_child_metrics)
+                on_metrics=self._on_child_metrics,
+                on_trace=self._on_child_trace)
 
     def _become_local_root(self, members: List[int], secret: bytes,
                            start_timeout: float) -> None:
@@ -1606,6 +1725,16 @@ class TcpWorker(Controller):
         if self._metrics_on:
             self._child_seen[r] = time.monotonic()
 
+    def _on_child_trace(self, r: int, payload: bytes) -> None:
+        """A leaf's TRACE frame: ACCUMULATE (spans are deltas, not
+        totals) until send_trace folds the batch upward. Past the cap
+        the oldest frame is dropped — lossy beats unbounded."""
+        if len(self._child_trace) >= 64:
+            del self._child_trace[0]
+        self._child_trace.append(payload)
+        if self._metrics_on:
+            self._child_seen[r] = time.monotonic()
+
     def send_metrics(self, payload: bytes) -> None:
         try:
             if self._child_metrics:
@@ -1621,6 +1750,17 @@ class TcpWorker(Controller):
                 self._m_ctrl_tx.inc(len(payload))
         except Exception:
             pass  # best-effort: the cycle path owns channel errors
+
+    def send_trace(self, payload: bytes) -> None:
+        try:
+            if self._child_trace:
+                batch, self._child_trace = self._child_trace, []
+                payload = wire.combine_trace_frames([payload] + batch)
+            self._ch.send(payload, TAG_TRACE)
+            if self._metrics_on:
+                self._m_ctrl_tx.inc(len(payload))
+        except Exception:
+            pass  # best-effort, like send_metrics
 
     def peer_heartbeat_ages(self) -> Dict[int, float]:
         if not self._metrics_on:
@@ -1662,10 +1802,12 @@ class TcpWorker(Controller):
             if self._metrics_on:
                 self._up_seen = time.monotonic()
             if tag == TAG_PING:
+                if self._trace_on:
+                    self._note_ping(data)
                 self._relay_children_safe(data, TAG_PING)
                 continue
-            if tag == TAG_METRICS:
-                continue  # metrics only flow upward; tolerate strays
+            if tag in (TAG_METRICS, TAG_TRACE):
+                continue  # these only flow upward; tolerate strays
             if tag == TAG_ABORT:
                 origin, cause = heartbeat.decode_abort(data)
                 self._relay_children_safe(data, TAG_ABORT)
@@ -1677,6 +1819,18 @@ class TcpWorker(Controller):
             if self._metrics_on:
                 self._m_ctrl_rx.inc(len(data))
             return data
+
+    @staticmethod
+    def _note_ping(data: bytes) -> None:
+        """Clock-sync t2: a coordinator PING's receipt stamp, the
+        worker half of the NTP exchange (common/trace.py). Garbled
+        pings are liveness regardless — never an error here."""
+        try:
+            sender, seq = heartbeat.decode_ping(data)
+        except ValueError:
+            return
+        from horovod_tpu.common import trace as htrace
+        htrace.clock().ping_received(sender, seq, time.monotonic())
 
     def _recv_child(self, r: int, tag: int) -> bytes:
         while True:
@@ -1690,6 +1844,9 @@ class TcpWorker(Controller):
                     from e
             if t == TAG_METRICS:
                 self._on_child_metrics(r, data)
+                continue
+            if t == TAG_TRACE:
+                self._on_child_trace(r, data)
                 continue
             if t == TAG_ABORT:
                 origin, cause = heartbeat.decode_abort(data)
@@ -1843,12 +2000,13 @@ class TcpWorker(Controller):
             if self._metrics_on:
                 self._up_seen = time.monotonic()
             if tag == TAG_PING:
-                self._relay_children_safe(
-                    spill if spill is not None else bytes(view[:n]),
-                    TAG_PING)
+                data = spill if spill is not None else bytes(view[:n])
+                if self._trace_on:
+                    self._note_ping(data)
+                self._relay_children_safe(data, TAG_PING)
                 continue
-            if tag == TAG_METRICS:
-                continue  # metrics only flow upward; tolerate strays
+            if tag in (TAG_METRICS, TAG_TRACE):
+                continue  # these only flow upward; tolerate strays
             if tag == TAG_ABORT:
                 data = spill if spill is not None else bytes(view[:n])
                 origin, cause = heartbeat.decode_abort(data)
@@ -1924,7 +2082,7 @@ class TcpWorker(Controller):
                 f"the steady cycle")
         kind, val = _steady.run_worker_cycle(
             lib, plan, fd, self._ch.secret, bufs,
-            bytes((TAG_PING, TAG_METRICS)), TAG_REQUESTS,
+            bytes((TAG_PING, TAG_METRICS, TAG_TRACE)), TAG_REQUESTS,
             TAG_RESPONSES, self._ch._hb)
         if self._metrics_on:
             self._up_seen = time.monotonic()

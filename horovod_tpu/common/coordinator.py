@@ -613,22 +613,30 @@ class StallInspector:
         self._warned.discard(name)
 
     def check(self, table: MessageTable, cache_stats: str = "",
-              world_stats: str = "") -> bool:
+              world_stats: str = "",
+              straggler_stats: str = "") -> bool:
         """Log a report of stalled tensors; returns True if the shutdown
         threshold was exceeded (caller must initiate shutdown).
         ``cache_stats`` — a one-line negotiation-cache summary (hits /
         misses / cached cycles) surfaced with the periodic report so a
         timeline reader can tell whether negotiation time went to full
         rounds or to the bitmask fast path. ``world_stats`` — steady-
-        state health context (tensor-queue depth, per-peer heartbeat
-        ages, timeline drop count) appended to each stall warning so
-        one warning carries enough to diagnose without a second
-        tool."""
+        state health context (world cycle, tensor-queue depth,
+        per-peer heartbeat ages labeled in the coordinator clock,
+        per-peer clock offsets, timeline drop count) appended to each
+        stall warning so one warning carries enough to diagnose
+        without a second tool. ``straggler_stats`` — the per-cycle
+        critical-path attribution line from the trace plane's arrival
+        stamps ("rank 3 last-arriver in 84% of the last 1000
+        gathers"), its own report line so the slow RANK is named even
+        when nothing is stalled outright."""
         self._last_check = time.monotonic()
         if cache_stats:
             hlog.info(f"negotiation {cache_stats}")
         if world_stats:
             hlog.info(f"world health: {world_stats}")
+        if straggler_stats:
+            hlog.info(f"stragglers: {straggler_stats}")
         suffix = f" [world: {world_stats}]" if world_stats else ""
         must_shutdown = False
         for name, age, ranks_reported in table.pending():

@@ -162,6 +162,17 @@ class ElasticContext:
         inside it (per-rank by definition, not replicated)."""
         self.rank = rank
         self.membership.install(generation, size, rank_table, lost)
+        # Flight-recorder breadcrumb (common/trace.py, on by
+        # default): a postmortem dump then shows every generation
+        # this process lived through, with its rank in each.
+        from horovod_tpu.common import trace as htrace
+        htrace.flight().record(
+            htrace.EV_ELASTIC, arg=generation,
+            note=f"membership installed: generation {generation}, "
+                 f"rank {rank} of {size}")
+        # The renumbering invalidates every per-rank clock offset:
+        # old rank 3's skew must not bind to whoever is rank 2 now.
+        htrace.clock().reset()
 
     def world_line(self) -> str:
         """One status line for the stall report."""
@@ -524,6 +535,11 @@ def rendezvous(origin_rank: int, cause: str) -> _Assignment:
     ctx = _ctx
     assert ctx is not None
     t0 = time.monotonic()
+    from horovod_tpu.common import trace as htrace
+    htrace.flight().record(
+        htrace.EV_ELASTIC,
+        arg=origin_rank if origin_rank is not None else -1,
+        note=f"entering re-rendezvous (cause: {cause[:120]})")
     faults.tick_rendezvous(ctx.rank)
     dead = set()
     if origin_rank is not None and origin_rank >= 0:

@@ -42,6 +42,10 @@ Actions:
   selects one peer on the coordinator/local root), simulating link
   loss.
 - ``delay`` — sleep ``ms=N`` milliseconds once (latency injection).
+  ``count=K`` repeats the delay on K consecutive trigger hits (one
+  per cycle/op), turning a one-shot hiccup into a sustained
+  straggler — the lever the world-trace tests use to pin last-arriver
+  attribution on a specific rank.
 
 The module is zero-cost when idle: the runtime's per-cycle/per-op
 ticks return after a single ``_PLAN`` check.
@@ -64,17 +68,23 @@ class Fault:
     """One armed fault directive."""
 
     __slots__ = ("action", "rank", "at_cycle", "at_op", "at_rdzv",
-                 "seconds", "ms", "code", "target", "fired")
+                 "seconds", "ms", "code", "target", "count", "fired")
 
     def __init__(self, action: str, rank: Optional[int] = None,
                  at_cycle: Optional[int] = None,
                  at_op: Optional[int] = None,
                  at_rdzv: Optional[int] = None, seconds: float = 60.0,
                  ms: float = 0.0, code: int = 1,
-                 target: Optional[int] = None):
+                 target: Optional[int] = None, count: int = 1):
         if action not in _ACTIONS:
             raise ValueError(f"unknown fault action {action!r}; "
                              f"expected one of {_ACTIONS}")
+        if count != 1 and action != "delay":
+            raise ValueError(
+                "count= repeats only make sense for delay faults "
+                f"(a fired {action!r} never returns)")
+        if count < 1:
+            raise ValueError(f"fault count must be >= 1, got {count}")
         triggers = [t for t in (at_cycle, at_op, at_rdzv)
                     if t is not None]
         if len(triggers) != 1:
@@ -94,6 +104,7 @@ class Fault:
         self.ms = ms
         self.code = code
         self.target = target
+        self.count = count
         self.fired = False
 
     def __repr__(self) -> str:
@@ -145,6 +156,8 @@ def parse_spec(spec: str) -> List[Fault]:
                     kw["code"] = int(v)
                 elif k == "target":
                     kw["target"] = int(v)
+                elif k == "count":
+                    kw["count"] = int(v)
                 else:
                     raise ValueError(
                         f"unknown fault key {k!r} in {directive!r}")
@@ -198,11 +211,16 @@ def _apply(fault: Fault, runtime, rank: Optional[int] = None) -> None:
     """``runtime`` may be None for rendezvous-triggered faults (the
     old runtime is already torn down there); ``rank`` then labels the
     log line."""
-    fault.fired = True
+    fault.count -= 1
+    if fault.count <= 0:
+        fault.fired = True
     if rank is None:
         rank = runtime.controller.rank
     hlog.warning(f"fault injection firing on rank {rank}: {fault!r}",
                  rank=rank)
+    from horovod_tpu.common import trace as htrace
+    htrace.flight().record(htrace.EV_FAULT,
+                           note=f"{fault!r} fired on rank {rank}")
     if fault.action == "kill":
         os.kill(os.getpid(), signal.SIGKILL)
     elif fault.action == "exit":

@@ -33,6 +33,7 @@ from horovod_tpu.common import logging as hlog
 from horovod_tpu.common import metrics as hmetrics
 from horovod_tpu.common import overlap as hoverlap
 from horovod_tpu.common import steady as hsteady
+from horovod_tpu.common import trace as htrace
 from horovod_tpu.common import wire
 from horovod_tpu.common import wire_dtype as _wd
 from horovod_tpu.common.config import Config
@@ -479,6 +480,63 @@ class Runtime:
                 if config.metrics_log:
                     self._metrics_log = hmetrics.JsonlMetricsLog(
                         config.metrics_log)
+            # Info-style build identity (value always 1; the labels
+            # ARE the payload): postmortems and dashboards can tell
+            # WHICH build + knob set produced a dump or a regression.
+            bi = htrace.build_info()
+            reg.gauge(
+                f'hvd_build_info{{version="{bi["version"]}",'
+                f'native="{bi["native"]}",knobs="{bi["knobs"]}"}}',
+                "build identity: package version, native .so build "
+                "hash, armed-knobs digest (value is always 1)",
+                agg=hmetrics.AGG_MAX).set(1)
+
+        # -- world trace plane (HOROVOD_TPU_TRACE, common/trace.py) ----
+        # Flight recorder first: ON BY DEFAULT (no-op writes when
+        # HOROVOD_TPU_FLIGHT=0), process-lifetime singleton so a
+        # postmortem spans elastic generations.
+        self._flight = htrace.flight()
+        self._flight.set_identity(controller.rank)
+        htrace.install_sigusr2()
+        # Span collection + the world-identical cycle sequence number.
+        self._trace = htrace.create_collector(bool(config.trace_path))
+        self._trace_on = self._trace.enabled
+        self._world_cycle = 0
+        self._trace_last_pub = 0.0
+        self._trace_spans_sent = 0
+        self._m_trace_spans = reg.counter(
+            "hvd_trace_spans_total",
+            "trace spans this rank shipped (or wrote, on rank 0) "
+            "into the world trace plane")
+        self._trace_writer = None
+        # Straggler attribution lives on rank 0 and arms whenever
+        # EITHER observability plane is on (the metrics series are
+        # no-ops without the registry, but the stall-report line and
+        # the merged trace both want the arrival stamps).
+        self._straggler = None
+        if controller.rank == 0:
+            if self._trace_on:
+                # An elastic re-init constructs a fresh writer over
+                # the same knob; suffix post-resize generations so the
+                # just-finalized trace of the ABORTED world — the
+                # artifact worth inspecting — is never truncated.
+                trace_path = config.trace_path
+                try:
+                    from horovod_tpu.common import elastic as _elastic
+                    gen = _elastic.generation()
+                except Exception:
+                    gen = 0
+                if gen:
+                    trace_path = f"{trace_path}.gen{gen}"
+                self._trace_writer = htrace.WorldTraceWriter(trace_path)
+                controller.trace_sink = self._trace_writer.ingest
+            if self._metrics_on or self._trace_on:
+                self._straggler = htrace.StragglerTracker(reg)
+                controller.attach_trace(
+                    on_arrivals=self._straggler.note_gather)
+        elif self._trace_on:
+            # Workers: arm the clock-echo half (PING noting).
+            controller.attach_trace()
 
     @property
     def _spec_enabled(self) -> bool:
@@ -654,10 +712,20 @@ class Runtime:
         self._abort_info = (origin, cause)
         hlog.error(f"horovod_tpu world aborted: {self._error}",
                    rank=self.controller.rank)
+        self._flight.record(htrace.EV_ABORT, self._world_cycle,
+                            arg=origin, note=cause[:200])
+        if self._trace_on:
+            self._trace.mark("ABORT", time.monotonic(),
+                             self._world_cycle)
         try:
             self.controller.abort(origin, cause)
         except Exception:
             pass
+        # Postmortem AFTER the abort fan-out: file I/O must not delay
+        # the notice the survivors' deadlines are waiting on. The dump
+        # ships the last N seconds of world history (final cycles,
+        # the abort, any elastic/stall events) with nothing armed.
+        self._flight.dump(cause=cause, origin=origin)
 
     # -- the loop --------------------------------------------------------
     def _background_loop(self) -> None:
@@ -704,6 +772,7 @@ class Runtime:
         if getattr(self, "_teardown_started", False):
             return
         self._teardown_started = True
+        self._flight.record(htrace.EV_TEARDOWN, self._world_cycle)
         self._done.set()
         # Overlap runner first: its thread may sit inside a native
         # cycle against channels about to close — stop accepting work,
@@ -748,6 +817,36 @@ class Runtime:
             self.timeline.shutdown()
         except Exception:
             pass
+        # Flush the trace tail: rank 0 writes its residue and closes
+        # the merged file (the JSON array must terminate — the trace
+        # of exactly the aborted run is the one worth inspecting);
+        # workers best-effort ship theirs while the channel may still
+        # be up. Stage-guarded like everything else here.
+        if self._trace_on:
+            try:
+                spans, dropped = self._trace.drain()
+                if self._trace_writer is not None:
+                    self._trace_writer.add_section(0, spans, dropped)
+                    self._trace_spans_sent += len(spans)
+                elif (spans or dropped or
+                      getattr(self.controller, "_child_trace", None)):
+                    # a local root whose own buffer drained empty must
+                    # still flush its children's parked frames — the
+                    # tail of an aborted run is the part worth having
+                    self.controller.send_trace(
+                        wire.serialize_trace_frame(
+                            [{"rank": self.controller.rank,
+                              "dropped": dropped,
+                              "echo": htrace.clock().take_echo(),
+                              "spans": spans}]))
+                    self._trace_spans_sent += len(spans)
+            except Exception:
+                pass
+        if self._trace_writer is not None:
+            try:
+                self._trace_writer.close()
+            except Exception:
+                pass  # stage-guarded: metrics/backends must still close
         if self._aggregator is not None \
                 and self._metrics_log is not None:
             # Final JSONL line with rank 0's own totals exact and
@@ -1246,6 +1345,14 @@ class Runtime:
         if kind == "done":
             self._native_steady_cycles += 1
             self._overlap_cycles += 1
+            # The drained cycle IS a completed world round — counted
+            # here, at apply time, because verdicts apply in
+            # submission order (the wire order every rank shares).
+            wc = self._note_round()
+            if self._trace_on:
+                self._trace.slice(
+                    "OVERLAP", cyc.t_start,
+                    max(cyc.t_done - cyc.t_start, 0.0), wc)
             if self._metrics_on:
                 dur = max(cyc.t_done - cyc.t_start, 1e-9)
                 self._m_overlap_fraction.observe(
@@ -1298,6 +1405,11 @@ class Runtime:
             assert kind == "fallback"
             reply, meta = self._coordinate_cycle(val)
             ctl.broadcast_responses(reply)
+        wc = self._note_round()
+        if self._trace_on:
+            self._trace.slice("OVERLAP", cyc.t_start,
+                              max(time.monotonic() - cyc.t_start, 0.0),
+                              wc)
         self._apply_overlap_verdict(cyc, meta)
 
     @world_coherent
@@ -1337,6 +1449,57 @@ class Runtime:
             self._inflight_masks.remove(mask)
         except ValueError:
             pass
+
+    def _note_round(self) -> int:
+        """One world negotiation round (gather + broadcast — classic,
+        cached, native steady or overlapped) completed on this rank.
+        The counter is WORLD-IDENTICAL: every rank participates in
+        every round in wire order (overlapped cycles apply at drain in
+        submission order, which IS the wire order), so the same round
+        carries the same number everywhere — the correlation key the
+        timeline, the world trace and the flight recorder all stamp."""
+        self._world_cycle += 1
+        wc = self._world_cycle
+        self.timeline.set_world_cycle(wc)
+        self._flight.record(htrace.EV_CYCLE, wc)
+        return wc
+
+    def _maybe_publish_trace(self) -> None:
+        """Per-interval trace shipping (background thread only):
+        drain the span collector and either feed rank 0's world
+        writer directly or ride one TAG_TRACE frame up the control
+        tree — out-of-band, exactly like METRICS frames. The frame
+        also carries the clock-sync echo closing the NTP loop."""
+        now = time.monotonic()
+        # A hierarchical local root forwards buffered child frames on
+        # the next tick rather than waiting out its own interval: a
+        # child's clock-sync echo ages while parked, and every parked
+        # microsecond inflates t4 — a systematic (same-period publish
+        # timers, constant phase) negative bias on the leaf's offset
+        # that min-RTT filtering cannot remove.
+        pending_children = bool(
+            getattr(self.controller, "_child_trace", None))
+        if (now - self._trace_last_pub < self.config.trace_interval_s
+                and not pending_children):
+            return
+        self._trace_last_pub = now
+        spans, dropped = self._trace.drain()
+        if self._trace_writer is not None:
+            self._trace_writer.add_section(0, spans, dropped)
+            self._trace_spans_sent += len(spans)
+            return
+        echo = htrace.clock().take_echo()
+        if (not spans and not dropped and echo is None
+                and not pending_children):
+            return
+        try:
+            payload = wire.serialize_trace_frame(
+                [{"rank": self.controller.rank, "dropped": dropped,
+                  "echo": echo, "spans": spans}])
+        except Exception:
+            return  # a malformed span must not kill the loop
+        self._trace_spans_sent += len(spans)
+        self.controller.send_trace(payload)
 
     def _record_signature(self, req: Request) -> None:
         if req.request_type not in CACHEABLE_REQUESTS:
@@ -1425,8 +1588,11 @@ class Runtime:
         payload, bit_requests = self._build_request_frame(
             requests, shutting_down)
 
-        if self._metrics_on:
-            tn = time.monotonic()
+        # 0.0 (not unbound) when dark: _trace_on may be flipped from
+        # another thread mid-cycle (the trace-overhead toggle bench),
+        # and the span emit below must then skip, never NameError.
+        tn = (time.monotonic()
+              if self._metrics_on or self._trace_on else 0.0)
         submitted = False
         meta = None
         if not isinstance(payload, hsteady.SteadyPlan) \
@@ -1477,6 +1643,14 @@ class Runtime:
             else:
                 data = self.controller.broadcast_responses(None)
                 meta = wire.parse_cycle_response(data)
+        if meta is not None:
+            # A world round completed synchronously in this iteration
+            # (a submitted overlap cycle completes at drain instead).
+            wc = self._note_round()
+            if self._trace_on and tn:
+                self._trace.slice(
+                    "STEADY" if isinstance(payload, hsteady.SteadyPlan)
+                    else "ROUND", tn, time.monotonic() - tn, wc)
         if self._metrics_on:
             self._m_negotiation_s.observe(time.monotonic() - tn)
 
@@ -1493,6 +1667,8 @@ class Runtime:
             if self._metrics_on:
                 self._m_cycle_s.observe(time.monotonic() - t0)
                 self._maybe_publish_metrics()
+            if self._trace_on:
+                self._maybe_publish_trace()
             return True
 
         if isinstance(meta, CacheCycleResponse):
@@ -1534,6 +1710,8 @@ class Runtime:
         if self._metrics_on:
             self._m_cycle_s.observe(elapsed)
             self._maybe_publish_metrics()
+        if self._trace_on:
+            self._maybe_publish_trace()
         idle_hold = False
         sleep_s = cycle_time_ms / 1000.0 - elapsed
         if not self.tensor_table.queue_pending():
@@ -2050,6 +2228,7 @@ class Runtime:
         self._m_arena_bytes.set(harena.total_bytes())
         self._m_queue_depth.set(len(self.tensor_table))
         self._m_lock_inversions.set_total(lockdep.inversion_count())
+        self._m_trace_spans.set_total(self._trace_spans_sent)
         for r, age in self.controller.peer_heartbeat_ages().items():
             self.metrics.gauge(
                 f'hvd_peer_heartbeat_age_seconds{{peer="{r}"}}',
@@ -2098,7 +2277,8 @@ class Runtime:
         depth and timeline drops always; per-peer heartbeat ages when
         the metrics plane maintains them — one warning then carries
         enough to diagnose without a second tool."""
-        parts = [f"tensor queue depth {len(self.tensor_table)}"]
+        parts = [f"world cycle {self._world_cycle}",
+                 f"tensor queue depth {len(self.tensor_table)}"]
         if self._last_wire_verdict is not None:
             alg, w = self._last_wire_verdict
             parts.append(
@@ -2108,9 +2288,21 @@ class Runtime:
             parts.append(self._elastic.world_line())
         ages = self.controller.peer_heartbeat_ages()
         if ages:
+            # Ages are last-frame-to-now durations measured on THIS
+            # host's clock — on rank 0 (where the stall report runs)
+            # that IS the coordinator clock, and the offsets line
+            # below quantifies how far each peer's own clock sits
+            # from it, so a skewed host's timeline no longer reads
+            # as "silent".
             worst = sorted(ages.items(), key=lambda kv: -kv[1])[:4]
-            parts.append("oldest peer heartbeat ages: " + ", ".join(
-                f"rank {r} {a:.1f}s" for r, a in worst))
+            parts.append(
+                "oldest peer heartbeat ages (coordinator clock): "
+                + ", ".join(f"rank {r} {a:.1f}s" for r, a in worst))
+        if self.controller.is_coordinator:
+            offs = htrace.clock_offsets_line()
+            if offs:
+                parts.append("peer clock offsets vs coordinator: "
+                             + offs)
         if self.timeline.dropped_events:
             parts.append(f"timeline events dropped "
                          f"{self.timeline.dropped_events}")
@@ -2157,9 +2349,14 @@ class Runtime:
         stall warnings and fail-fast shutdown must still see it)."""
         if not self._stall.should_check():
             return
+        straggler = (self._straggler.report_line()
+                     if self._straggler is not None else "")
         if self._stall.check(table,
                              cache_stats=self._cache_stats_line(),
-                             world_stats=self._world_status_line()):
+                             world_stats=self._world_status_line(),
+                             straggler_stats=straggler):
+            self._flight.record(htrace.EV_STALL, self._world_cycle,
+                                note="stall shutdown threshold")
             # The stall-shutdown threshold fires the fail-fast
             # abort so every rank gets a structured error naming
             # the condition, instead of the silent clean-shutdown
@@ -2389,6 +2586,10 @@ class Runtime:
                     e.callback = _cb
             else:
                 self.timeline.activity_start_all(names, ACT_COLLECTIVE)
+            # 0.0 (not unbound) when dark — _trace_on may flip from
+            # another thread mid-execute (the trace-overhead toggle
+            # bench); the emit below must then skip, never NameError.
+            tx = time.monotonic() if self._trace_on else 0.0
             try:
                 status = self.op_manager.execute(entries, response)
             except WorldAbortedError as e:
@@ -2416,6 +2617,13 @@ class Runtime:
             except Exception as e:
                 status = Status.UnknownError(
                     f"collective execution failed: {e!r}")
+            if self._trace_on and tx:
+                # Issue-side wall time of the batch (async backends
+                # complete on finalizer threads — their tail rides
+                # the next ROUND span, like the timeline's B span).
+                self._trace.slice(f"{op_name} x{len(entries)}", tx,
+                                  time.monotonic() - tx,
+                                  self._world_cycle)
             if closer is None and self.timeline.enabled:
                 self.timeline.activity_end_all(names)
                 for e in entries:

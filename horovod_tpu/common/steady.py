@@ -397,8 +397,12 @@ def run_coord_cycle(lib, plan: SteadyPlan, fds: List[int],
     world sums. ``on_oob(peer_idx, tag, payload) -> bool`` absorbs an
     out-of-band frame (metrics) — True resumes the native gather with
     the already-received frames intact. Returns
-    (DONE, acc segments) | (DEV, (idx, tag, payload, done_list,
-    peer_views)) | (ERR, (rc, done_list))."""
+    (DONE, (acc segments, arrivals)) | (DEV, (idx, tag, payload,
+    done_list, peer_views)) | (ERR, (rc, done_list)). ``arrivals`` is
+    each peer's frame-completion stamp on CLOCK_MONOTONIC (0.0 for a
+    frame absorbed before this call re-entered, e.g. across an
+    out-of-band bounce) — the steady fast path's feed into the
+    coordinator's straggler attribution."""
     n = len(fds)
     c = _c_common(plan)
     b = _c_coord(plan, n, scratch)
@@ -418,6 +422,7 @@ def run_coord_cycle(lib, plan: SteadyPlan, fds: List[int],
     acc_ptrs = (ctypes.c_void_p * plan.nseg)(
         *[a.ctypes.data for a in acc_bufs])
     done = (ctypes.c_uint8 * n)()
+    arrive = (ctypes.c_double * n)()
     timeout_ms, interval_ms = _hb_ms(hb)
     idle_cb = on_idle if on_idle is not None else _native.NULL_ON_IDLE
     dev_idx = ctypes.c_int(-1)
@@ -430,12 +435,13 @@ def run_coord_cycle(lib, plan: SteadyPlan, fds: List[int],
             len(plan.prefix), c["hdr_ptrs"], c["hdr_lens"],
             c["seg_lens"], c["seg_codes"], plan.nseg, b["peer_ptrs"],
             acc_ptrs, sec, len(secret), skip, len(skip_tags),
-            timeout_ms, interval_ms, idle_cb, done,
+            timeout_ms, interval_ms, idle_cb, done, arrive,
             ctypes.byref(dev_idx), ctypes.byref(dev_buf),
             ctypes.byref(dev_len), ctypes.byref(dev_tag))
         if rc == 0:
-            return DONE, [(dt, a) for dt, a in
-                          zip(plan.seg_dtypes, acc_bufs)]
+            return DONE, ([(dt, a) for dt, a in
+                           zip(plan.seg_dtypes, acc_bufs)],
+                          list(arrive))
         if rc == 1:
             try:
                 payload = ctypes.string_at(dev_buf, dev_len.value)

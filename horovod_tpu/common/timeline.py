@@ -40,6 +40,7 @@ class _NoOpTimeline:
     dropped_events = 0
 
     def attach_drop_counter(self, counter): pass
+    def set_world_cycle(self, n): pass
     def negotiate_start(self, name, request_type): pass
     def negotiate_rank_ready(self, name, rank): pass
     def negotiate_end(self, name, verdict=""): pass
@@ -79,6 +80,7 @@ class Timeline(_NoOpTimeline):
         self._drop_metric = None
         self._pids: Dict[str, int] = {}
         self._next_pid = 1
+        self._wc = 0  # world cycle number (set_world_cycle)
         self._lock = lockdep.lock("timeline.Timeline._lock")
         self._start_ts = time.monotonic()
         self._writer = threading.Thread(target=self._write_loop,
@@ -88,6 +90,15 @@ class Timeline(_NoOpTimeline):
 
     def attach_drop_counter(self, counter) -> None:
         self._drop_metric = counter
+
+    def set_world_cycle(self, n: int) -> None:
+        """The world-identical negotiation-round sequence number
+        (common/trace.py): stamped into every span-opening event's
+        args as ``wc`` so per-rank timeline files correlate with the
+        merged world trace — and with each other — by eye, without
+        the aggregator armed. A bare int store; the runtime updates
+        it once per completed world round."""
+        self._wc = n
 
     def _put(self, rec: dict) -> None:
         """Enqueue one event; on overflow drop it and count the drop
@@ -133,11 +144,18 @@ class Timeline(_NoOpTimeline):
                            "pid": pid, "args": {"sort_index": pid}})
             return pid
 
+    # Event phases that OPEN (or fully describe) a span get the world
+    # cycle stamp; closing "E"/"e" events inherit it in the viewer, so
+    # stamping them too would only bloat the file.
+    _WC_PHASES = frozenset(("B", "X", "i", "b"))
+
     def _emit(self, ph: str, name: str, event_name: str, **kw):
         rec = {"ph": ph, "pid": self._pid(name), "ts": self._ts()}
         if event_name:
             rec["name"] = event_name
         rec.update(kw)
+        if ph in self._WC_PHASES:
+            rec.setdefault("args", {})["wc"] = self._wc
         self._put(rec)
 
     # -- negotiation (reference: timeline.cc NegotiateStart/RankReady/End,

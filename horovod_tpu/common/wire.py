@@ -684,6 +684,130 @@ def combine_cycle_requests(frames) -> "bytes | None":
     return serialize_cycle_request(combined, aggregate=True)
 
 
+# ---------------------------------------------------------------------------
+# TRACE frames — the world trace plane's out-of-band payload
+# (TAG_TRACE, common/trace.py): each rank ships bounded batches of
+# completed spans upward the same way METRICS frames ride; a
+# hierarchical local root CONCATENATES its host's sections into one
+# frame (spans are one-shot deltas, not totals — unlike metrics they
+# must never be latest-wins folded), and rank 0 merges every rank's
+# track into ONE clock-aligned Chrome-trace file.
+#
+#   TraceFrame := u8 version | u32 nsections | Section[nsections]
+#   Section    := i32 rank | u32 dropped
+#               | u8 has_echo [| u64 ping_seq | f64 t_ping_recv
+#                              | f64 t_send]
+#               | u32 nspans | Span[nspans]
+#   Span       := u8 kind | u64 cycle | f64 ts | f64 dur | str name
+#
+# The echo is the worker half of the NTP-style clock exchange
+# (common/trace.py ClockSync): ``ping_seq`` names the coordinator
+# PING being answered, ``t_ping_recv``/``t_send`` are this rank's
+# monotonic clock at ping receipt and frame build. ``cycle`` is the
+# world-identical negotiation-round sequence number, so spans
+# correlate across ranks even before clock alignment converges.
+
+_TRACE_VERSION = 1
+
+# Span kinds (u8 on the wire; one family, pairwise distinct —
+# enforced by the hvdlint wire-protocol analyzer like WIRE_*/ALG_*).
+SPAN_SLICE = 0   # complete span: Chrome "X" (ts + dur)
+SPAN_MARK = 1    # instant event: Chrome "i" (dur ignored)
+
+SPAN_NAMES = {SPAN_SLICE: "slice", SPAN_MARK: "mark"}
+
+# Flight-recorder event codes (u8 in the ring and the postmortem
+# JSONL header — common/trace.py FlightRecorder). Same distinctness
+# contract as SPAN_*.
+EV_CYCLE = 0      # one world negotiation round completed
+EV_ABORT = 1      # world abort observed/raised on this rank
+EV_ELASTIC = 2    # elastic lifecycle event (recovery/resize/rejoin)
+EV_STALL = 3      # stall-inspector warning/shutdown
+EV_FAULT = 4      # injected fault fired (common/faults.py)
+EV_TEARDOWN = 5   # runtime teardown entered
+EV_MARK = 6       # free-form marker (tests, user code)
+
+EV_NAMES = {EV_CYCLE: "cycle", EV_ABORT: "abort",
+            EV_ELASTIC: "elastic", EV_STALL: "stall",
+            EV_FAULT: "fault", EV_TEARDOWN: "teardown",
+            EV_MARK: "mark"}
+
+
+def serialize_trace_frame(sections) -> bytes:
+    """``sections``: [{"rank", "dropped", "echo": None|(seq, t_recv,
+    t_send), "spans": [(kind, cycle, ts, dur, name), ...]}, ...]."""
+    w = _Writer()
+    w.u8(_TRACE_VERSION)
+    w.u32(len(sections))
+    for sec in sections:
+        w.i32(sec["rank"])
+        w.u32(sec.get("dropped", 0))
+        echo = sec.get("echo")
+        if echo is None:
+            w.u8(0)
+        else:
+            seq, t_recv, t_send = echo
+            w.u8(1)
+            w.parts.append(_U64.pack(seq))
+            w.f64(t_recv)
+            w.f64(t_send)
+        spans = sec.get("spans", ())
+        w.u32(len(spans))
+        for kind, cycle, ts, dur, name in spans:
+            w.u8(kind)
+            w.parts.append(_U64.pack(cycle))
+            w.f64(ts)
+            w.f64(dur)
+            w.string(name)
+    return w.bytes()
+
+
+def parse_trace_frame(data: bytes):
+    """-> [section dict, ...] (layout above). Raises on a malformed
+    or unknown-version frame; control-plane callers treat that as a
+    droppable best-effort payload, like METRICS frames."""
+    r = _Reader(data)
+    version = r.u8()
+    if version != _TRACE_VERSION:
+        raise ValueError(f"unknown trace frame version {version}")
+    sections = []
+    for _ in range(r.u32()):
+        rank = r.i32()
+        dropped = r.u32()
+        echo = None
+        if r.u8():
+            r._need(_U64.size)
+            (seq,) = _U64.unpack_from(r.data, r.off)
+            r.off += _U64.size
+            echo = (seq, r.f64(), r.f64())
+        spans = []
+        for _s in range(r.u32()):
+            kind = r.u8()
+            r._need(_U64.size)
+            (cycle,) = _U64.unpack_from(r.data, r.off)
+            r.off += _U64.size
+            spans.append((kind, cycle, r.f64(), r.f64(), r.string()))
+        sections.append({"rank": rank, "dropped": dropped,
+                         "echo": echo, "spans": spans})
+    return sections
+
+
+def combine_trace_frames(frames) -> bytes:
+    """Concatenate several TRACE frames' sections into one (a local
+    root folding its host before forwarding upward). Unlike
+    combine_metrics_frames this NEVER merges two sections: spans are
+    one-shot deltas, so every section must survive verbatim with its
+    rank attribution. A garbled frame is dropped — one leaf on skewed
+    code must not silence its healthy siblings."""
+    sections = []
+    for f in frames:
+        try:
+            sections.extend(parse_trace_frame(f))
+        except Exception:
+            continue
+    return serialize_trace_frame(sections)
+
+
 # -- elastic rendezvous frames (common/elastic.py) ---------------------------
 #
 # These ride short-lived dedicated sockets (never the controller

@@ -101,7 +101,7 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.hvd_gather_frames.argtypes = [
         ctypes.POINTER(ctypes.c_int), ctypes.c_int, u8p, ctypes.c_int,
         ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_int64), u8p,
-        ctypes.c_int]
+        ctypes.c_int, ctypes.POINTER(ctypes.c_double)]
     lib.hvd_broadcast_frame.restype = ctypes.c_int
     lib.hvd_broadcast_frame.argtypes = [
         ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_uint8,
@@ -194,7 +194,7 @@ def _configure(lib: ctypes.CDLL) -> None:
         u8p, ctypes.c_int,
         ctypes.c_int, ctypes.c_int,
         ON_IDLE_FUNC,
-        u8p,
+        u8p, ctypes.POINTER(ctypes.c_double),
         ctypes.POINTER(ctypes.c_int), u8pp, i64p, u8p]
 
 

@@ -525,6 +525,15 @@ def main(argv: Optional[List[str]] = None) -> None:
     parser.add_argument("--metrics-log", default=None,
                         help="rank-0 JSONL snapshot file (implies "
                              "--metrics; env HOROVOD_TPU_METRICS_LOG)")
+    parser.add_argument("--trace", default=None, metavar="PATH",
+                        help="arm the world trace plane on every rank "
+                             "and write the merged clock-aligned "
+                             "Chrome trace to PATH on rank 0 (env "
+                             "HOROVOD_TPU_TRACE; docs/tracing.md)")
+    parser.add_argument("--trace-interval", type=float, default=None,
+                        help="seconds between trace-span shipments "
+                             "up the control tree (env "
+                             "HOROVOD_TPU_TRACE_INTERVAL)")
     parser.add_argument("--verbose", action="store_true")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="training command")
@@ -554,11 +563,23 @@ def main(argv: Optional[List[str]] = None) -> None:
             str(args.metrics_interval)
     if args.metrics_log is not None:
         metrics_env["HOROVOD_TPU_METRICS_LOG"] = args.metrics_log
+    # World trace plane + flight recorder knobs, same plumbing. The
+    # trace path must reach EVERY rank (workers collect spans; rank 0
+    # writes the merged file).
+    if args.trace is not None:
+        metrics_env["HOROVOD_TPU_TRACE"] = args.trace
+    if args.trace_interval is not None:
+        metrics_env["HOROVOD_TPU_TRACE_INTERVAL"] = \
+            str(args.trace_interval)
     # Multihost task servers forward only an explicit env set; carry
-    # env-configured metrics knobs across hosts too, not just flags.
+    # env-configured metrics/trace/flight knobs across hosts too,
+    # not just flags.
     for key in ("HOROVOD_TPU_METRICS", "HOROVOD_TPU_METRICS_PORT",
                 "HOROVOD_TPU_METRICS_INTERVAL",
-                "HOROVOD_TPU_METRICS_LOG"):
+                "HOROVOD_TPU_METRICS_LOG", "HOROVOD_TPU_TRACE",
+                "HOROVOD_TPU_TRACE_INTERVAL", "HOROVOD_TPU_FLIGHT",
+                "HOROVOD_TPU_FLIGHT_EVENTS",
+                "HOROVOD_TPU_FLIGHT_DIR"):
         if key in os.environ:
             metrics_env.setdefault(key, os.environ[key])
 

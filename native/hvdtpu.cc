@@ -16,6 +16,7 @@
 #include <cerrno>
 #include <cstdlib>
 #include <cstring>
+#include <ctime>
 #include <poll.h>
 #include <sys/socket.h>
 #include <sys/uio.h>
@@ -28,6 +29,15 @@
 // ---------------------------------------------------------------------
 
 namespace {
+
+// Same clock Python's time.monotonic() reads on Linux, so the
+// per-peer arrival stamps the gather loops export compare directly
+// against Python-side stamps (straggler attribution, common/trace.py).
+inline double now_mono() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return double(ts.tv_sec) + double(ts.tv_nsec) * 1e-9;
+}
 
 struct Sha256 {
   uint32_t h[8];
@@ -579,7 +589,7 @@ extern "C" {
 
 int hvd_gather_frames(const int* fds, int n, const uint8_t* secret,
                       int secret_len, uint8_t** bufs, int64_t* lens,
-                      uint8_t* tags, int timeout_ms) {
+                      uint8_t* tags, int timeout_ms, double* arrive) {
   // Poll-driven: service whichever worker's frame arrives first so one
   // slow rank doesn't serialize the reads (the reference gets this
   // from MPI_Gatherv internally).
@@ -617,6 +627,7 @@ int hvd_gather_frames(const int* fds, int n, const uint8_t* secret,
       int rrc = recv_frame(fds[idx], secret, secret_len, &bufs[idx],
                            &lens[idx], &tags[idx]);
       if (rrc) return rrc;
+      if (arrive) arrive[idx] = now_mono();
       done[size_t(idx)] = true;
       remaining--;
     }
@@ -1007,7 +1018,7 @@ int hvd_steady_coord(const int* fds, int n, uint8_t req_tag,
                      const uint8_t* skip_tags, int nskip,
                      int timeout_ms, int interval_ms,
                      void (*on_idle)(void),
-                     uint8_t* done,
+                     uint8_t* done, double* arrive,
                      int* dev_idx, uint8_t** dev_buf,
                      int64_t* dev_len, uint8_t* dev_tag) {
   // --- gather: one speculative frame per pending peer -----------------
@@ -1056,6 +1067,7 @@ int hvd_steady_coord(const int* fds, int n, uint8_t req_tag,
       if (rc == RX_SKIP) continue;  // liveness/stray: peer stays owed
       if (rc == RX_DEV) { *dev_idx = idx; return 1; }
       if (rc < 0) return rc;
+      if (arrive) arrive[idx] = now_mono();
       done[idx] = 1;
       remaining--;
       dl.idle_ms = 0;

@@ -23,10 +23,14 @@ extern "C" {
 // Read one frame from each of n fds (poll-driven, GIL released on the
 // Python side). For fd i: *(bufs+i) receives a malloc'd payload whose
 // length is written to lens[i]; tags[i] receives the frame tag.
-// Caller frees each buffer with hvd_free.
+// Caller frees each buffer with hvd_free. ``arrive`` (nullable, n
+// doubles) receives each frame's completion stamp on CLOCK_MONOTONIC
+// — the same clock Python's time.monotonic() reads — feeding the
+// coordinator's per-cycle straggler attribution (common/trace.py);
+// slots of peers whose frame never arrived are left untouched.
 int hvd_gather_frames(const int* fds, int n, const uint8_t* secret,
                       int secret_len, uint8_t** bufs, int64_t* lens,
-                      uint8_t* tags, int timeout_ms);
+                      uint8_t* tags, int timeout_ms, double* arrive);
 
 // Write the same frame to each of n fds.
 int hvd_broadcast_frame(const int* fds, int n, uint8_t tag,
@@ -137,6 +141,9 @@ int hvd_steady_worker_chunked(int fd, uint8_t req_tag, uint8_t resp_tag,
 // index) or an out-of-band bounce the caller can hand the array back
 // and resume, or fall back with the absorbed frames intact.
 // on_idle (nullable) fires once per idle poll slice (PING fan-out).
+// ``arrive`` (nullable, n doubles) mirrors hvd_gather_frames: each
+// peer's speculative-frame completion stamp on CLOCK_MONOTONIC, so
+// the steady fast path stays visible to straggler attribution.
 int hvd_steady_coord(const int* fds, int n, uint8_t req_tag,
                      uint8_t resp_tag,
                      const uint8_t* prefix, int64_t prefix_len,
@@ -150,7 +157,7 @@ int hvd_steady_coord(const int* fds, int n, uint8_t req_tag,
                      const uint8_t* skip_tags, int nskip,
                      int timeout_ms, int interval_ms,
                      void (*on_idle)(void),
-                     uint8_t* done,
+                     uint8_t* done, double* arrive,
                      int* dev_idx, uint8_t** dev_buf,
                      int64_t* dev_len, uint8_t* dev_tag);
 

@@ -131,6 +131,40 @@ class TestFaultSpec:
         faults.clear()
         assert faults._PLAN is None
 
+    def test_parse_delay_count(self):
+        (f,) = faults.parse_spec("rank=2:delay:cycle=10:ms=40:count=8")
+        assert (f.action, f.count, f.ms) == ("delay", 8, 40.0)
+
+    def test_count_only_for_delay(self):
+        # a fired kill/exit never returns; repeating them is a spec bug
+        with pytest.raises(ValueError):
+            faults.parse_spec("kill:cycle=1:count=2")
+        with pytest.raises(ValueError):
+            faults.parse_spec("delay:cycle=1:count=0")
+
+    def test_repeating_delay_fires_count_times_then_spends(self):
+        """count=K turns the one-shot delay into a sustained straggler
+        (K consecutive trigger hits) — the lever the world-trace mp
+        test uses to pin last-arriver attribution on one rank."""
+
+        class _Ctl:
+            rank = 0
+
+        class _Rt:
+            controller = _Ctl()
+
+        try:
+            f = faults.install("delay", at_cycle=3, ms=0.0, count=2)
+            faults.tick_cycle(_Rt(), 2)
+            assert f.count == 2 and not f.fired  # below trigger
+            faults.tick_cycle(_Rt(), 3)
+            assert f.count == 1 and not f.fired  # first hit
+            faults.tick_cycle(_Rt(), 4)
+            assert f.fired                       # second hit: spent
+            faults.tick_cycle(_Rt(), 5)          # no-op thereafter
+        finally:
+            faults.clear()
+
 
 class TestHeartbeatConfig:
     def test_env_knobs_round_trip(self, monkeypatch):

@@ -334,6 +334,69 @@ def test_wire_protocol_code_family_clean(tmp_path):
                          name="wire_dtype.py") == []
 
 
+BAD_TRACE_CODES = """
+    SPAN_SLICE = 0
+    SPAN_MARK = 0
+    EV_CYCLE = 0
+    EV_ABORT = 1
+    EV_ELASTIC = 1
+    EV_NAMES = 1  # name tables exempt
+"""
+
+
+def test_wire_protocol_trace_code_families_fire(tmp_path):
+    """The PR 11 families — SPAN_* trace span kinds and EV_* flight
+    recorder event codes — join the same distinctness contract: a
+    collision silently aliases two meanings in every TRACE frame and
+    every postmortem ring."""
+    fs = _lint_snippet(tmp_path, BAD_TRACE_CODES, "wire-protocol",
+                       name="wire.py")
+    msgs = "\n".join(f.message for f in fs)
+    assert "SPAN_SLICE and SPAN_MARK share byte value" in msgs
+    assert "EV_ABORT and EV_ELASTIC share byte value" in msgs
+
+
+BAD_CONTROLLER_TAGS = """
+    TAG_HANDSHAKE = 1
+    TAG_REQUESTS = 2
+    TAG_TRACE = 2
+    TAG_BIG = 999
+"""
+
+GOOD_CONTROLLER_TAGS = """
+    TAG_HANDSHAKE = 1
+    TAG_REQUESTS = 2
+    TAG_METRICS = 7
+    TAG_TRACE = 8
+"""
+
+
+def test_wire_protocol_controller_tag_collision_fires(tmp_path):
+    fs = _lint_snippet(tmp_path, BAD_CONTROLLER_TAGS, "wire-protocol",
+                       name="controller.py")
+    msgs = "\n".join(f.message for f in fs)
+    assert "TAG_REQUESTS and TAG_TRACE share byte value" in msgs
+    assert "TAG_BIG = 999 does not fit the u8" in msgs
+
+
+def test_wire_protocol_controller_tags_clean(tmp_path):
+    assert _lint_snippet(tmp_path, GOOD_CONTROLLER_TAGS,
+                         "wire-protocol", name="controller.py") == []
+
+
+def test_trace_frame_codec_real_tree_guarded(tmp_path):
+    """The REAL wire.py trace codec passes the analyzer — pairing
+    (serialize_/parse_trace_frame), guard domination, and family
+    distinctness all hold on the shipped tree (the clean-tree gate
+    covers this too; this pins the specific module)."""
+    import shutil
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    shutil.copy(os.path.join(REPO, "horovod_tpu", "common", "wire.py"),
+                pkg / "wire.py")
+    assert lint_paths([str(pkg)], ["wire-protocol"]) == []
+
+
 # -- native-codec -----------------------------------------------------------
 
 _NATIVE_HEADER = """

@@ -8,6 +8,7 @@ import signal
 import socket
 import subprocess
 import sys
+import tempfile
 
 import pytest
 
@@ -35,6 +36,12 @@ def _base_env(extra_env=None):
     # — an acquisition-order inversion anywhere in the runtime raises
     # LockInversionError instead of someday deadlocking a real job.
     base.setdefault("HOROVOD_TPU_LOCKCHECK", "1")
+    # The default-on flight recorder dumps into CWD on every abort;
+    # point every spawned world at a throwaway dir so abort-path tests
+    # don't litter the checkout with pid-unique postmortems (tests
+    # that assert on dumps override this with their own tmp_path).
+    base.setdefault("HOROVOD_TPU_FLIGHT_DIR",
+                    tempfile.mkdtemp(prefix="hvd-flight-test."))
     if extra_env:
         base.update(extra_env)
     return base

@@ -128,12 +128,18 @@ def test_frame_transport_interop(secret):
     lens = (ctypes.c_int64 * n)()
     tags = (ctypes.c_uint8 * n)()
     sec = (ctypes.c_uint8 * max(1, len(secret)))(*secret)
+    arrive = (ctypes.c_double * n)()
     rc = lib.hvd_gather_frames(fds, n, sec, len(secret), bufs, lens,
-                               tags, 5000)
+                               tags, 5000, arrive)
     assert rc == 0
     assert ctypes.string_at(bufs[0], lens[0]) == payload0
     assert ctypes.string_at(bufs[1], lens[1]) == payload1
     assert tags[0] == 2 and tags[1] == 2
+    # arrival stamps: CLOCK_MONOTONIC, comparable to time.monotonic()
+    import time as _time
+    now = _time.monotonic()
+    for i in range(n):
+        assert 0 < arrive[i] <= now + 1.0, (i, arrive[i], now)
     for i in range(n):
         lib.hvd_free(bufs[i])
     t0.join(); t1.join()
@@ -164,7 +170,7 @@ def test_frame_transport_rejects_bad_hmac():
     secret = b"right-secret"
     sec = (ctypes.c_uint8 * len(secret))(*secret)
     rc = lib.hvd_gather_frames(fds, n, sec, len(secret), bufs, lens,
-                               tags, 5000)
+                               tags, 5000, None)
     assert rc != 0  # EBADMSG
     t.join()
     a.close(); b.close()
@@ -296,15 +302,20 @@ def test_native_steady_cycle_roundtrip(secret):
     dl = ctypes.c_int64()
     dt = ctypes.c_uint8()
     import horovod_tpu.native as _nat
+    arrive = (ctypes.c_double * n)()
     rc = lib.hvd_steady_coord(
         fds, n, 2, 3, c["prefix"], c["prefix_len"], c["hdrs"],
         c["hdr_lens"], c["seg_lens"], c["seg_codes"], 1, peer_ptrs,
         acc_ptrs, sec, len(secret), skip, 2, 5000, 100,
-        _nat.ON_IDLE_FUNC(0), done, ctypes.byref(dev_idx),
+        _nat.ON_IDLE_FUNC(0), done, arrive, ctypes.byref(dev_idx),
         ctypes.byref(dev), ctypes.byref(dl), ctypes.byref(dt))
     for t in threads:
         t.join()
     assert rc == 0, rc
+    import time as _time
+    now = _time.monotonic()
+    for i in range(n):  # per-peer arrival stamps on the steady gather
+        assert 0 < arrive[i] <= now + 1.0, (i, arrive[i], now)
     expect = seg * (1.0 + 2.0 + 3.0)
     np.testing.assert_allclose(acc, expect)
     for r in (1, 2):
@@ -347,7 +358,7 @@ def test_native_steady_coord_deviation_returns_classic_frame():
         fds, 1, 2, 3, c["prefix"], c["prefix_len"], c["hdrs"],
         c["hdr_lens"], c["seg_lens"], c["seg_codes"], 1, peer_ptrs,
         acc_ptrs, sec, len(secret), skip, 1, 5000, 100,
-        _nat.ON_IDLE_FUNC(0), done, ctypes.byref(dev_idx),
+        _nat.ON_IDLE_FUNC(0), done, None, ctypes.byref(dev_idx),
         ctypes.byref(dev), ctypes.byref(dl), ctypes.byref(dt))
     t.join()
     assert rc == 1 and dev_idx.value == 0 and dt.value == 2

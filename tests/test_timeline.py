@@ -85,6 +85,32 @@ def test_timeline_two_process(tmp_path):
             if e.get("ph") == "X"} >= {"0", "1"}
 
 
+def test_timeline_spans_carry_world_cycle(tmp_path):
+    """Every span-opening event (B/X/i/b) carries the world-identical
+    cycle sequence number in args.wc (ISSUE 11), monotone
+    non-decreasing in emit order — so two per-rank timeline files (or
+    a timeline and the merged world trace) correlate by eye without
+    the aggregator armed."""
+    path = str(tmp_path / "timeline_wc.json")
+    run_scenario("timeline", 2,
+                 extra_env={"HOROVOD_TIMELINE": path,
+                            "HOROVOD_TIMELINE_MARK_CYCLES": "1"})
+    events = _load_events(path)
+    opening = [e for e in events
+               if e.get("ph") in ("B", "X", "i", "b")]
+    assert opening
+    wcs = [e["args"]["wc"] for e in opening]
+    assert all(isinstance(w, int) for w in wcs)
+    # collectives ran, so rounds advanced past zero...
+    assert max(wcs) >= 2
+    # ...monotonically in emit order (the background thread emits and
+    # bumps in one place; writer order is queue order)
+    assert wcs == sorted(wcs)
+    # closing events stay unstamped (viewers inherit from the opener)
+    assert all("wc" not in (e.get("args") or {}) for e in events
+               if e.get("ph") in ("E", "e"))
+
+
 def test_timeline_cached_negotiation_markers(tmp_path):
     """Hit cycles carry no per-tensor NEGOTIATE spans, so the trace's
     evidence of the fast path is the instant NEGOTIATE_CACHED marker —

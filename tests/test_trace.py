@@ -1,0 +1,485 @@
+"""World trace plane units (ISSUE 11, common/trace.py + the TAG_TRACE
+codec in common/wire.py): frame roundtrip/truncation, the hierarchical
+concat fold, NTP clock math + min-RTT smoothing, the flight-recorder
+ring + postmortem dump, straggler attribution, and the merged catapult
+writer's offset correction."""
+
+import json
+import os
+import signal
+
+import pytest
+
+from horovod_tpu.common import trace as htrace
+from horovod_tpu.common import wire
+from tests.test_multiprocess import run_scenario
+
+
+def _section(rank, spans, echo=None, dropped=0):
+    return {"rank": rank, "dropped": dropped, "echo": echo,
+            "spans": spans}
+
+
+SPANS = [(wire.SPAN_SLICE, 17, 1.5, 0.25, "ROUND"),
+         (wire.SPAN_SLICE, 17, 1.75, 0.1, "ALLREDUCE x3"),
+         (wire.SPAN_MARK, 18, 2.0, 0.0, "ABORT")]
+
+
+class TestTraceCodec:
+    def test_roundtrip(self):
+        blob = wire.serialize_trace_frame(
+            [_section(2, SPANS, echo=(41, 10.5, 10.75), dropped=3),
+             _section(3, [])])
+        secs = wire.parse_trace_frame(blob)
+        assert len(secs) == 2
+        assert secs[0]["rank"] == 2 and secs[0]["dropped"] == 3
+        assert secs[0]["echo"] == (41, 10.5, 10.75)
+        assert secs[0]["spans"] == SPANS
+        assert secs[1] == _section(3, [])
+
+    def test_every_truncation_raises(self):
+        """Every strict prefix must fail parse loudly (the _Reader
+        length-guard contract every wire codec carries), never decode
+        a silently-wrong frame."""
+        blob = wire.serialize_trace_frame(
+            [_section(1, SPANS, echo=(7, 1.0, 2.0))])
+        for cut in range(len(blob)):
+            with pytest.raises((ConnectionError, ValueError)):
+                wire.parse_trace_frame(blob[:cut])
+
+    def test_unknown_version_rejected(self):
+        blob = wire.serialize_trace_frame([_section(0, [])])
+        with pytest.raises(ValueError):
+            wire.parse_trace_frame(b"\xff" + blob[1:])
+
+    def test_combine_concatenates_sections(self):
+        """The hierarchical fold CONCATENATES — spans are one-shot
+        deltas; a latest-wins fold (the metrics semantics) would lose
+        every earlier batch."""
+        a = wire.serialize_trace_frame([_section(1, SPANS[:1])])
+        b = wire.serialize_trace_frame([_section(2, SPANS[1:]),
+                                        _section(3, [])])
+        secs = wire.parse_trace_frame(wire.combine_trace_frames([a, b]))
+        assert [s["rank"] for s in secs] == [1, 2, 3]
+        assert secs[0]["spans"] == SPANS[:1]
+        assert secs[1]["spans"] == SPANS[1:]
+
+    def test_combine_drops_garbled_frame(self):
+        good = wire.serialize_trace_frame([_section(1, SPANS)])
+        secs = wire.parse_trace_frame(
+            wire.combine_trace_frames([b"\x00garbage", good]))
+        assert [s["rank"] for s in secs] == [1]
+
+    def test_code_families_distinct(self):
+        assert len(set(wire.SPAN_NAMES)) == len(wire.SPAN_NAMES)
+        assert len(set(wire.EV_NAMES)) == len(wire.EV_NAMES)
+        for v in list(wire.SPAN_NAMES) + list(wire.EV_NAMES):
+            assert 0 <= v <= 255
+
+
+class TestClockSync:
+    def test_ntp_offset_recovered_exactly(self):
+        """Symmetric delay, known offset: the four-stamp math must
+        recover it exactly. Peer clock = coord clock + 2.5s; one-way
+        delay 10ms each direction."""
+        cs = htrace.ClockSync()
+        off, delay = 2.5, 0.010
+        t1 = 100.0
+        cs.ping_sent(7, t1)
+        t2 = t1 + delay + off          # peer clock at ping receipt
+        t3 = t2 + 0.050                # peer processes for 50ms
+        t4 = (t3 - off) + delay        # coord clock at echo arrival
+        cs.echo(1, 7, t2, t3, t4)
+        got_off, got_rtt = cs.offsets()[1]
+        assert got_off == pytest.approx(off, abs=1e-9)
+        assert got_rtt == pytest.approx(2 * delay, abs=1e-9)
+        assert cs.offset_of(1) == pytest.approx(off, abs=1e-9)
+        assert cs.offset_of(0) == 0.0  # the coordinator IS the frame
+
+    def test_min_rtt_sample_wins(self):
+        """A congested (asymmetric-queueing) sample inflates RTT and
+        skews the offset — the estimator must prefer the cleanest
+        round trip in the window."""
+        cs = htrace.ClockSync()
+        cs.ping_sent(1, 100.0)
+        cs.echo(1, 1, 101.0, 101.0, 100.002)      # rtt 2ms, off ~1.0
+        cs.ping_sent(2, 200.0)
+        cs.echo(1, 2, 201.4, 201.4, 200.5)        # rtt 500ms, skewed
+        off, rtt = cs.offsets()[1]
+        assert rtt == pytest.approx(0.002, abs=1e-9)
+        assert off == pytest.approx(0.999, abs=1e-3)
+
+    def test_unknown_ping_and_negative_rtt_dropped(self):
+        cs = htrace.ClockSync()
+        cs.echo(1, 99, 1.0, 2.0, 3.0)  # never sent: forgotten
+        assert cs.offsets() == {}
+        cs.ping_sent(5, 100.0)
+        cs.echo(1, 5, 200.0, 210.0, 100.1)  # rtt < 0: clocks moved
+        assert cs.offsets() == {}
+
+    def test_worker_echo_consumed_once_and_coord_only(self):
+        cs = htrace.ClockSync()
+        cs.ping_received(3, 10, 1.0)   # a local root's beacon: ignored
+        assert cs.take_echo() is None
+        cs.ping_received(0, 11, 2.0)
+        seq, t2, t3 = cs.take_echo()
+        assert (seq, t2) == (11, 2.0) and t3 > 0
+        assert cs.take_echo() is None  # one ping answered once
+
+    def test_offsets_line_formatting(self):
+        htrace._reset_for_tests()
+        try:
+            cs = htrace.clock()
+            cs.ping_sent(1, 0.0)
+            cs.echo(2, 1, 0.101, 0.101, 0.002)
+            line = htrace.clock_offsets_line()
+            assert "rank 2" in line and "ms" in line
+        finally:
+            htrace._reset_for_tests()
+
+
+class TestFlightRecorder:
+    def test_ring_wraps_keeping_latest(self):
+        rec = htrace.FlightRecorder(capacity=8)
+        for i in range(20):
+            rec.record(wire.EV_CYCLE, cycle=i)
+        evs = rec.events()
+        assert len(evs) == 8
+        assert [e[2] for e in evs] == list(range(12, 20))  # chrono
+        assert all(e[1] == wire.EV_CYCLE for e in evs)
+
+    def test_dump_format(self, tmp_path):
+        rec = htrace.FlightRecorder(capacity=16)
+        rec.set_identity(3)
+        rec.record(wire.EV_CYCLE, cycle=41)
+        rec.record(wire.EV_ABORT, cycle=42, arg=1,
+                   note="connection to rank 1 lost")
+        path = str(tmp_path / "flight.jsonl")
+        got = rec.dump(cause="test abort", origin=1, path=path)
+        assert got == path
+        lines = [json.loads(l) for l in open(path)]
+        header, events = lines[0], lines[1:]
+        assert header["flight"] == 1 and header["rank"] == 3
+        assert header["origin"] == 1 and header["cause"] == "test abort"
+        assert set(header["build"]) == {"version", "native", "knobs"}
+        assert [e["ev"] for e in events] == ["cycle", "abort"]
+        assert events[1]["arg"] == 1
+        assert "rank 1" in events[1]["note"]
+        # a second dump appends a fresh block
+        rec.dump(cause="again", origin=-1, path=path)
+        assert sum(1 for l in open(path)
+                   if json.loads(l).get("flight")) == 2
+
+    def test_dump_never_raises(self):
+        rec = htrace.FlightRecorder(capacity=8)
+        assert rec.dump(path="/nonexistent-dir/zz/flight.jsonl") is None
+
+    def test_events_survive_lock_held_on_same_thread(self, tmp_path):
+        # SIGUSR2 delivers on the main thread; if that thread is mid-
+        # record() and holds the ring lock, the handler's dump() must
+        # still complete (best-effort snapshot) instead of deadlocking.
+        rec = htrace.FlightRecorder(capacity=8)
+        rec.record(wire.EV_CYCLE, cycle=7)
+        assert rec._lock.acquire(timeout=1.0)
+        try:
+            evs = rec.events()  # must return, not block forever
+            assert [e[2] for e in evs] == [7]
+            path = rec.dump(cause="SIGUSR2",
+                            path=str(tmp_path / "f.jsonl"))
+            assert path is not None
+        finally:
+            rec._lock.release()
+
+    def test_disabled_env_hands_out_noop(self, monkeypatch):
+        htrace._reset_for_tests()
+        try:
+            monkeypatch.setenv("HOROVOD_TPU_FLIGHT", "0")
+            rec = htrace.flight()
+            assert rec is htrace.NOOP_RECORDER
+            assert not rec.enabled
+            rec.record(wire.EV_CYCLE, 1)  # all no-ops
+            assert rec.events() == []
+            assert rec.dump(cause="x") is None
+        finally:
+            htrace._reset_for_tests()
+
+    def test_default_on_singleton(self):
+        htrace._reset_for_tests()
+        try:
+            assert os.environ.get("HOROVOD_TPU_FLIGHT", "1") != "0"
+            rec = htrace.flight()
+            assert isinstance(rec, htrace.FlightRecorder)
+            assert htrace.flight() is rec
+        finally:
+            htrace._reset_for_tests()
+
+
+class TestDisabledRuntimeSites:
+    def test_noop_write_sites_enumerable(self, monkeypatch):
+        """HOROVOD_TPU_FLIGHT=0 + no trace path: every instrumented
+        site must hold the shared no-op objects (the NOOP_METRIC
+        contract — the disabled paths stay provably free)."""
+        import horovod_tpu as hvd
+        from horovod_tpu.common import basics as _b
+        htrace._reset_for_tests()
+        monkeypatch.setenv("HOROVOD_TPU_FLIGHT", "0")
+        monkeypatch.delenv("HOROVOD_TPU_TRACE", raising=False)
+        hvd.shutdown()
+        hvd.init()
+        try:
+            rt = _b.runtime()
+            assert rt._flight is htrace.NOOP_RECORDER
+            assert rt._trace is htrace.NOOP_TRACE
+            assert not rt._trace_on
+            assert rt._trace_writer is None
+            assert rt._straggler is None  # metrics off too
+            ctl = rt.controller
+            assert not ctl._trace_on and ctl._on_arrivals is None
+            assert ctl.trace_sink is None
+        finally:
+            hvd.shutdown()
+            htrace._reset_for_tests()
+
+
+class TestHierTracePublish:
+    """A hierarchical local root must not park child TRACE frames for
+    its own publish interval: every parked second inflates the echo's
+    t4 and biases the leaf's clock offset (systematically — same-period
+    publish timers hold a constant phase, so min-RTT can't filter it)."""
+
+    def _runtime_stub(self, child_trace, interval=60.0):
+        import time
+        from types import SimpleNamespace
+        sent = []
+        controller = SimpleNamespace(
+            rank=1, _child_trace=child_trace,
+            send_trace=lambda p: sent.append(p))
+        collector = SimpleNamespace(drain=lambda: ([], 0))
+        rt = SimpleNamespace(
+            config=SimpleNamespace(trace_interval_s=interval),
+            controller=controller, _trace=collector,
+            _trace_writer=None, _trace_spans_sent=0,
+            _trace_last_pub=time.monotonic())  # interval NOT elapsed
+        return rt, sent
+
+    def test_pending_child_frames_bypass_interval(self):
+        from horovod_tpu.common.runtime import Runtime
+        rt, sent = self._runtime_stub(child_trace=[b"leaf-frame"])
+        Runtime._maybe_publish_trace(rt)
+        assert len(sent) == 1  # forwarded now, not a minute from now
+        secs = wire.parse_trace_frame(sent[0])
+        assert len(secs) == 1 and secs[0]["rank"] == 1
+        assert secs[0]["spans"] == [] and secs[0]["dropped"] == 0
+
+    def test_idle_rank_still_waits_out_interval(self):
+        from horovod_tpu.common.runtime import Runtime
+        rt, sent = self._runtime_stub(child_trace=[])
+        Runtime._maybe_publish_trace(rt)
+        assert sent == []  # nothing to say, nothing parked: no frame
+
+
+class TestStragglerTracker:
+    def test_last_arriver_and_skew(self):
+        from horovod_tpu.common import metrics as hm
+        reg = hm.MetricsRegistry()
+        tr = htrace.StragglerTracker(reg)
+        for _ in range(9):
+            tr.note_gather({0: 10.0, 1: 10.001, 2: 10.050, 3: 10.002})
+        tr.note_gather({0: 20.0, 1: 20.2, 2: 20.01, 3: 20.0})
+        line = tr.report_line()
+        assert "rank 2 last-arriver in 90% of the last 10" in line
+        snap = reg.snapshot()
+        assert snap['hvd_last_arriver_total{peer="2"}']["v"] == 9.0
+        assert snap['hvd_last_arriver_total{peer="1"}']["v"] == 1.0
+        assert snap['hvd_arrival_lag_seconds{peer="2"}']["v"] == \
+            pytest.approx(0.050)
+        assert snap['hvd_arrival_lag_seconds{peer="2"}']["agg"] == "max"
+        h = snap["hvd_cycle_skew_seconds"]
+        assert h["count"] == 10
+        assert h["sum"] == pytest.approx(9 * 0.050 + 0.2)
+
+    def test_window_slides(self):
+        tr = htrace.StragglerTracker()
+        tr.WINDOW  # class constant stays 1000
+        for i in range(htrace.StragglerTracker.WINDOW + 50):
+            tr.note_gather({0: 1.0, 1: 2.0})  # rank 1 always last
+        line = tr.report_line()
+        assert "rank 1 last-arriver in 100% of the last 1000" in line
+
+    def test_empty_before_any_gather(self):
+        assert htrace.StragglerTracker().report_line() == ""
+
+
+class TestWorldTraceWriter:
+    def _write(self, tmp_path, sections, clock=None):
+        path = str(tmp_path / "world.json")
+        w = htrace.WorldTraceWriter(path, clock_sync=clock
+                                    or htrace.ClockSync())
+        for rank, spans, dropped in sections:
+            w.add_section(rank, spans, dropped)
+        w.close()
+        with open(path) as f:
+            return json.load(f)  # must be VALID JSON after close
+
+    def test_tracks_and_cycle_args(self, tmp_path):
+        events = self._write(tmp_path, [
+            (0, [(wire.SPAN_SLICE, 5, 1.0, 0.5, "ROUND")], 0),
+            (2, [(wire.SPAN_SLICE, 5, 1.1, 0.4, "ROUND"),
+                 (wire.SPAN_MARK, 6, 1.9, 0.0, "ABORT")], 1),
+        ])
+        pids = {e["pid"] for e in events}
+        assert pids == {0, 2}
+        names = {e["args"]["name"] for e in events
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert names == {"rank 0", "rank 2"}
+        rounds = [e for e in events if e.get("name") == "ROUND"]
+        assert all(e["ph"] == "X" and e["args"]["wc"] == 5
+                   for e in rounds)
+        marks = [e for e in events if e.get("name") == "ABORT"]
+        assert marks and marks[0]["ph"] == "i"
+        drops = [e for e in events
+                 if str(e.get("name", "")).startswith("TRACE_DROPPED")]
+        assert drops and drops[0]["pid"] == 2
+
+    def test_offset_correction_aligns_tracks(self, tmp_path):
+        """Rank 1's clock sits +2.0s from the coordinator; after
+        correction its span must land at the same coordinator time as
+        rank 0's concurrent span."""
+        cs = htrace.ClockSync()
+        cs.ping_sent(1, 50.0)
+        cs.echo(1, 1, 52.0, 52.0, 50.0)  # offset exactly +2.0, rtt 0
+        path = str(tmp_path / "world.json")
+        w = htrace.WorldTraceWriter(path, clock_sync=cs)
+        w.add_section(0, [(wire.SPAN_SLICE, 9, 100.0, 0.5, "ROUND")])
+        w.add_section(1, [(wire.SPAN_SLICE, 9, 102.0, 0.5, "ROUND")])
+        w.close()
+        events = json.load(open(path))
+        ts = {e["pid"]: e["ts"] for e in events
+              if e.get("name") == "ROUND"}
+        assert ts[0] == ts[1]
+
+    def test_tracks_clamped_monotonic(self, tmp_path):
+        """A drifting offset estimate between batches must never make
+        a rank's own track run backwards."""
+        cs = htrace.ClockSync()
+        path = str(tmp_path / "world.json")
+        w = htrace.WorldTraceWriter(path, clock_sync=cs)
+        w.add_section(1, [(wire.SPAN_SLICE, 1, 10.0, 0.5, "ROUND")])
+        # offset estimate jumps to +5s: raw correction would throw
+        # the next span far BEFORE the previous one
+        cs.ping_sent(1, 0.0)
+        cs.echo(1, 1, 5.0, 5.0, 0.0)
+        w.add_section(1, [(wire.SPAN_SLICE, 2, 10.6, 0.5, "ROUND")])
+        w.close()
+        events = [e for e in json.load(open(path))
+                  if e.get("name") == "ROUND"]
+        assert events[1]["ts"] >= events[0]["ts"]
+
+    def test_ingest_closes_clock_loop(self, tmp_path):
+        cs = htrace.ClockSync()
+        cs.ping_sent(3, 0.0)
+        path = str(tmp_path / "world.json")
+        w = htrace.WorldTraceWriter(path, clock_sync=cs)
+        payload = wire.serialize_trace_frame([
+            _section(2, [(wire.SPAN_SLICE, 1, 1.0, 0.1, "ROUND")],
+                     echo=(3, 0.5, 0.6))])
+        w.ingest(2, payload)
+        w.ingest(2, b"garbled")  # dropped, never raises
+        w.close()
+        assert 2 in cs.offsets()
+        events = json.load(open(path))
+        assert any(e.get("name") == "ROUND" and e["pid"] == 2
+                   for e in events)
+
+
+class TestBuildInfo:
+    def test_triplet_shape(self):
+        bi = htrace.build_info()
+        from horovod_tpu import __version__
+        assert bi["version"] == __version__
+        assert bi["native"] and bi["knobs"]
+        assert len(bi["knobs"]) == 12
+
+    def test_knobs_digest_tracks_env(self, monkeypatch):
+        a = htrace.knobs_digest()
+        monkeypatch.setenv("HOROVOD_SOME_TEST_KNOB", "1")
+        b = htrace.knobs_digest()
+        assert a != b
+        monkeypatch.delenv("HOROVOD_SOME_TEST_KNOB")
+        assert htrace.knobs_digest() == a
+
+
+# -- multi-process e2e (scenario bodies in tests/mp_scenarios.py) -----
+
+# Short publish/beacon intervals so 60 gathers see many TRACE frames
+# and clock-sync loops; speculation off keeps every recv on the Python
+# paths where PING echoes close the NTP exchange.
+_TRACE_MP_ENV = {
+    "HOROVOD_TPU_METRICS": "1",
+    "HOROVOD_TPU_METRICS_INTERVAL": "0.2",
+    "HOROVOD_TPU_TRACE_INTERVAL": "0.2",
+    "HOROVOD_HEARTBEAT_INTERVAL": "0.2",
+    "HOROVOD_HEARTBEAT_TIMEOUT": "60",
+    "HOROVOD_CACHE_SPECULATIVE": "0",
+}
+
+
+def test_trace_world_merged_catapult_and_straggler(tmp_path):
+    """The ISSUE 11 e2e: ws=4 with a repeating 250ms ``delay`` fault
+    on rank 2. The scenario asserts the straggler attribution NAMES
+    rank 2 (arrival-lag dominance + last-arriver counter + skew
+    histogram) and that the piggybacked clock sync closed; this
+    wrapper validates the merged catapult artifact rank 0 wrote."""
+    path = str(tmp_path / "world_trace.json")
+    run_scenario(
+        "trace_world", 4, timeout=180.0,
+        extra_env={**_TRACE_MP_ENV,
+                   "HOROVOD_TPU_TRACE": path,
+                   "HOROVOD_FAULT_SPEC":
+                       "rank=2:delay:cycle=8:ms=250:count=40"})
+    events = json.load(open(path))  # ONE valid-JSON merged file
+    spans = [e for e in events if e.get("ph") in ("X", "i")]
+    assert {e["pid"] for e in spans} == {0, 1, 2, 3}  # track per rank
+    names = {e["args"]["name"] for e in events
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert names == {f"rank {r}" for r in range(4)}
+    for rank in range(4):
+        track = [e["ts"] for e in spans if e["pid"] == rank]
+        assert track, f"rank {rank} track empty"
+        # offset-corrected timestamps stay monotonic per track
+        assert track == sorted(track), f"rank {rank} runs backwards"
+        # ...and carry the world cycle number, itself monotone
+        wcs = [e["args"]["wc"] for e in spans if e["pid"] == rank
+               if "wc" in e.get("args", {})]
+        assert wcs and wcs == sorted(wcs)
+
+
+def test_trace_arrival_stamps_cover_native_steady():
+    """Skew/last-arriver attribution must not go dark when the steady
+    loop collapses into one-call native cycles (hvd_steady_coord):
+    the scenario asserts the skew histogram advances at least once
+    per native cycle and exactly one last-arriver is charged per
+    stamped gather."""
+    run_scenario(
+        "trace_native_arrivals", 4, timeout=120.0,
+        extra_env={"HOROVOD_TPU_METRICS": "1",
+                   "HOROVOD_TPU_SHM": "0"})
+
+
+def test_flight_dump_on_sigkill_world(tmp_path):
+    """SIGKILL rank 2 mid-steady-cycle with NO profiling armed: every
+    survivor raises WorldAbortedError naming rank 2 (the PR 2
+    invariant) and leaves a flight-recorder postmortem in
+    HOROVOD_TPU_FLIGHT_DIR naming the dead rank and holding the final
+    cycles (asserted rank-side in the scenario)."""
+    run_scenario(
+        "flight_sigkill", 4, timeout=90.0,
+        extra_env={"HOROVOD_HEARTBEAT_INTERVAL": "0.3",
+                   "HOROVOD_HEARTBEAT_TIMEOUT": "3",
+                   "HOROVOD_FAULT_SPEC": "rank=2:kill:op=25",
+                   "HOROVOD_TPU_FLIGHT_DIR": str(tmp_path)},
+        expect_rc={2: -signal.SIGKILL})
+    dumps = sorted(tmp_path.glob("hvd-flight-rank*.jsonl"))
+    headers = [json.loads(p.open().readline()) for p in dumps]
+    assert {h["rank"] for h in headers} == {0, 1, 3}, dumps
+    assert all(h["origin"] == 2 for h in headers)

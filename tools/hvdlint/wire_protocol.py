@@ -31,11 +31,18 @@ classes, each one this repo has actually shipped and review-fixed:
 
 5. **Code-family distinctness.** Single-byte negotiated-attribute
    code families (``WIRE_*`` wire dtypes, ``ALG_*`` algorithm stamps —
-   common/wire_dtype.py) must be pairwise distinct within their
-   family and fit a u8: these ride Request/Response frames as raw
+   common/wire_dtype.py; ``SPAN_*`` trace span kinds and ``EV_*``
+   flight-recorder event codes — common/wire.py, PR 11) must be
+   pairwise distinct within their family and fit a u8: these ride
+   TRACE/Request/Response frames (and the postmortem ring) as raw
    bytes, and two names sharing a value silently alias two different
-   negotiated verdicts (the compression analog of a FRAME_*
-   collision).
+   meanings (the compression analog of a FRAME_* collision).
+
+6. **Controller tag distinctness.** Modules named ``controller``
+   define the channel frame tags (``TAG_HANDSHAKE`` ... ``TAG_TRACE``)
+   as module-level ints: they must be pairwise distinct and u8-ranged,
+   or two frame streams silently alias on every channel — the bug
+   class a hand-added tag constant can reintroduce in one line.
 """
 
 from __future__ import annotations
@@ -120,12 +127,48 @@ def _has_guard_before(func: ast.FunctionDef, line: int) -> bool:
     return False
 
 
+def _is_controller_module(src: SourceFile) -> bool:
+    return src.shortname == "controller"
+
+
+def _check_tag_family(src: SourceFile) -> List[Finding]:
+    """TAG_* distinctness + u8 range over a controller module."""
+    findings: List[Finding] = []
+    values: Dict[int, str] = {}
+    for node in src.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        cname = node.targets[0].id
+        if not cname.startswith("TAG_"):
+            continue
+        if not (isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)):
+            continue
+        v = node.value.value
+        if not 0 <= v <= 255:
+            findings.append(Finding(
+                NAME, src.path, node.lineno,
+                f"channel frame tag {cname} = {v} does not fit the "
+                f"u8 the frame header carries"))
+        elif v in values:
+            findings.append(Finding(
+                NAME, src.path, node.lineno,
+                f"channel frame tags {values[v]} and {cname} share "
+                f"byte value {v:#04x} — two frame streams would "
+                f"alias on every channel"))
+        else:
+            values[v] = cname
+    return findings
+
+
 def run(project: Project) -> List[Finding]:
     findings: List[Finding] = []
     for src in project.files:
-        if not _is_wire_module(src):
-            continue
-        findings.extend(_check_module(src))
+        if _is_wire_module(src):
+            findings.extend(_check_module(src))
+        elif _is_controller_module(src):
+            findings.extend(_check_tag_family(src))
     return findings
 
 
@@ -207,9 +250,10 @@ def _check_module(src: SourceFile) -> List[Finding]:
                         f"without a length guard — a short buffer "
                         f"silently decodes a WRONG value"))
 
-    # 5 — negotiated-attribute code families: WIRE_* / ALG_* bytes
-    # distinct within each family and u8-ranged
-    for family in ("WIRE_", "ALG_"):
+    # 5 — single-byte code families: WIRE_*/ALG_* (negotiated
+    # attributes), SPAN_* (trace span kinds) and EV_* (flight
+    # recorder event codes) — distinct within each family, u8-ranged
+    for family in ("WIRE_", "ALG_", "SPAN_", "EV_"):
         values: Dict[int, str] = {}
         for node in src.tree.body:
             if not (isinstance(node, ast.Assign)
