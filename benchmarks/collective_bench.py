@@ -701,6 +701,156 @@ OVERLAP_BENCH_STEPS = 50
 OVERLAP_BENCH_ELEMS = 65536
 
 
+def worker_multitenant(rank: int, size: int) -> None:
+    """Multi-tenant section (docs/multitenancy.md): one or two
+    tenants spanning the whole fleet run an identical per-tenant
+    workload from separate threads. Two program shapes:
+
+    * ``paced`` (HVD_BENCH_THINK_MS) — a training-shaped loop: one
+      64 KiB allreduce then a think-time sleep (compute stand-in;
+      releases the GIL like device compute). The shared-fleet leg's
+      per-tenant throughput vs the isolated leg measures co-tenancy
+      overhead.
+    * ``saturated`` (HVD_BENCH_SATURATE=1) — a 4-deep async pipeline
+      with no think time: both lanes stay backlogged, so the
+      QoS-weighted interleave is the binding constraint and the
+      cycle share at the first tenant's completion measures it.
+
+    Reports per-tenant elapsed/ops_per_s plus lane stats (cycles,
+    deferrals) and — with two tenants — the second tenant's completed
+    cycles at the moment the first finishes."""
+    import threading
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init()
+    nten = int(os.environ.get("HVD_BENCH_TENANTS", "2"))
+    weights = [float(w) for w in
+               os.environ.get("HVD_BENCH_WEIGHTS", "1,1").split(",")]
+    think_s = float(os.environ.get("HVD_BENCH_THINK_MS", "5")) / 1e3
+    saturate = os.environ.get("HVD_BENCH_SATURATE") == "1"
+    steps = int(os.environ.get("HVD_BENCH_STEPS", "150"))
+    names = ["jobA", "jobB"][:nten]
+    tenants = [hvd.create_tenant(n, list(range(size)), weight=w)
+               for n, w in zip(names, weights)]
+    x = np.full(16384, float(rank + 1), np.float32)  # 64 KiB
+    ssum = float(sum(range(1, size + 1)))
+    out: dict = {}
+
+    def run(t, key, first):
+        t0 = time.monotonic()
+        if saturate:
+            depth, pend = 4, []
+            for i in range(steps):
+                pend.append(t.allreduce_async(
+                    x, average=False, name=f"{key}.g{i % depth}"))
+                if len(pend) >= depth:
+                    assert float(np.asarray(
+                        t.synchronize(pend.pop(0)))[0]) == ssum
+            while pend:
+                t.synchronize(pend.pop(0))
+        else:
+            for _ in range(steps):
+                r = t.allreduce(x, average=False, name=f"{key}.g")
+                assert float(np.asarray(r)[0]) == ssum
+                if think_s:
+                    time.sleep(think_s)
+        out[key] = {"elapsed_s": time.monotonic() - t0}
+        if first and len(tenants) > 1:
+            out["peer_cycles_at_first_done"] = \
+                tenants[1].lane_stats()["cycles"]
+
+    threads = [threading.Thread(target=run, args=(t, k, i == 0))
+               for i, (t, k) in enumerate(zip(tenants, names))]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    result = {"size": size, "steps": steps, "tenants": {}}
+    for t, key in zip(tenants, names):
+        stats = t.lane_stats()
+        result["tenants"][key] = {
+            "elapsed_s": round(out[key]["elapsed_s"], 4),
+            "ops_per_s": round(steps / out[key]["elapsed_s"], 2),
+            "cycles": stats["cycles"],
+            "deferrals": stats["deferrals"],
+            "weight": stats["weight"],
+        }
+    if "peer_cycles_at_first_done" in out:
+        result["peer_cycles_at_first_done"] = \
+            out["peer_cycles_at_first_done"]
+        result["first_cycles"] = \
+            result["tenants"][names[0]]["cycles"]
+    for t in tenants:
+        t.shutdown()
+    if rank == 0:
+        print("RESULT " + json.dumps(result), flush=True)
+    hvd.shutdown()
+
+
+def _multitenant_bench_section(np_: int) -> dict:
+    """Shared-fleet throughput (isolated-leg protocol, alternating
+    reps so adjacent runs share this throttling host's phase) and the
+    priority-weight cycle-share shift (saturated legs, equal weights
+    vs 3:1)."""
+    reps = 2
+    iso_rates, shared = [], []
+    for _ in range(reps):
+        iso = _run_world("multitenant", np_, timeout=300.0,
+                         extra_env={"HVD_BENCH_TENANTS": "1"})
+        iso_rates.append(iso["tenants"]["jobA"]["ops_per_s"])
+        sh = _run_world("multitenant", np_, timeout=300.0,
+                        extra_env={"HVD_BENCH_TENANTS": "2"})
+        shared.append(sh)
+    iso_rate = _quantiles(iso_rates)[1]
+    ratios_a = [s["tenants"]["jobA"]["ops_per_s"] / iso_rate
+                for s in shared]
+    ratios_b = [s["tenants"]["jobB"]["ops_per_s"] / iso_rate
+                for s in shared]
+    ratio_a = _quantiles(ratios_a)[1]
+    ratio_b = _quantiles(ratios_b)[1]
+
+    def _share(weights: str) -> dict:
+        r = _run_world("multitenant", np_, timeout=300.0,
+                       extra_env={"HVD_BENCH_TENANTS": "2",
+                                  "HVD_BENCH_WEIGHTS": weights,
+                                  "HVD_BENCH_SATURATE": "1",
+                                  "HVD_BENCH_STEPS": "400"})
+        peer = max(1, r["peer_cycles_at_first_done"])
+        return {"first_cycles": r["first_cycles"],
+                "peer_cycles_at_first_done": peer,
+                "share": round(r["first_cycles"] / peer, 3),
+                "light_deferrals":
+                    r["tenants"]["jobB"]["deferrals"]}
+
+    equal = _share("1,1")
+    skewed = _share("3,1")
+    shift = round(skewed["share"] / max(0.01, equal["share"]), 3)
+    return {
+        "np": np_,
+        "protocol": "isolated-leg alternating reps; 64KiB f32 op + "
+                    "5ms think per step (paced legs); saturated "
+                    "4-deep async pipeline for the share legs",
+        "isolated_ops_per_s": iso_rate,
+        "shared_ops_per_s": {
+            "jobA": _quantiles(
+                [s["tenants"]["jobA"]["ops_per_s"]
+                 for s in shared])[1],
+            "jobB": _quantiles(
+                [s["tenants"]["jobB"]["ops_per_s"]
+                 for s in shared])[1]},
+        "shared_vs_isolated": {"jobA": round(ratio_a, 3),
+                               "jobB": round(ratio_b, 3)},
+        "min_tenant_fraction": round(min(ratio_a, ratio_b), 3),
+        "meets_60pct": bool(min(ratio_a, ratio_b) >= 0.6),
+        "cycle_share_equal_weights": equal,
+        "cycle_share_3to1": skewed,
+        "share_shift_3to1_vs_equal": shift,
+        "weights_shift_share": bool(shift > 1.15
+                                    and skewed["light_deferrals"] > 0),
+    }
+
+
 def worker_overlap(rank: int, size: int) -> None:
     """Overlap-tier section: a steady training-shaped loop whose
     backward pass is modeled by injected compute (sleep — it releases
@@ -1790,7 +1940,7 @@ def main() -> None:
                              "overhead", "autotune_value", "cache",
                              "elastic", "compression",
                              "compression_autotune", "overlap",
-                             "trace_toggle"])
+                             "trace_toggle", "multitenant"])
     ap.add_argument("--rank", type=int)
     ap.add_argument("--size", type=int)
     ap.add_argument("--skip-variants", action="store_true",
@@ -1825,6 +1975,13 @@ def main() -> None:
                          "compute calibrated to wire time; isolated + "
                          "simultaneous-pair protocols) and merge it "
                          "into RESULTS_cpu.json")
+    ap.add_argument("--multitenant", action="store_true",
+                    help="run just the multi-tenant section (two "
+                         "tenants sharing one fleet vs an isolated "
+                         "single-tenant baseline, isolated-leg "
+                         "protocol, plus the 3:1 priority-weight "
+                         "cycle-share shift) and merge it into "
+                         "RESULTS_cpu.json")
     ap.add_argument("--compression", action="store_true",
                     help="run just the wire-compression/two-level "
                          "grid ((algorithm x dtype x bucket) medians "
@@ -1847,6 +2004,7 @@ def main() -> None:
          "compression_autotune": worker_compression_autotune,
          "overlap": worker_overlap,
          "trace_toggle": worker_trace_toggle,
+         "multitenant": worker_multitenant,
          "overhead": worker_overhead}[args.worker](
              args.rank, args.size)
         return
@@ -1874,6 +2032,29 @@ def main() -> None:
             json.dump(merged, fh, indent=2)
             fh.write("\n")
         print(f"merged elastic_recovery into {results_path}")
+        return
+
+    if args.multitenant:
+        np_mt = min(np_, 4)  # ws>=4 per acceptance; 2 runtimes/proc
+        print(f"== multi-tenant shared fleet (np={np_mt}, two tenants "
+              f"spanning all ranks) ==", flush=True)
+        mt = _multitenant_bench_section(np_mt)
+        print(f"  isolated {mt['isolated_ops_per_s']} ops/s   shared "
+              f"A {mt['shared_vs_isolated']['jobA']:.0%} / B "
+              f"{mt['shared_vs_isolated']['jobB']:.0%} of isolated "
+              f"(>=60% pass={mt['meets_60pct']})   3:1 share shift "
+              f"{mt['share_shift_3to1_vs_equal']}x vs equal weights "
+              f"(pass={mt['weights_shift_share']})", flush=True)
+        try:
+            with open(results_path) as fh:
+                merged = json.load(fh)
+        except (OSError, ValueError):
+            merged = {}
+        merged["multitenant"] = mt
+        with open(results_path, "w") as fh:
+            json.dump(merged, fh, indent=2)
+            fh.write("\n")
+        print(f"merged multitenant into {results_path}")
         return
 
     if args.compression:

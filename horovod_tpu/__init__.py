@@ -74,6 +74,14 @@ from horovod_tpu.common.status import (
 # training continues (upstream analog: Elastic Horovod, v0.20).
 from horovod_tpu.common import elastic
 
+# Multi-tenant collective service (docs/multitenancy.md):
+# hvd.create_tenant runs several jobs' sub-worlds concurrently on one
+# warm fleet under QoS-weighted scheduling; hvd.service attaches jobs
+# to an hvdtpurun --service fleet and pulls parameter snapshots over
+# a broadcast fanout, with no fleet re-rendezvous.
+from horovod_tpu.common import tenancy as service
+from horovod_tpu.common.tenancy import Tenant, create_tenant
+
 __all__ = [
     "HorovodInternalError", "WorldAbortedError",
     "__version__",
@@ -90,4 +98,5 @@ __all__ = [
     "Average", "Sum",
     "Compression",
     "elastic",
+    "Tenant", "create_tenant", "service",
 ]

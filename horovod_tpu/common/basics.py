@@ -9,11 +9,19 @@ Identity comes from the launcher's env (``HOROVOD_RANK``/``HOROVOD_SIZE``
 horovod_tpu/run) the way the reference reads MPI's; with no env set,
 ``init()`` brings up a size-1 world, which still runs the full cycle
 loop so async semantics/fusion/timeline behave identically at any size.
+
+Multi-tenancy (common/tenancy.py, docs/multitenancy.md): one process
+may host SEVERAL runtimes at once — the default world built here plus
+any tenants created with ``create_tenant``. The module-level ops API
+routes through :func:`active_runtime`, which a tenant's ``use()``
+scope (a contextvar, so thread- and task-safe) points at its own
+runtime; everything else keeps reading the default world.
 """
 
 from __future__ import annotations
 
 import atexit
+import contextvars
 from typing import Optional
 
 from horovod_tpu.common import lockdep
@@ -31,6 +39,13 @@ from horovod_tpu.ops.xla_ops import XlaMeshBackend
 _lock = lockdep.lock("basics._lock")
 _runtime: Optional[Runtime] = None
 
+# The runtime the module-level ops API targets in THIS context: a
+# tenant scope (tenancy.Tenant.use) sets it; None means the default
+# world. A contextvar (not a plain global) so two threads driving two
+# tenants never race each other's routing.
+_active_runtime: "contextvars.ContextVar[Optional[Runtime]]" = \
+    contextvars.ContextVar("horovod_tpu_active_runtime", default=None)
+
 
 def _require_runtime() -> Runtime:
     if _runtime is None:
@@ -39,16 +54,127 @@ def _require_runtime() -> Runtime:
     return _runtime
 
 
+def active_runtime() -> Runtime:
+    """The runtime ops should target: the tenant scoped in via
+    ``Tenant.use()`` when inside one, the default world otherwise."""
+    rt = _active_runtime.get()
+    return rt if rt is not None else _require_runtime()
+
+
+def active_scope() -> str:
+    """Auto-name counter scope of the active runtime ('' = default
+    world) — per-tenant scoping keeps each tenant's
+    ``<op>.noname.<n>`` sequence world-consistent no matter how its
+    co-tenants' submissions interleave in this process."""
+    rt = _active_runtime.get()
+    return rt._tenant if rt is not None else ""
+
+
+def _is_full_world(ranks, env_size: int) -> bool:
+    """True when a comm list names the ENTIRE launched world — that
+    sub-world IS the default world and may keep its env endpoint
+    (and the launcher's reserved listener fd)."""
+    return env_size > 0 and ranks == list(range(env_size))
+
+
+def _build_runtime(cfg: Config, coordinator_listener=None,
+                   elastic_ctx=None) -> Runtime:
+    """Construct and start one runtime from a fully-resolved Config:
+    controller (with the world id + tenant descriptor in the
+    handshake), backends, op manager, autotuner. Shared by init()
+    (the default world) and tenancy.create_tenant (tenant worlds —
+    several may coexist in one process; nothing here touches module
+    globals)."""
+    secret = cfg.secret_key.encode() if cfg.secret_key else b""
+    size = cfg.size if cfg.size > 0 else 1
+    rank = cfg.rank if cfg.rank >= 0 else 0
+    elastic_port = elastic_ctx.port if elastic_ctx is not None \
+        and size > 1 else None
+
+    tenant_desc = None
+    if cfg.world_id and rank == 0:
+        from horovod_tpu.common import tenancy as _tenancy
+        tenant_desc = _tenancy.descriptor_of(cfg)
+
+    if size == 1:
+        controller: Controller = LocalController()
+    elif rank == 0:
+        listener = coordinator_listener
+        if listener is None and cfg.controller_fd >= 0:
+            import socket as _socket
+            listener = _socket.socket(fileno=cfg.controller_fd)
+        coord = TcpCoordinator(size, port=cfg.controller_port,
+                               secret=secret,
+                               start_timeout=cfg.start_timeout,
+                               listener=listener,
+                               hierarchical=cfg.hier_controller,
+                               heartbeat_interval=cfg.heartbeat_interval_s,
+                               heartbeat_timeout=cfg.heartbeat_timeout_s,
+                               elastic_port=elastic_port,
+                               world_id=cfg.world_id,
+                               tenant_desc=tenant_desc)
+        coord.accept_workers()
+        controller = coord
+    else:
+        if not cfg.controller_addr or not cfg.controller_port:
+            raise ValueError(
+                "HOROVOD_CONTROLLER_ADDR/PORT must be set for "
+                "multi-process init (use the hvdtpurun launcher).")
+        controller = TcpWorker(rank, size, cfg.controller_addr,
+                               cfg.controller_port, secret=secret,
+                               start_timeout=cfg.start_timeout,
+                               heartbeat_interval=cfg.heartbeat_interval_s,
+                               heartbeat_timeout=cfg.heartbeat_timeout_s,
+                               elastic_port=elastic_port,
+                               world_id=cfg.world_id)
+
+    # Install the world-identical elastic membership (the
+    # coordinator's broadcast endpoint map) for this generation.
+    endpoints = getattr(controller, "elastic_endpoints", None)
+    if elastic_ctx is not None and endpoints is not None:
+        table = dict(endpoints)
+        host0, port0 = table[0]
+        if not host0:  # the coordinator's own placeholder entry
+            table[0] = (cfg.controller_addr or "127.0.0.1", port0)
+        elastic_ctx.apply_membership(
+            elastic_ctx.membership.generation, controller.rank,
+            controller.size, table)
+
+    from horovod_tpu.ops.shm_ops import ShmBackend
+    socket_backend = SocketBackend(controller, secret=secret,
+                                   config=cfg)
+    backends = [
+        XlaMeshBackend(controller, config=cfg),
+        ShmBackend(controller, fallback=socket_backend, config=cfg,
+                   secret=secret),
+        socket_backend,
+        LocalBackend(lambda: controller.size),
+    ]
+    op_manager = OperationManager(backends)
+
+    parameter_manager = None
+    if cfg.autotune:
+        from horovod_tpu.common.parameter_manager import ParameterManager
+        parameter_manager = ParameterManager(cfg, controller)
+
+    rt = Runtime(cfg, controller, op_manager, parameter_manager)
+    rt.start()
+    return rt
+
+
 def init(comm=None, config: Optional[Config] = None,
          coordinator_listener=None) -> None:
     """Initialize the runtime. ``comm`` accepts either a (rank, size)
     TUPLE for explicit worlds, or a LIST of global ranks forming a
     sub-world (reference: common/__init__.py:58-84 init(comm=ranks)):
     members are renumbered 0..len-1 in list order, the first listed
-    rank's process hosts the sub-world's coordinator on the configured
-    controller port, and processes NOT in the list come up as size-1
-    worlds so they can keep doing local work while the subset runs
-    collectives. With ``comm=None`` identity comes from the environment.
+    rank's process hosts the sub-world's coordinator on a port derived
+    from the membership, and processes NOT in the list come up as
+    size-1 worlds so they can keep doing local work while the subset
+    runs collectives. With ``comm=None`` identity comes from the
+    environment. (For CONCURRENT sub-worlds with QoS scheduling and
+    per-tenant observability, use ``hvd.create_tenant`` —
+    docs/multitenancy.md.)
 
     ``coordinator_listener`` (rank 0 only) — an already-bound listening
     socket for the coordinator to adopt, closing the reserve/release/
@@ -71,14 +197,16 @@ def init(comm=None, config: Optional[Config] = None,
         _wd.set_active(_wd.wire_code_of(cfg.compression))
         if isinstance(comm, list):
             ranks = [int(r) for r in comm]
+            env_size = cfg.size
             g_rank = cfg.rank if cfg.rank >= 0 else 0
-            # An inherited coordinator fd (launcher-reserved) serves the
-            # FULL world's published endpoint; it is only valid when this
-            # process leads a sub-world anchored at global rank 0. Close
-            # it otherwise or it lingers as a dead listener that eats the
+            full_world = _is_full_world(ranks, env_size)
+            # An inherited coordinator fd (launcher-reserved) serves
+            # the FULL world's published endpoint; it is only valid
+            # when this process leads that full world. Close it
+            # otherwise or it lingers as a dead listener that eats the
             # port and black-holes connects.
-            if cfg.controller_fd >= 0 and not (
-                    ranks and ranks[0] == 0 and g_rank == 0):
+            if cfg.controller_fd >= 0 and not (full_world
+                                               and g_rank == 0):
                 import os as _os
                 try:
                     _os.close(cfg.controller_fd)
@@ -88,16 +216,25 @@ def init(comm=None, config: Optional[Config] = None,
             if g_rank in ranks:
                 cfg.rank = ranks.index(g_rank)
                 cfg.size = len(ranks)
-                if ranks[0] != 0 and cfg.controller_port:
-                    # The env endpoint belongs to global rank 0, which is
-                    # NOT in this sub-world: derive a deterministic
-                    # per-subset port so the sub-coordinator never
-                    # collides with the full world's listener. On
-                    # multi-host launches where the first listed rank is
-                    # not on the env-addr host, set
-                    # HOROVOD_CONTROLLER_ADDR to that rank's host before
-                    # calling init.
-                    cfg.controller_port += 1 + (ranks[0] % 997)
+                if not full_world and cfg.controller_port:
+                    # The env endpoint belongs to the full world:
+                    # derive a per-membership port (tenancy.py) so a
+                    # sub-coordinator never collides with the full
+                    # world's listener OR another sub-world's — the
+                    # old first-rank-only derivation collided for two
+                    # subsets sharing a first rank, and a subset
+                    # anchored at global rank 0 squatted the fleet
+                    # port itself. Every member derives identically
+                    # from the full list; the world id below turns
+                    # any residual collision into a named handshake
+                    # error. On multi-host launches where the first
+                    # listed rank is not on the env-addr host, set
+                    # HOROVOD_CONTROLLER_ADDR to that rank's host
+                    # before calling init.
+                    from horovod_tpu.common import tenancy as _tenancy
+                    cfg.controller_port = _tenancy.derive_subworld_port(
+                        cfg.controller_port, "", ranks)
+                    cfg.world_id = _tenancy.derive_world_id("", ranks)
             else:
                 cfg.rank, cfg.size = 0, 1
         elif comm is not None:
@@ -123,76 +260,21 @@ def init(comm=None, config: Optional[Config] = None,
             if cfg.size > 1 or cfg.size <= 0:
                 elastic_ctx = _elastic.ensure_context(cfg, secret)
 
-        size = cfg.size if cfg.size > 0 else 1
-        rank = cfg.rank if cfg.rank >= 0 else 0
-        elastic_port = elastic_ctx.port if elastic_ctx is not None \
-            and size > 1 else None
-
-        if size == 1:
-            controller: Controller = LocalController()
-        elif rank == 0:
-            listener = coordinator_listener
-            if listener is None and cfg.controller_fd >= 0:
-                import socket as _socket
-                listener = _socket.socket(fileno=cfg.controller_fd)
-            coord = TcpCoordinator(size, port=cfg.controller_port,
-                                   secret=secret,
-                                   start_timeout=cfg.start_timeout,
-                                   listener=listener,
-                                   hierarchical=cfg.hier_controller,
-                                   heartbeat_interval=cfg.heartbeat_interval_s,
-                                   heartbeat_timeout=cfg.heartbeat_timeout_s,
-                                   elastic_port=elastic_port)
-            coord.accept_workers()
-            controller = coord
-        else:
-            if not cfg.controller_addr or not cfg.controller_port:
-                raise ValueError(
-                    "HOROVOD_CONTROLLER_ADDR/PORT must be set for "
-                    "multi-process init (use the hvdtpurun launcher).")
-            controller = TcpWorker(rank, size, cfg.controller_addr,
-                                   cfg.controller_port, secret=secret,
-                                   start_timeout=cfg.start_timeout,
-                                   heartbeat_interval=cfg.heartbeat_interval_s,
-                                   heartbeat_timeout=cfg.heartbeat_timeout_s,
-                                   elastic_port=elastic_port)
-
-        # Install the world-identical elastic membership (the
-        # coordinator's broadcast endpoint map) for this generation.
-        endpoints = getattr(controller, "elastic_endpoints", None)
-        if elastic_ctx is not None and endpoints is not None:
-            table = dict(endpoints)
-            host0, port0 = table[0]
-            if not host0:  # the coordinator's own placeholder entry
-                table[0] = (cfg.controller_addr or "127.0.0.1", port0)
-            elastic_ctx.apply_membership(
-                elastic_ctx.membership.generation, controller.rank,
-                controller.size, table)
-
-        from horovod_tpu.ops.shm_ops import ShmBackend
-        socket_backend = SocketBackend(controller, secret=secret,
-                                       config=cfg)
-        backends = [
-            XlaMeshBackend(controller, config=cfg),
-            ShmBackend(controller, fallback=socket_backend, config=cfg,
-                       secret=secret),
-            socket_backend,
-            LocalBackend(lambda: controller.size),
-        ]
-        op_manager = OperationManager(backends)
-
-        parameter_manager = None
-        if cfg.autotune:
-            from horovod_tpu.common.parameter_manager import ParameterManager
-            parameter_manager = ParameterManager(cfg, controller)
-
-        rt = Runtime(cfg, controller, op_manager, parameter_manager)
-        rt.start()
+        rt = _build_runtime(cfg,
+                            coordinator_listener=coordinator_listener,
+                            elastic_ctx=elastic_ctx)
         _runtime = rt
         from horovod_tpu import ops
-        ops.reset_name_counters()
-        hlog.debug(f"horovod_tpu initialized: rank {controller.rank} of "
-                   f"{controller.size}", rank=controller.rank)
+        ops.reset_name_counters("")
+        # Service mode (docs/multitenancy.md): rank 0 of a --service
+        # fleet opens the tenant gate so jobs can attach/detach and
+        # pull parameter snapshots without the fleet re-rendezvousing.
+        if cfg.service_enabled and not cfg.world_id \
+                and rt.controller.rank == 0:
+            from horovod_tpu.common import tenancy as _tenancy
+            _tenancy.start_service_gate(cfg, secret)
+        hlog.debug(f"horovod_tpu initialized: rank {rt.controller.rank}"
+                   f" of {rt.controller.size}", rank=rt.controller.rank)
 
 
 def shutdown() -> None:
@@ -209,6 +291,8 @@ def shutdown() -> None:
         _runtime = None
         from horovod_tpu.common import wire_dtype as _wd
         _wd.set_active(_wd.WIRE_NONE)
+    from horovod_tpu.common import tenancy as _tenancy
+    _tenancy.stop_service_gate()
 
 
 atexit.register(shutdown)
@@ -224,34 +308,34 @@ def runtime() -> Runtime:
 
 
 def rank() -> int:
-    return _require_runtime().controller.topology.rank
+    return active_runtime().controller.topology.rank
 
 
 def size() -> int:
-    return _require_runtime().controller.topology.size
+    return active_runtime().controller.topology.size
 
 
 def local_rank() -> int:
-    return _require_runtime().controller.topology.local_rank
+    return active_runtime().controller.topology.local_rank
 
 
 def local_size() -> int:
-    return _require_runtime().controller.topology.local_size
+    return active_runtime().controller.topology.local_size
 
 
 def cross_rank() -> int:
     """Rank among hosts (reference: global_state.h cross_rank)."""
-    return _require_runtime().controller.topology.cross_rank
+    return active_runtime().controller.topology.cross_rank
 
 
 def cross_size() -> int:
-    return _require_runtime().controller.topology.cross_size
+    return active_runtime().controller.topology.cross_size
 
 
 def is_homogeneous() -> bool:
     """True when every host runs the same number of ranks
     (reference: operations.cc:741-757)."""
-    return _require_runtime().controller.topology.is_homogeneous
+    return active_runtime().controller.topology.is_homogeneous
 
 
 def metrics() -> dict:
@@ -262,8 +346,10 @@ def metrics() -> dict:
     materializes only on rank 0 (the fold point); ``http_port`` is the
     live Prometheus endpoint's bound port when
     HOROVOD_TPU_METRICS_PORT enabled it. With metrics disabled the
-    snapshots are empty and ``enabled`` is False."""
-    return _require_runtime().metrics_view()
+    snapshots are empty and ``enabled`` is False. Inside a tenant
+    scope this is the TENANT's view, with every series carrying its
+    ``tenant`` label."""
+    return active_runtime().metrics_view()
 
 
 def coordinator_threads_supported() -> bool:

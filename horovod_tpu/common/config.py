@@ -325,6 +325,26 @@ class Config:
     elastic_join_addr: str = ""
     elastic_join_port: int = 0
 
+    # Multi-tenant collective service (docs/multitenancy.md,
+    # common/tenancy.py). A TENANT sub-world (hvd.create_tenant) gets
+    # a nonzero world_id stamped on every control frame and a name
+    # labelling its metrics/trace series; weight and quotas feed the
+    # process-local QoS scheduler interleaving concurrent tenants'
+    # negotiation cycles. The coordinator's weight/quota values are
+    # broadcast in the handshake and win over rank-local env (like
+    # the fusion threshold), so scheduling state is world-replicated.
+    world_id: int = 0      # derived, never read from env
+    tenant_name: str = ""  # derived, never read from env
+    tenant_weight: float = 1.0
+    tenant_quota_bytes_s: float = 0.0   # 0 = unlimited
+    tenant_quota_cycles_s: float = 0.0  # 0 = unlimited
+    # Service mode (hvdtpurun --service): rank 0 of the default world
+    # opens the tenant service gate — jobs attach/detach and pull
+    # parameter snapshots over a broadcast fanout without the fleet
+    # re-rendezvousing. service_port 0 binds an ephemeral port.
+    service_enabled: bool = False
+    service_port: int = 0
+
     # Elastic/launcher-provided identity (reference: test/common.py:25-57
     # reads OMPI_COMM_WORLD_RANK; we read HOROVOD_RANK/SIZE first).
     rank: int = -1
@@ -447,6 +467,16 @@ class Config:
                                       c.elastic_join_addr)
         c.elastic_join_port = _env_int("HOROVOD_ELASTIC_JOIN_PORT",
                                        c.elastic_join_port)
+        c.tenant_weight = _env_float("HOROVOD_TENANT_WEIGHT",
+                                     c.tenant_weight)
+        c.tenant_quota_bytes_s = _env_float(
+            "HOROVOD_TENANT_QUOTA_BYTES", c.tenant_quota_bytes_s)
+        c.tenant_quota_cycles_s = _env_float(
+            "HOROVOD_TENANT_QUOTA_CYCLES", c.tenant_quota_cycles_s)
+        c.service_enabled = _env_bool("HOROVOD_TPU_SERVICE",
+                                      c.service_enabled)
+        c.service_port = _env_int("HOROVOD_TPU_SERVICE_PORT",
+                                  c.service_port)
         c.rank = _env_int("HOROVOD_RANK", c.rank)
         c.size = _env_int("HOROVOD_SIZE", c.size)
         c.local_rank = _env_int("HOROVOD_LOCAL_RANK", c.local_rank)

@@ -184,11 +184,13 @@ def _maybe_ping(ctl, channels: Dict[int, "network.Channel"],
         return
     ctl._last_ping = now
     ctl._ping_seq += 1
-    if sender_rank == 0:
+    if sender_rank == 0 and not getattr(ctl, "_world_id", 0):
         # Clock-sync t1: the coordinator clock is the world's
         # reference frame, so only rank 0's beacons are recorded
         # (common/trace.py ClockSync; local-root beacons carry their
-        # own clocks and would poison the table).
+        # own clocks and would poison the table — and so would a
+        # TENANT sub-world's coordinator, whose ping sequence is a
+        # different stream than the default world's).
         from horovod_tpu.common import trace as htrace
         htrace.clock().ping_sent(ctl._ping_seq, now)
     payload = heartbeat.encode_ping(sender_rank, ctl._ping_seq)
@@ -846,7 +848,9 @@ class TcpCoordinator(Controller):
                  hierarchical: bool = True,
                  heartbeat_interval: float = 5.0,
                  heartbeat_timeout: float = 30.0,
-                 elastic_port: Optional[int] = None):
+                 elastic_port: Optional[int] = None,
+                 world_id: int = 0,
+                 tenant_desc: Optional[dict] = None):
         """``listener`` — an already-bound listening socket to adopt
         instead of binding ``port``. Launch layers that must publish
         the coordinator endpoint BEFORE init (Spark rendezvous,
@@ -898,6 +902,15 @@ class TcpCoordinator(Controller):
         # map. None = elastic off; populated by accept_workers.
         self._elastic_port = elastic_port
         self.elastic_endpoints: Optional[Dict[int, tuple]] = None
+        # Tenancy (common/tenancy.py): the world id every member must
+        # present in its handshake — a dialer carrying another world's
+        # id (a derived-port collision between two concurrent
+        # sub-worlds) is refused at accept instead of poisoning this
+        # world's gathers. The coordinator's tenant descriptor
+        # (weight/quota knobs) broadcasts with the handshake so
+        # scheduling state is world-replicated from cycle 0.
+        self._world_id = world_id
+        self.tenant_desc = tenant_desc
 
     def accept_workers(self) -> None:
         deadline = time.monotonic() + self._start_timeout
@@ -908,6 +921,12 @@ class TcpCoordinator(Controller):
             r = int(hello["rank"])
             if r <= 0 or r >= self._size or r in self._channels:
                 raise ConnectionError(f"bad or duplicate rank {r}")
+            wid = int(hello.get("world_id", 0))
+            if wid != self._world_id:
+                raise ConnectionError(
+                    f"rank {r} dialed with world id {wid:#010x}; this "
+                    f"coordinator serves world {self._world_id:#010x} "
+                    f"— two sub-worlds are sharing a port")
             hello["hostname"]  # reject (KeyError) if absent
             return r
 
@@ -941,6 +960,10 @@ class TcpCoordinator(Controller):
         hier = (self._hierarchical and topo.cross_size > 1
                 and remote_leaves > 0)
         handshake = {"hostnames": hostnames, "hier": hier}
+        if self._world_id:
+            handshake["world_id"] = self._world_id
+        if self.tenant_desc is not None:
+            handshake["tenant"] = self.tenant_desc
         # Elastic endpoint map: only meaningful when EVERY member runs
         # elastic mode (the knob must be world-uniform, like the cache
         # knobs); a partial map would leave some ranks unreachable at
@@ -1079,7 +1102,12 @@ class TcpCoordinator(Controller):
                 continue
             blob = out[owner]
             if allow_combined:
-                if blob[:1] == wire.CACHED_AGG_PREFIX:
+                # A tenant world's folded aggregate leads with the
+                # world-id envelope; the CACHED_AGG kind byte then
+                # sits right after it (wire.read_world).
+                kind_off = 5 if blob[:1] == wire.TENANT_PREFIX else 0
+                if blob[kind_off:kind_off + 1] == \
+                        wire.CACHED_AGG_PREFIX:
                     for m in members:
                         if m != owner:
                             out[m] = b""
@@ -1582,12 +1610,14 @@ class TcpWorker(Controller):
                  secret: bytes = b"", start_timeout: float = 30.0,
                  heartbeat_interval: float = 5.0,
                  heartbeat_timeout: float = 30.0,
-                 elastic_port: Optional[int] = None):
+                 elastic_port: Optional[int] = None,
+                 world_id: int = 0):
         self.coordinator_addr = addr  # rank 0's reachable address
         self._hb_interval = heartbeat_interval
         self._hb_timeout = heartbeat_timeout
         self._ping_seq = 0
         self._last_ping = 0.0
+        self._world_id = world_id
         self._up_rank = 0  # who the upward channel talks to
         self._ch = network.connect(addr, port, secret,
                                    timeout=start_timeout,
@@ -1596,12 +1626,26 @@ class TcpWorker(Controller):
         hello_d = {"rank": rank, "hostname": _my_hostname()}
         if elastic_port is not None:
             hello_d["elastic_port"] = elastic_port
+        if world_id:
+            hello_d["world_id"] = world_id
         hello = json.dumps(hello_d).encode()
         self._ch.send(hello, TAG_HANDSHAKE)
         tag, payload = self._ch.recv()
         if tag != TAG_HANDSHAKE:
             raise ConnectionError("handshake failed")
         info = json.loads(payload.decode())
+        coord_wid = int(info.get("world_id", 0))
+        if coord_wid != world_id:
+            raise ConnectionError(
+                f"dialed a coordinator serving world {coord_wid:#010x} "
+                f"while joining world {world_id:#010x} — two "
+                f"sub-worlds are sharing a port (check the derived "
+                f"sub-world coordinator ports)")
+        # Tenant descriptor broadcast by the coordinator (tenancy.py):
+        # the world-replicated scheduling knobs — the coordinator's
+        # values win over any rank-local env, like the fusion
+        # threshold.
+        self.tenant_desc = info.get("tenant")
         hostnames = info["hostnames"]
         # Elastic re-rendezvous endpoint map (rank 0's host is the
         # address this worker dialed — provably reachable from here).
@@ -1820,11 +1864,18 @@ class TcpWorker(Controller):
                 self._m_ctrl_rx.inc(len(data))
             return data
 
-    @staticmethod
-    def _note_ping(data: bytes) -> None:
+    def _note_ping(self, data: bytes) -> None:
         """Clock-sync t2: a coordinator PING's receipt stamp, the
         worker half of the NTP exchange (common/trace.py). Garbled
-        pings are liveness regardless — never an error here."""
+        pings are liveness regardless — never an error here. Tenant
+        workers skip the note entirely (the send-side guard in
+        _maybe_ping has this as its receive-side mirror): a TENANT
+        coordinator's ping sequence is a different stream, and its
+        (sender==0, seq) stamps would overwrite the process-global
+        ClockSync's pending echo and poison the DEFAULT world's
+        offset table."""
+        if self._world_id:
+            return
         try:
             sender, seq = heartbeat.decode_ping(data)
         except ValueError:

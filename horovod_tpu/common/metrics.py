@@ -223,16 +223,32 @@ class MetricsRegistry:
 
     enabled = True
 
-    def __init__(self):
+    def __init__(self, const_labels: "Optional[Dict[str, str]]" = None):
+        """``const_labels`` (e.g. ``{"tenant": "jobA"}``) are folded
+        into every registered metric's labeled name, so a tenant
+        sub-world's series stay distinct from the default world's and
+        from other tenants' on every read surface (/metrics,
+        hvd.metrics(), the control-tree world fold)."""
         self._lock = lockdep.lock("metrics.MetricsRegistry._lock")
         self._metrics: "Dict[str, object]" = {}
         self._collectors: List[Callable[[], None]] = []
+        self._const_labels = dict(const_labels or {})
+
+    def _labeled(self, name: str) -> str:
+        if not self._const_labels:
+            return name
+        extra = ",".join(f'{k}="{v}"'
+                         for k, v in sorted(self._const_labels.items()))
+        base, labels = _split_labels(name)
+        labels = f"{labels},{extra}" if labels else extra
+        return f"{base}{{{labels}}}"
 
     def _get(self, name: str, factory, kind):
+        name = self._labeled(name)
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
-                m = factory()
+                m = factory(name)
                 self._metrics[name] = m
             elif not isinstance(m, kind):
                 raise ValueError(
@@ -241,11 +257,11 @@ class MetricsRegistry:
             return m
 
     def counter(self, name: str, help: str = "") -> Counter:
-        return self._get(name, lambda: Counter(name, help), Counter)
+        return self._get(name, lambda n: Counter(n, help), Counter)
 
     def gauge(self, name: str, help: str = "",
               agg: str = AGG_SUM) -> Gauge:
-        g = self._get(name, lambda: Gauge(name, help, agg), Gauge)
+        g = self._get(name, lambda n: Gauge(n, help, agg), Gauge)
         if g.agg != agg:
             # agg is part of the metric's identity (merge_into fails
             # loudly on it cross-rank) — the same must hold within a
@@ -258,7 +274,7 @@ class MetricsRegistry:
     def histogram(self, name: str, help: str = "",
                   buckets: Tuple[float, ...] = LATENCY_BUCKETS
                   ) -> Histogram:
-        h = self._get(name, lambda: Histogram(name, help, buckets),
+        h = self._get(name, lambda n: Histogram(n, help, buckets),
                       Histogram)
         if h.bounds != tuple(float(b) for b in buckets):
             raise ValueError(
@@ -548,7 +564,13 @@ class JsonlMetricsLog:
             self._dead = True
 
 
-def create_registry(enabled: bool):
+def create_registry(enabled: bool, tenant: str = ""):
     """The registry for one runtime: a real one when the metrics plane
-    is on, the shared no-op otherwise — mirroring create_timeline."""
-    return MetricsRegistry() if enabled else NOOP_REGISTRY
+    is on, the shared no-op otherwise — mirroring create_timeline.
+    ``tenant`` labels every metric of a tenant sub-world's runtime
+    (common/tenancy.py) so per-tenant bytes/cycles/queue-depth stay
+    separable on every read surface."""
+    if not enabled:
+        return NOOP_REGISTRY
+    return MetricsRegistry(
+        const_labels={"tenant": tenant} if tenant else None)

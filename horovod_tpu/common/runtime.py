@@ -61,6 +61,27 @@ from horovod_tpu.common.timeline import (
 from horovod_tpu.ops.operation_manager import OperationManager
 
 
+def _merge_tenant_worlds(world: Dict) -> Dict:
+    """Fold the world views of every tenant whose coordinator lives
+    in THIS process into a copy of the default world's view. Tenant
+    series carry their tenant label, so the merge never collides;
+    docs/multitenancy.md describes which surface shows which tenant."""
+    from horovod_tpu.common import tenancy as _tenancy
+    merged = dict(world)
+    for t in _tenancy.tenants().values():
+        rt = t._runtime
+        agg = getattr(rt, "_aggregator", None) if rt is not None \
+            else None
+        if agg is None:
+            continue
+        try:
+            agg.update_local(rt.metrics.snapshot())
+            hmetrics.merge_into(merged, agg.world())
+        except Exception:
+            pass  # a tenant mid-teardown must not break the scrape
+    return merged
+
+
 class Runtime:
     """Process-global state + background thread
     (reference: HorovodGlobalState, common/global_state.h:33-136)."""
@@ -79,6 +100,15 @@ class Runtime:
             self.timeline = create_timeline(config.timeline_path,
                                             config.timeline_mark_cycles)
         op_manager.attach_timeline(self.timeline)
+        # Tenancy (common/tenancy.py): a tenant sub-world stamps every
+        # cycle frame with its world id (wire.stamp_world) and paces
+        # its coordinator-bound cycles through the process-local
+        # tenant scheduler lane bound by bind_tenant_lane. world_id 0
+        # (the default world) keeps the wire byte-identical to every
+        # earlier build and every hook a no-op.
+        self._world_id = int(getattr(config, "world_id", 0))
+        self._tenant = getattr(config, "tenant_name", "")
+        self._tenant_lane = None
         self._dtypes: Dict[str, DataType] = {}
         # name -> elements per dim-0 row, for allgather fusion byte
         # accounting (reference: TotalByteSizeOfAllgatherOutput).
@@ -353,7 +383,8 @@ class Runtime:
         # no-op metric — same zero-overhead contract as _NoOpTimeline;
         # _metrics_on additionally gates the extra clock reads so the
         # disabled hot path does not even pay a time.monotonic().
-        self.metrics = hmetrics.create_registry(config.metrics_enabled)
+        self.metrics = hmetrics.create_registry(config.metrics_enabled,
+                                                tenant=self._tenant)
         self._metrics_on = bool(config.metrics_enabled)
         reg = self.metrics
         self._m_cycle_s = reg.histogram(
@@ -474,8 +505,15 @@ class Runtime:
                     controller.size)
                 controller.metrics_sink = self._aggregator.ingest
                 if config.metrics_port >= 0:
+                    world_fn = self._aggregator.world
+                    if not self._world_id:
+                        # The fleet's /metrics also scrapes its
+                        # co-located tenants (series are
+                        # tenant-labelled; see metrics_view).
+                        world_fn = (lambda base=self._aggregator.world:
+                                    _merge_tenant_worlds(base()))
                     self._metrics_http = hmetrics.MetricsHTTPServer(
-                        self._aggregator.world, config.metrics_port,
+                        world_fn, config.metrics_port,
                         host=config.metrics_addr)
                 if config.metrics_log:
                     self._metrics_log = hmetrics.JsonlMetricsLog(
@@ -496,10 +534,18 @@ class Runtime:
         # HOROVOD_TPU_FLIGHT=0), process-lifetime singleton so a
         # postmortem spans elastic generations.
         self._flight = htrace.flight()
-        self._flight.set_identity(controller.rank)
+        if self._world_id:
+            # Tenant sub-world: the process-lifetime recorder keeps
+            # the default world's rank identity; tenants register in
+            # the header's worlds map instead.
+            self._flight.note_world(self._world_id, self._tenant,
+                                    controller.rank)
+        else:
+            self._flight.set_identity(controller.rank)
         htrace.install_sigusr2()
         # Span collection + the world-identical cycle sequence number.
-        self._trace = htrace.create_collector(bool(config.trace_path))
+        self._trace = htrace.create_collector(bool(config.trace_path),
+                                              tenant=self._tenant)
         self._trace_on = self._trace.enabled
         self._world_cycle = 0
         self._trace_last_pub = 0.0
@@ -528,6 +574,13 @@ class Runtime:
                     gen = 0
                 if gen:
                     trace_path = f"{trace_path}.gen{gen}"
+                if self._world_id:
+                    # A tenant's rank-0 writer must never share (and
+                    # truncate) the default world's file — same
+                    # collision class the .genN suffix solves for
+                    # elastic re-inits.
+                    trace_path = (f"{trace_path}."
+                                  f"{self._tenant or hex(self._world_id)}")
                 self._trace_writer = htrace.WorldTraceWriter(trace_path)
                 controller.trace_sink = self._trace_writer.ingest
             if self._metrics_on or self._trace_on:
@@ -604,6 +657,12 @@ class Runtime:
             # its handle cannot hang forever.
             if self.tensor_table.pop_entry_if_present(entry.tensor_name):
                 return self._terminal_status()
+        if self._tenant_lane is not None:
+            # Backlog hint for the QoS scheduler: queued work makes
+            # this tenant a contender NOW, not only once its cycle
+            # loop reaches acquire (benign unlocked write — the
+            # acquire path re-asserts it under the lock).
+            self._tenant_lane.want = True
         if not self._wake.is_set():
             self._wake.set()  # snap an idle-backed-off loop awake
         return Status.OK()
@@ -650,6 +709,8 @@ class Runtime:
                 if self.tensor_table.pop_entry_if_present(
                         entry.tensor_name) and entry.callback:
                     entry.callback(self._terminal_status())
+        if self._tenant_lane is not None:
+            self._tenant_lane.want = True  # backlog hint (see enqueue)
         if not self._wake.is_set():
             self._wake.set()
         return Status.OK()
@@ -774,6 +835,16 @@ class Runtime:
         self._teardown_started = True
         self._flight.record(htrace.EV_TEARDOWN, self._world_cycle)
         self._done.set()
+        # Tenant lane first (stage-guarded): a dying tenant must stop
+        # counting as a scheduling contender, or its co-tenants would
+        # defer against a ghost until its user-level shutdown ran.
+        if self._tenant_lane is not None:
+            try:
+                from horovod_tpu.common import tenancy as _tenancy
+                _tenancy.scheduler().unregister(self._tenant_lane)
+            except Exception:
+                pass
+            self._tenant_lane = None
         # Overlap runner first: its thread may sit inside a native
         # cycle against channels about to close — stop accepting work,
         # let the armed recv deadline return the call, and join. Any
@@ -926,6 +997,22 @@ class Runtime:
             hold = min(hold, hb / 4.0)
         return hold
 
+    # -- tenancy (common/tenancy.py) -------------------------------------
+    def bind_tenant_lane(self, lane) -> None:
+        """Attach this runtime's lane in the process-local tenant
+        scheduler: cycles with local work acquire the lane (QoS-
+        weighted interleave + quota deferral, bounded far under the
+        heartbeat deadline) and report their negotiated bytes back."""
+        self._tenant_lane = lane
+
+    def _stamp(self, frame: bytes) -> bytes:
+        return wire.stamp_world(frame, self._world_id) \
+            if self._world_id else frame
+
+    def _unstamp(self, frame: bytes) -> bytes:
+        return wire.unstamp_world(frame, self._world_id) \
+            if self._world_id else frame
+
     def _build_request_frame(self, requests: List[Request],
                              shutting_down: bool):
         """Partition this cycle's requests into cache-bitmask bits and
@@ -935,8 +1022,8 @@ class Runtime:
         cache = self._cache
         self._spec_inflight = None
         if cache is None:
-            return wire.serialize_cycle_request(
-                RequestList(requests, shutdown=shutting_down)), []
+            return self._stamp(wire.serialize_cycle_request(
+                RequestList(requests, shutdown=shutting_down))), []
         now = time.monotonic()
         hit_mask = 0
         invalid_mask = 0
@@ -977,18 +1064,19 @@ class Runtime:
             key = (cache.epoch, hit_mask)
             payload = self._frame_memo.get(key)
             if payload is None:
-                payload = wire.serialize_cycle_request(
+                payload = self._stamp(wire.serialize_cycle_request(
                     CacheCycleRequest(
                         epoch=cache.epoch, nslots=cache.nslots,
-                        hit_mask=hit_mask))
+                        hit_mask=hit_mask)))
                 if len(self._frame_memo) >= 64:
                     self._frame_memo.clear()
                 self._frame_memo[key] = payload
             return payload, bit_requests
-        payload = wire.serialize_cycle_request(CacheCycleRequest(
-            epoch=cache.epoch, nslots=cache.nslots, hit_mask=hit_mask,
-            invalid_mask=invalid_mask, requests=uncached,
-            shutdown=shutting_down))
+        payload = self._stamp(wire.serialize_cycle_request(
+            CacheCycleRequest(
+                epoch=cache.epoch, nslots=cache.nslots,
+                hit_mask=hit_mask, invalid_mask=invalid_mask,
+                requests=uncached, shutdown=shutting_down)))
         return payload, bit_requests
 
     def _absorb_burst(self, requests: List[Request]) -> List[Request]:
@@ -1129,9 +1217,10 @@ class Runtime:
                                  fused))
         self._spec_inflight = inflight
         self._spec_bids += 1
-        return wire.serialize_cycle_request(CacheCycleRequest(
-            epoch=cache.epoch, nslots=cache.nslots, hit_mask=hit_mask,
-            spec_payload=segments))
+        return self._stamp(wire.serialize_cycle_request(
+            CacheCycleRequest(
+                epoch=cache.epoch, nslots=cache.nslots,
+                hit_mask=hit_mask, spec_payload=segments)))
 
     def _steady_plan_for(self, hit_mask: int, seg_arrays, seg_wires):
         """Memoized SteadyPlan for (mask, threshold) at the current
@@ -1171,7 +1260,8 @@ class Runtime:
             splan = hsteady.SteadyPlan(
                 cache.epoch, cache.nslots, hit_mask, segments, arena,
                 chunk_bytes=(0 if self.controller.is_coordinator
-                             else self._overlap_chunk))
+                             else self._overlap_chunk),
+                world_id=self._world_id)
             if len(self._steady_plans) >= 64:
                 self._steady_plans.clear()
             self._steady_plans[key] = splan
@@ -1197,8 +1287,8 @@ class Runtime:
                 reply, meta = self._coordinate_cycle(gathered)
                 ctl.broadcast_responses(reply)
             else:
-                meta = wire.parse_cycle_response(
-                    ctl.broadcast_responses(None))
+                meta = wire.parse_cycle_response(self._unstamp(
+                    ctl.broadcast_responses(None)))
             return meta
         kind, val = outcome
         if kind == "done":
@@ -1210,7 +1300,7 @@ class Runtime:
                 epoch=splan.epoch, nslots=splan.nslots,
                 grant_mask=splan.mask, spec_payload=val)
         if kind == "frame":
-            return wire.parse_cycle_response(val)
+            return wire.parse_cycle_response(self._unstamp(val))
         assert kind == "fallback"
         reply, meta = self._coordinate_cycle(val)
         ctl.broadcast_responses(reply)
@@ -1397,10 +1487,10 @@ class Runtime:
                 reply, meta = self._coordinate_cycle(gathered)
                 ctl.broadcast_responses(reply)
             else:
-                meta = wire.parse_cycle_response(
-                    ctl.broadcast_responses(None))
+                meta = wire.parse_cycle_response(self._unstamp(
+                    ctl.broadcast_responses(None)))
         elif kind == "frame":
-            meta = wire.parse_cycle_response(val)
+            meta = wire.parse_cycle_response(self._unstamp(val))
         else:
             assert kind == "fallback"
             reply, meta = self._coordinate_cycle(val)
@@ -1559,6 +1649,18 @@ class Runtime:
             requests = self._split_buckets(requests)
         shutting_down = self._shutdown_requested.is_set()
 
+        if self._tenant_lane is not None and requests \
+                and not shutting_down:
+            # QoS-weighted tenant scheduling (common/tenancy.py): a
+            # cycle with local work waits for this tenant's turn in
+            # the process-local weighted interleave, and an over-quota
+            # tenant is DEFERRED — never skipped, so no frame is ever
+            # lost. The wait is bounded by the same hold rule as every
+            # other hold in this loop (far under the heartbeat
+            # deadline), so a deferred tenant's peers can never
+            # mistake pacing for death.
+            self._tenant_lane.acquire(self._bounded_hold_s(8, 2.0))
+
         if (self._overlap is not None and not requests
                 and not shutting_down
                 and (self._overlap.outstanding or self._steady)):
@@ -1642,7 +1744,7 @@ class Runtime:
                 self.controller.broadcast_responses(reply)
             else:
                 data = self.controller.broadcast_responses(None)
-                meta = wire.parse_cycle_response(data)
+                meta = wire.parse_cycle_response(self._unstamp(data))
         if meta is not None:
             # A world round completed synchronously in this iteration
             # (a submitted overlap cycle completes at drain instead).
@@ -1661,6 +1763,12 @@ class Runtime:
             # bucket. Treat the submit as activity and loop
             # immediately: the next bucket may already be queued.
             self._idle_cycles = 0
+            if self._tenant_lane is not None:
+                self._tenant_lane.note_cycle(self._cycle_bytes)
+                if self.parameter_manager is None:
+                    self._cycle_bytes = 0
+                if self.tensor_table.queue_pending():
+                    self._tenant_lane.want = True  # backlog persists
             if self.parameter_manager is not None:
                 self.parameter_manager.on_cycle(self._cycle_bytes)
                 self._cycle_bytes = 0
@@ -1690,6 +1798,15 @@ class Runtime:
         # Pace the cycle (reference: operations.cc:987-995). The autotuner
         # may be steering cycle_time_ms (reference: parameter_manager.cc).
         cycle_time_ms = self.config.cycle_time_ms
+        if self._tenant_lane is not None:
+            # Report this cycle's negotiated bytes to the tenant
+            # scheduler's quota bucket (the live metrics plane carries
+            # the same totals; the lane prefers whichever is armed).
+            self._tenant_lane.note_cycle(self._cycle_bytes)
+            if self.parameter_manager is None:
+                self._cycle_bytes = 0
+            if self.tensor_table.queue_pending():
+                self._tenant_lane.want = True  # backlog persists
         if self.parameter_manager is not None:
             self.parameter_manager.apply_synced(
                 resp_list.tuned_fusion_threshold_bytes,
@@ -1780,6 +1897,11 @@ class Runtime:
         broadcast payload. Returns (payload, meta) where ``meta`` is
         the ResponseList (cache disabled) or CacheCycleResponse that
         every rank — this one included — applies identically."""
+        if self._world_id:
+            # Tenant world: verify + strip every rank's world-id
+            # envelope before parsing (a mismatched id names both
+            # worlds instead of decoding a foreign mask).
+            gathered = [self._unstamp(f) if f else f for f in gathered]
         cache = self._cache
         if cache is None:
             req_lists = [wire.parse_cycle_request(f)
@@ -1792,7 +1914,8 @@ class Runtime:
                         "HOROVOD_CACHE_ENABLED/HOROVOD_CACHE_CAPACITY "
                         "must be identical on every rank")
             resp_list = self._coordinate(req_lists)
-            return wire.serialize_cycle_response(resp_list), resp_list
+            return self._stamp(
+                wire.serialize_cycle_response(resp_list)), resp_list
         epoch = cache.epoch
         and_hits = -1  # all-ones identity; every rank ANDs one mask in
         or_invalid = 0
@@ -1859,7 +1982,8 @@ class Runtime:
                                       nslots=cache.nslots,
                                       grant_mask=and_hits,
                                       spec_payload=reduced)
-            return wire.serialize_cycle_response(meta), meta
+            return self._stamp(wire.serialize_cycle_response(meta)), \
+                meta
         grant = and_hits & ~or_invalid
         resp_list = self._coordinate(req_lists,
                                      extra_shutdown=shutdown)
@@ -1869,7 +1993,7 @@ class Runtime:
                                   grant_mask=grant,
                                   invalid_mask=or_invalid,
                                   response_list=resp_list)
-        return wire.serialize_cycle_response(meta), meta
+        return self._stamp(wire.serialize_cycle_response(meta)), meta
 
     def _stale_plan_slots(self) -> int:
         """Mask of every cached slot holding an ALLREDUCE verdict —
@@ -2267,7 +2391,15 @@ class Runtime:
                 "world": None, "http_port": None}
         if self._aggregator is not None:
             self._aggregator.update_local(local)
-            view["world"] = self._aggregator.world()
+            world = self._aggregator.world()
+            if not self._world_id:
+                # The fleet's read surface also carries its co-located
+                # tenants' world folds: every tenant series is
+                # tenant-labelled, so the merge is collision-free (a
+                # tenant whose coordinator lives elsewhere appears on
+                # THAT process's surface instead).
+                world = _merge_tenant_worlds(world)
+            view["world"] = world
         if self._metrics_http is not None:
             view["http_port"] = self._metrics_http.port
         return view
@@ -2279,6 +2411,16 @@ class Runtime:
         enough to diagnose without a second tool."""
         parts = [f"world cycle {self._world_cycle}",
                  f"tensor queue depth {len(self.tensor_table)}"]
+        if self._world_id:
+            # Per-tenant line: which job this runtime serves, and how
+            # the process-local scheduler has been treating it — a
+            # starved tenant's stall warning answers "why" inline.
+            line = (f"tenant {self._tenant or '?'} "
+                    f"(world {self._world_id:#010x})")
+            lane = self._tenant_lane
+            if lane is not None:
+                line += ": " + lane.status_line()
+            parts.append(line)
         if self._last_wire_verdict is not None:
             alg, w = self._last_wire_verdict
             parts.append(

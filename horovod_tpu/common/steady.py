@@ -56,7 +56,8 @@ class SteadyPlan:
                  "chunked")
 
     def __init__(self, epoch: int, nslots: int, mask: int,
-                 segments, arena: FusionArena, chunk_bytes: int = 0):
+                 segments, arena: FusionArena, chunk_bytes: int = 0,
+                 world_id: int = 0):
         """``segments``: [(DataType, np_dtype, nbytes, src_np_dtype),
         ...] in replay-plan order, where ``np_dtype``/``nbytes``
         describe the ON-WIRE representation and ``src_np_dtype`` names
@@ -91,10 +92,14 @@ class SteadyPlan:
         # in C; one exotic dtype degrades the whole cycle to Python.
         self.native_ok = bool(segments) and all(c is not None
                                                 for c in codes)
+        # Tenant worlds lead the constant prefix with the world-id
+        # envelope (wire.stamp_world) so the native byte-compare and
+        # the classically-serialized frame stay byte-identical.
         self.prefix, self.seg_hdrs = wire.spec_frame_parts(
             epoch, nslots, mask,
             [(dt, n) for dt, n in zip(self.seg_dtypes,
-                                      self.seg_nbytes)])
+                                      self.seg_nbytes)],
+            world_id=world_id)
         self.payload_nbytes = (len(self.prefix)
                                + sum(len(h) for h in self.seg_hdrs)
                                + sum(self.seg_nbytes))

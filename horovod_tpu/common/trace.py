@@ -249,10 +249,14 @@ class TraceCollector(_NoOpTraceCollector):
     enabled = True
     CAPACITY = 4096
 
-    def __init__(self, capacity: int = CAPACITY):
+    def __init__(self, capacity: int = CAPACITY, tenant: str = ""):
         self._lock = lockdep.lock("trace.TraceCollector._lock")
         self._capacity = capacity
         self._spans: List[tuple] = []
+        # Tenant sub-worlds (common/tenancy.py) prefix every span name
+        # with their tenant id so the merged world trace attributes
+        # each round to its job ("jobA:ROUND" vs "ROUND").
+        self._prefix = f"{tenant}:" if tenant else ""
         self.dropped = 0
 
     def _push(self, span: tuple) -> None:
@@ -264,10 +268,10 @@ class TraceCollector(_NoOpTraceCollector):
 
     def slice(self, name: str, ts: float, dur: float,
               cycle: int) -> None:
-        self._push((SPAN_SLICE, cycle, ts, dur, name))
+        self._push((SPAN_SLICE, cycle, ts, dur, self._prefix + name))
 
     def mark(self, name: str, ts: float, cycle: int) -> None:
-        self._push((SPAN_MARK, cycle, ts, 0.0, name))
+        self._push((SPAN_MARK, cycle, ts, 0.0, self._prefix + name))
 
     def drain(self):
         """-> (spans, dropped_since_last_drain)."""
@@ -277,8 +281,8 @@ class TraceCollector(_NoOpTraceCollector):
         return spans, dropped
 
 
-def create_collector(enabled: bool):
-    return TraceCollector() if enabled else NOOP_TRACE
+def create_collector(enabled: bool, tenant: str = ""):
+    return TraceCollector(tenant=tenant) if enabled else NOOP_TRACE
 
 
 # ---------------------------------------------------------------------------
@@ -294,6 +298,7 @@ class _NoOpRecorder:
 
     def record(self, ev, cycle=0, arg=None, note=""): pass
     def set_identity(self, rank): pass
+    def note_world(self, world_id, tenant, rank): pass
     def events(self): return []
     def dump(self, cause="", origin=-1, path=None): return None
 
@@ -314,6 +319,10 @@ class FlightRecorder(_NoOpRecorder):
         self._ring: List[Optional[tuple]] = [None] * max(8, capacity)
         self._next = 0
         self._rank = hconfig.env_int("HOROVOD_RANK", -1)
+        # Tenant sub-worlds this process is a member of (tenancy.py):
+        # world id -> {"tenant", "rank"}, carried in every dump header
+        # so a postmortem can attribute events to jobs.
+        self._worlds: dict = {}
         self._dumped = 0
 
     def set_identity(self, rank: int) -> None:
@@ -321,6 +330,15 @@ class FlightRecorder(_NoOpRecorder):
         from HOROVOD_RANK stays in the filename — stable across
         elastic renumbering)."""
         self._rank = rank
+
+    def note_world(self, world_id: int, tenant: str,
+                   rank: int) -> None:
+        """Register a tenant sub-world this process joined (the
+        default world keeps set_identity); the recorder is process-
+        lifetime, so the header names every world it ever served."""
+        with self._lock:
+            self._worlds[f"{world_id:#010x}"] = {
+                "tenant": tenant, "rank": rank}
 
     def record(self, ev: int, cycle: int = 0,
                arg: Optional[int] = None, note: str = "") -> None:
@@ -368,6 +386,8 @@ class FlightRecorder(_NoOpRecorder):
                 "pid": os.getpid(), "cause": cause, "origin": origin,
                 "events": len(events), "dump": self._dumped,
             }
+            if self._worlds:
+                header["worlds"] = dict(self._worlds)
             try:
                 from horovod_tpu.common import elastic as _elastic
                 header["generation"] = _elastic.generation()

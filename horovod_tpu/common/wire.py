@@ -336,6 +336,45 @@ CACHED_AGG_PREFIX = bytes((FRAME_CACHED_AGG,))
 # leads with its u32 frame count, and a 2-rank host's count byte is
 # exactly FRAME_CACHED_AGG.
 PACKED_PREFIX = b"\xfe"
+# World-id envelope (common/tenancy.py): every cycle frame of a
+# TENANT sub-world rides as ``0xFD | u32 world_id | frame`` so a
+# frame that strays across worlds (a derived-port collision, a stale
+# connection in service mode) fails fast with BOTH ids named instead
+# of corrupting a foreign tensor table. world_id 0 is the default
+# world; its frames ride unstamped, keeping the single-job wire
+# byte-identical to every earlier build.
+TENANT_PREFIX = b"\xfd"
+
+
+def stamp_world(frame: bytes, world_id: int) -> bytes:
+    """Wrap a cycle frame in the world-id envelope (identity for the
+    default world)."""
+    if not world_id:
+        return frame
+    return TENANT_PREFIX + _U32.pack(world_id) + frame
+
+
+def read_world(data: bytes) -> tuple:
+    """-> (world_id, payload_offset): (0, 0) for an unstamped frame."""
+    if data[:1] != TENANT_PREFIX:
+        return 0, 0
+    if len(data) < 5:
+        raise ConnectionError(
+            f"truncated world-id envelope: {len(data)} bytes")
+    return _U32.unpack_from(data, 1)[0], 5
+
+
+def unstamp_world(data: bytes, expect_id: int) -> bytes:
+    """Strip (and verify) the world-id envelope. A mismatch is a
+    cross-world frame — the caller's world must fail fast, never
+    decode a foreign table's masks."""
+    world_id, off = read_world(data)
+    if world_id != expect_id:
+        raise ConnectionError(
+            f"control frame for world {world_id:#010x} arrived in "
+            f"world {expect_id:#010x} — two worlds are sharing a "
+            f"connection (check sub-world coordinator ports)")
+    return data[off:] if off else data
 
 
 def _mask_nbytes(nslots: int) -> int:
@@ -362,7 +401,8 @@ def _seg_hdr(dt, nbytes: int) -> bytes:
     return _U8.pack(int(dt)) + _I64.pack(nbytes)
 
 
-def spec_frame_parts(epoch: int, nslots: int, mask: int, seg_meta):
+def spec_frame_parts(epoch: int, nslots: int, mask: int, seg_meta,
+                     world_id: int = 0):
     """(prefix, [seg_hdr, ...]): the CONSTANT byte regions of a
     CACHED_SPEC cycle frame — everything except the raw segment data.
     ``seg_meta`` is [(DataType, nbytes), ...]. This is THE single
@@ -372,8 +412,13 @@ def spec_frame_parts(epoch: int, nslots: int, mask: int, seg_meta):
     byte-compares exactly these regions around fusion-arena pointers —
     so a native rank and a pure-Python rank can never drift apart on
     the wire. Request and response share one shape because a granted
-    steady cycle's grant_mask IS the bid's hit_mask."""
+    steady cycle's grant_mask IS the bid's hit_mask. A tenant world
+    (``world_id`` != 0) leads the prefix with the world-id envelope,
+    exactly as stamp_world wraps the classically-serialized frame."""
     w = _Writer()
+    if world_id:
+        w.parts.append(TENANT_PREFIX)
+        w.u32(world_id)
     w.u8(FRAME_CACHED_SPEC)
     w.i64(epoch)
     w.u32(nslots)
@@ -663,12 +708,25 @@ def combine_cycle_requests(frames) -> "bytes | None":
     every Request carries its rank, so attribution survives the fold).
     Returns None when any frame is not cache-framed or the epochs /
     slot counts disagree (divergence is the coordinator's to
-    diagnose — the relay then forwards the frames unfolded)."""
+    diagnose — the relay then forwards the frames unfolded). Tenant
+    frames fold too: a host whose ranks all stamped the SAME world id
+    folds behind one (re-stamped) aggregate; mixed ids mean two
+    worlds' frames met on one relay — forwarded unfolded so the
+    coordinator's unstamp check names the stray."""
+    world_id = None
     parsed = []
     for f in frames:
-        if not f or f[0] not in (FRAME_CACHED, FRAME_CACHED_AGG):
+        if not f:
             return None
-        parsed.append(parse_cycle_request(f))
+        wid, off = read_world(f)
+        if world_id is None:
+            world_id = wid
+        elif wid != world_id:
+            return None
+        if len(f) <= off or f[off] not in (FRAME_CACHED,
+                                           FRAME_CACHED_AGG):
+            return None
+        parsed.append(parse_cycle_request(f[off:] if off else f))
     first = parsed[0]
     combined = CacheCycleRequest(
         epoch=first.epoch, nslots=first.nslots,
@@ -681,7 +739,9 @@ def combine_cycle_requests(frames) -> "bytes | None":
         combined.invalid_mask |= cf.invalid_mask
         combined.shutdown = combined.shutdown or cf.shutdown
         combined.requests.extend(cf.requests)
-    return serialize_cycle_request(combined, aggregate=True)
+    return stamp_world(serialize_cycle_request(combined,
+                                               aggregate=True),
+                       world_id)
 
 
 # ---------------------------------------------------------------------------
@@ -869,3 +929,150 @@ def parse_elastic_verdict(data: bytes) -> dict:
     out["joined"] = r.i32()
     out["coord_elastic_port"] = r.i32()
     return out
+
+
+# -- tenant service frames (common/tenancy.py) -------------------------------
+#
+# The service gate's attach/detach/snapshot protocol — the PR 8
+# manifest machinery generalized to jobs that join the WARM fleet's
+# service plane instead of its world: frames ride short-lived
+# dedicated sockets framed by network.Channel, exactly like the
+# elastic rendezvous frames above. One u8 kind family (TENANT_*,
+# pairwise distinct — enforced by the hvdlint wire-protocol analyzer
+# like WIRE_*/ALG_*):
+#
+#   attach   := u8 kind | u32 world_id | i64 generation | str tenant
+#             | i32 replica | i32 group | str host | i32 port
+#   lease    := u8 kind | u32 world_id | i64 generation | i64 lease
+#             | i32 size | u32 n x (str host | i32 port) | str cause
+#   snapshot := u8 kind | u64 version
+#             | u32 n x (str name | u8 dtype | u8 ndim | i64 dims[ndim]
+#                        | u64 nbytes | raw bytes)
+#   detach/ack/req reuse the attach/lease layouts with their own kind.
+
+TENANT_ATTACH = 0        # job replica -> gate: join the service plane
+TENANT_LEASE = 1         # gate -> replica: admitted; replica-group map
+TENANT_SNAPSHOT_REQ = 2  # group root -> gate: parameter snapshot pull
+TENANT_SNAPSHOT = 3      # gate -> root -> children: fanout payload
+TENANT_DETACH = 4        # replica -> gate: leaving (fleet unaffected)
+TENANT_ACK = 5           # gate -> replica: detach acknowledged
+TENANT_REFUSE = 6        # gate -> dialer: not serving (wrong world /
+                         # service mode off / unknown tenant group)
+
+TENANT_NAMES = {TENANT_ATTACH: "attach", TENANT_LEASE: "lease",
+                TENANT_SNAPSHOT_REQ: "snapshot_req",
+                TENANT_SNAPSHOT: "snapshot", TENANT_DETACH: "detach",
+                TENANT_ACK: "ack", TENANT_REFUSE: "refuse"}
+
+
+def serialize_tenant_attach(kind: int, world_id: int, generation: int,
+                            tenant: str, replica: int, group: int,
+                            host: str, port: int) -> bytes:
+    w = _Writer()
+    w.u8(kind)
+    w.u32(world_id)
+    w.i64(generation)
+    w.string(tenant)
+    w.i32(replica)
+    w.i32(group)
+    w.string(host)
+    w.i32(port)
+    return w.bytes()
+
+
+def parse_tenant_attach(data: bytes) -> dict:
+    r = _Reader(data)
+    return {"kind": r.u8(), "world_id": r.u32(), "gen": r.i64(),
+            "tenant": r.string(), "replica": r.i32(),
+            "group": r.i32(), "host": r.string(), "port": r.i32()}
+
+
+def serialize_tenant_lease(kind: int, world_id: int, generation: int,
+                           lease: int, size: int, members,
+                           cause: str = "") -> bytes:
+    """``members``: [(host, port), ...] in replica order — the fanout
+    tree every replica derives its children from."""
+    w = _Writer()
+    w.u8(kind)
+    w.u32(world_id)
+    w.i64(generation)
+    w.i64(lease)
+    w.i32(size)
+    w.u32(len(members))
+    for host, port in members:
+        w.string(host)
+        w.i32(port)
+    w.string(cause)
+    return w.bytes()
+
+
+def parse_tenant_lease(data: bytes) -> dict:
+    r = _Reader(data)
+    out = {"kind": r.u8(), "world_id": r.u32(), "gen": r.i64(),
+           "lease": r.i64(), "size": r.i32()}
+    out["members"] = [(r.string(), r.i32())
+                      for _ in range(r.u32())]
+    out["cause"] = r.string()
+    return out
+
+
+def serialize_tenant_snapshot(version: int, params) -> bytes:
+    """``params``: {name: numpy array} — the published parameter
+    snapshot a replica group pulls over the broadcast fanout."""
+    from horovod_tpu.common.message import numpy_dtype_to_datatype
+    from horovod_tpu.common.network import as_byte_view
+    w = _Writer()
+    w.u8(TENANT_SNAPSHOT)
+    w.parts.append(_U64.pack(version))
+    w.u32(len(params))
+    for name, arr in params.items():
+        w.string(name)
+        w.u8(int(numpy_dtype_to_datatype(arr.dtype)))
+        shape = arr.shape
+        w.u8(len(shape))
+        if shape:
+            w.parts.append(struct.pack(f"<{len(shape)}q", *shape))
+        view = as_byte_view(arr)
+        n = len(view) if isinstance(view, (bytes, bytearray)) \
+            else view.nbytes
+        w.parts.append(_U64.pack(n))
+        w.parts.append(view)
+    return w.bytes()
+
+
+def parse_tenant_snapshot(data: bytes) -> tuple:
+    """-> (version, {name: numpy array}). Arrays are fresh copies —
+    the frame buffer is transport-owned."""
+    import numpy as _np
+    from horovod_tpu.common.message import (
+        DataType, datatype_to_numpy_dtype,
+    )
+    r = _Reader(data)
+    kind = r.u8()
+    if kind != TENANT_SNAPSHOT:
+        raise ConnectionError(
+            f"expected tenant snapshot frame, got kind {kind}")
+    r._need(_U64.size)
+    (version,) = _U64.unpack_from(r.data, r.off)
+    r.off += _U64.size
+    params = {}
+    for _ in range(r.u32()):
+        name = r.string()
+        dt = DataType(r.u8())
+        ndim = r.u8()
+        if ndim:
+            r._need(8 * ndim)
+            shape = struct.unpack_from(f"<{ndim}q", r.data, r.off)
+            r.off += 8 * ndim
+        else:
+            shape = ()
+        r._need(_U64.size)
+        (nbytes,) = _U64.unpack_from(r.data, r.off)
+        r.off += _U64.size
+        r._need(nbytes)
+        arr = _np.frombuffer(
+            bytes(r.data[r.off:r.off + nbytes]),
+            dtype=datatype_to_numpy_dtype(dt)).reshape(shape).copy()
+        r.off += nbytes
+        params[name] = arr
+    return version, params

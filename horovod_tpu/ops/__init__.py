@@ -35,20 +35,32 @@ Average = 0
 Sum = 1
 
 _counter_lock = lockdep.lock("ops._counter_lock")
+# (scope, kind) -> count. The scope is the active runtime's tenant
+# name ('' = default world): each tenant's auto-name sequence must be
+# a pure function of ITS OWN submission order — keyed globally, two
+# tenants interleaving differently per process would diverge names
+# across ranks.
 _counters = {}
 
 
 def _auto_name(kind: str) -> str:
+    scope = basics.active_scope()
     with _counter_lock:
-        n = _counters.get(kind, 0)
-        _counters[kind] = n + 1
+        n = _counters.get((scope, kind), 0)
+        _counters[(scope, kind)] = n + 1
     return f"{kind}.noname.{n}"
 
 
-def reset_name_counters() -> None:
-    """Called by init() so re-initialized worlds agree on auto names."""
+def reset_name_counters(scope=None) -> None:
+    """Called by init()/create_tenant so re-initialized worlds agree
+    on auto names. ``scope`` clears one world's counters (''=default,
+    a tenant name otherwise); None clears everything."""
     with _counter_lock:
-        _counters.clear()
+        if scope is None:
+            _counters.clear()
+        else:
+            for key in [k for k in _counters if k[0] == scope]:
+                del _counters[key]
 
 
 def _inspect(tensor):
@@ -76,7 +88,7 @@ def _inspect(tensor):
 def _enqueue(kind: RequestType, tensor, name: Optional[str],
              root_rank: int = -1, prescale: float = 1.0,
              postscale: float = 1.0) -> int:
-    rt = basics.runtime()
+    rt = basics.active_runtime()
     payload, ctx, device, np_dtype, shape, ready_fn = _inspect(tensor)
     dtype = numpy_dtype_to_datatype(np_dtype)
     name = name or _auto_name(kind.name.lower())
@@ -99,7 +111,7 @@ def _enqueue(kind: RequestType, tensor, name: Optional[str],
 def poll(handle: int) -> bool:
     """True when the op behind ``handle`` has completed
     (reference: horovod/torch/mpi_ops.py poll)."""
-    return basics.runtime().handle_manager.poll(handle)
+    return basics.active_runtime().handle_manager.poll(handle)
 
 
 def synchronize(handle: int) -> Any:
@@ -107,7 +119,7 @@ def synchronize(handle: int) -> Any:
     (reference: horovod/torch/mpi_ops.py synchronize + WaitAndClear).
     A fail-fast world abort surfaces as WorldAbortedError (a
     HorovodInternalError subclass) carrying the originating rank."""
-    rt = basics.runtime()
+    rt = basics.active_runtime()
     try:
         status = rt.handle_manager.wait(handle)
     except ValueError:
@@ -223,7 +235,7 @@ def grouped_allreduce_async(tensors, average: Optional[bool] = None,
             numel *= int(d)
         nbytes_list.append(numel * np_dtype.itemsize)
 
-    rt = basics.runtime()
+    rt = basics.active_runtime()
     mark_done = rt.handle_manager.mark_done
     handles = rt.handle_manager.allocate_many(len(inspected))
     items = []

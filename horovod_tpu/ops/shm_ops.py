@@ -88,6 +88,12 @@ class ShmBackend(CollectiveBackend):
         self._dead = False
         self._opt_in = True if config is None else config.shm_enabled
         self._zero_copy = True if config is None else config.zero_copy
+        # Tenant sub-worlds (common/tenancy.py) namespace their
+        # segments: two worlds hosted by ONE process (same pid, same
+        # generation counter) must never collide on a segment path —
+        # the old pid+gen name did exactly that.
+        self._world_id = 0 if config is None \
+            else int(getattr(config, "world_id", 0))
         # Persistent pack buffer (common/arena.py): fused steady steps
         # re-pack into the same memory instead of allocating per step.
         # Safe here because every shm result is copied OUT of the
@@ -192,7 +198,8 @@ class ShmBackend(CollectiveBackend):
         path = ""
         ok = True
         if t.local_rank == 0 and not solo:
-            path = f"/dev/shm/hvdtpu-{os.getpid()}-{self._gen}"
+            path = (f"/dev/shm/hvdtpu-{os.getpid()}"
+                    f"-w{self._world_id:x}-{self._gen}")
             try:
                 fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL,
                              0o600)

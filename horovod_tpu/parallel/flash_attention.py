@@ -373,8 +373,35 @@ def _shapes_ok(seq_q, seq_k, block_q, block_k):
 # auto-pick the largest ladder entry dividing the sequence, so odd
 # lengths (ring shards, tests) degrade gracefully instead of falling
 # back to dense.
+#
+# The 512x1024 default was only ever validated for D <= 128
+# (ADVICE r05): the kernels' resident VMEM grows linearly with D —
+# per program roughly (block_q + 2*block_k) * D tile elements plus
+# the (block_q, D) f32 accumulator (the backward adds do/dq tiles of
+# the same shape) — so at D=256 the 512x1024 tile pair already sits
+# near ~3 MB of f32 working set and at D=512 it would blow the
+# ~16 MB/core VMEM budget outright once double-buffered pipelining
+# and the p-block scratch are counted. _ladders_for halves the
+# ladder per doubling past 128 so the working set stays roughly
+# D-invariant; tiles never drop below the 128-lane MXU width.
 _BLOCK_Q_LADDER = (512, 256, 128)
 _BLOCK_K_LADDER = (1024, 512, 256, 128)
+_HEAD_DIM_BASE = 128  # the largest D the default ladder was measured at
+
+
+def _ladders_for(head_dim: int):
+    """(q_ladder, k_ladder) scaled to ``head_dim``: the measured
+    512x1024 defaults up to D=128, then each doubling of D halves the
+    leading tiles (floor 128) so per-program VMEM stays level."""
+    q_top, k_top = _BLOCK_Q_LADDER[0], _BLOCK_K_LADDER[0]
+    d = max(1, int(head_dim))
+    while d > _HEAD_DIM_BASE and (q_top > 128 or k_top > 128):
+        q_top = max(128, q_top // 2)
+        k_top = max(128, k_top // 2)
+        d //= 2
+    q_ladder = tuple(b for b in _BLOCK_Q_LADDER if b <= q_top)
+    k_ladder = tuple(b for b in _BLOCK_K_LADDER if b <= k_top)
+    return q_ladder, k_ladder
 
 
 def _auto_block(seq: int, ladder, explicit) -> int:
@@ -418,8 +445,9 @@ def flash_attention_stats(q, k, v, causal: bool = True,
     Offsets may be traced values (one compilation serves every ring
     step)."""
     seq_q, seq_k = q.shape[1], k.shape[1]
-    block_q = _auto_block(seq_q, _BLOCK_Q_LADDER, block_q)
-    block_k = _auto_block(seq_k, _BLOCK_K_LADDER, block_k)
+    q_ladder, k_ladder = _ladders_for(q.shape[-1])
+    block_q = _auto_block(seq_q, q_ladder, block_q)
+    block_k = _auto_block(seq_k, k_ladder, block_k)
     if not _shapes_ok(seq_q, seq_k, block_q, block_k):
         raise ValueError(
             f"sequence lengths ({seq_q}, {seq_k}) must be divisible by "
@@ -456,8 +484,9 @@ def flash_attention_bwd(q, k, v, o, m, l, do, causal: bool = True,
     the exact full-sequence gradient."""
     b, seq_q, h, d = q.shape
     seq_k = k.shape[1]
-    block_q = _auto_block(seq_q, _BLOCK_Q_LADDER, block_q)
-    block_k = _auto_block(seq_k, _BLOCK_K_LADDER, block_k)
+    q_ladder, k_ladder = _ladders_for(d)
+    block_q = _auto_block(seq_q, q_ladder, block_q)
+    block_k = _auto_block(seq_k, k_ladder, block_k)
     if not _shapes_ok(seq_q, seq_k, block_q, block_k):
         raise ValueError(
             f"sequence lengths ({seq_q}, {seq_k}) must be divisible by "
@@ -521,8 +550,9 @@ def flash_attention(q, k, v, causal: bool = True,
     at several times the MXU cost; the context reaches inside the
     pallas kernel (verified on v5e silicon)."""
     seq_q, seq_k = q.shape[1], k.shape[1]
-    bq = _auto_block(seq_q, _BLOCK_Q_LADDER, block_q)
-    bk = _auto_block(seq_k, _BLOCK_K_LADDER, block_k)
+    q_ladder, k_ladder = _ladders_for(q.shape[-1])
+    bq = _auto_block(seq_q, q_ladder, block_q)
+    bk = _auto_block(seq_k, k_ladder, block_k)
     if not _shapes_ok(seq_q, seq_k, bq, bk):
         if not causal:
             raise ValueError("non-causal path requires block-divisible "

@@ -534,16 +534,35 @@ def main(argv: Optional[List[str]] = None) -> None:
                         help="seconds between trace-span shipments "
                              "up the control tree (env "
                              "HOROVOD_TPU_TRACE_INTERVAL)")
+    parser.add_argument("--service", action="store_true",
+                        help="run the fleet as a long-lived collective "
+                             "SERVICE (env HOROVOD_TPU_SERVICE; "
+                             "docs/multitenancy.md): rank 0 opens the "
+                             "tenant gate so jobs attach/detach and "
+                             "pull parameter snapshots without the "
+                             "fleet re-rendezvousing. With no "
+                             "training command, runs the built-in "
+                             "warm host (horovod_tpu.run.service_host)")
+    parser.add_argument("--service-port", type=int, default=None,
+                        help="fixed port for the rank-0 service gate "
+                             "(0 = ephemeral; env "
+                             "HOROVOD_TPU_SERVICE_PORT)")
     parser.add_argument("--verbose", action="store_true")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="training command")
     args = parser.parse_args(argv)
 
-    if not args.command:
-        parser.error("no training command given")
     command = args.command
     if command and command[0] == "--":
         command = command[1:]
+    if not command:
+        if args.service:
+            # Warm-fleet default: an idle service host per slot that
+            # inits the world and serves until terminated.
+            command = [sys.executable, "-m",
+                       "horovod_tpu.run.service_host"]
+        else:
+            parser.error("no training command given")
 
     if args.verbose:
         os.environ.setdefault("HOROVOD_LOG_LEVEL", "debug")
@@ -571,6 +590,13 @@ def main(argv: Optional[List[str]] = None) -> None:
     if args.trace_interval is not None:
         metrics_env["HOROVOD_TPU_TRACE_INTERVAL"] = \
             str(args.trace_interval)
+    # Service mode: every rank learns the knob (rank 0 opens the
+    # gate); the port pin keeps the attach endpoint stable.
+    if args.service or args.service_port is not None:
+        metrics_env["HOROVOD_TPU_SERVICE"] = "1"
+    if args.service_port is not None:
+        metrics_env["HOROVOD_TPU_SERVICE_PORT"] = \
+            str(args.service_port)
     # Multihost task servers forward only an explicit env set; carry
     # env-configured metrics/trace/flight knobs across hosts too,
     # not just flags.
@@ -579,7 +605,8 @@ def main(argv: Optional[List[str]] = None) -> None:
                 "HOROVOD_TPU_METRICS_LOG", "HOROVOD_TPU_TRACE",
                 "HOROVOD_TPU_TRACE_INTERVAL", "HOROVOD_TPU_FLIGHT",
                 "HOROVOD_TPU_FLIGHT_EVENTS",
-                "HOROVOD_TPU_FLIGHT_DIR"):
+                "HOROVOD_TPU_FLIGHT_DIR", "HOROVOD_TPU_SERVICE",
+                "HOROVOD_TPU_SERVICE_PORT"):
         if key in os.environ:
             metrics_env.setdefault(key, os.environ[key])
 

@@ -1,0 +1,59 @@
+"""Built-in warm host for ``hvdtpurun --service`` with no command.
+
+Each slot inits the world and then idles warm: the fleet's collective
+substrate (controller channels, heartbeats, metrics/trace planes, the
+rank-0 service gate) stays hot while jobs attach and detach through
+the tenant gate (common/tenancy.py, docs/multitenancy.md). Rank 0
+publishes a small heartbeat snapshot so a freshly-attached replica
+always has SOMETHING to pull before a real trainer publishes weights.
+
+A real deployment usually runs its own training script under
+--service instead; this module is the zero-config way to stand up a
+warm fleet and the smoke-test target for service mode.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import horovod_tpu as hvd
+    from horovod_tpu.common import tenancy
+
+    hvd.init()
+    stop = threading.Event()
+
+    def _sig(_signum, _frame):
+        stop.set()
+
+    for s in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(s, _sig)
+        except (ValueError, OSError):
+            pass  # non-main thread / restricted platform
+
+    beat = 0
+    while not stop.is_set():
+        if hvd.rank() == 0 and tenancy.service_gate() is not None:
+            tenancy.publish_snapshot(
+                {"service.heartbeat": np.asarray(
+                    [time.time(), float(beat)], np.float64)},
+                version=None)
+        # A periodic world collective keeps every slot's control
+        # plane exercised (and fail-fast if a peer dies) without
+        # burning the host: one tiny allreduce per beat interval.
+        # beat advances on EVERY rank — tensor names must agree.
+        beat += 1
+        hvd.allreduce(np.zeros(1, np.float32), average=False,
+                      name="service.beat")
+        stop.wait(5.0)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

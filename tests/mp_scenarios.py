@@ -3683,6 +3683,301 @@ def scenario_elastic_disabled_fail_fast(hvd, rank, size):
         assert e.origin_rank == 1, e
 
 
+# ---------------------------------------------------------------------------
+# Multi-tenant collective service (common/tenancy.py,
+# docs/multitenancy.md): concurrent sub-worlds on one fleet under QoS
+# scheduling, fault isolation between tenants, and service-mode
+# attach/detach with the parameter-snapshot broadcast fanout.
+# ---------------------------------------------------------------------------
+
+def _tenant_steps(tenant, rank, size, key, steps, numel=32):
+    """Drive ``steps`` deterministic allreduces on ``tenant`` and
+    assert exactness per step; returns the outputs."""
+    ssum = sum(range(1, size + 1))
+    outs = []
+    for i in range(steps):
+        out = tenant.allreduce(
+            np.full(numel, float(rank + 1) * (i + 1), np.float32),
+            average=False, name=f"{key}.g")
+        np.testing.assert_allclose(out, ssum * (i + 1))
+        outs.append(np.asarray(out))
+    return outs
+
+
+def scenario_tenants_exact(hvd, rank, size):
+    """Two equal-weight tenants spanning the SAME ws=4 fleet train
+    concurrently from separate threads; each tenant's per-step results
+    are exact, and tenant A's sequence replayed AFTER the concurrent
+    phase (B idle) is bit-identical — co-tenancy never perturbs
+    numerics. Also asserts the per-tenant observability surfaces."""
+    import threading
+    ta = hvd.create_tenant("jobA", list(range(size)))
+    tb = hvd.create_tenant("jobB", list(range(size)))
+    assert ta.rank == rank and ta.size == size
+    assert ta.world_id != tb.world_id
+    results = {}
+
+    def run(t, key):
+        results[key] = _tenant_steps(t, rank, size, key, 30)
+
+    threads = [threading.Thread(target=run, args=(t, k))
+               for t, k in ((ta, "a"), (tb, "b"))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results["a"]) == 30 and len(results["b"]) == 30
+
+    # single-tenant replay of A's exact submission sequence, B idle:
+    # bit-identical outputs prove scheduling never touched the math
+    ssum = sum(range(1, size + 1))
+    for i in range(30):
+        out = ta.allreduce(
+            np.full(32, float(rank + 1) * (i + 1), np.float32),
+            average=False, name="replay.g")
+        assert (np.asarray(out) == results["a"][i]).all(), i
+        np.testing.assert_allclose(out, ssum * (i + 1))
+
+    # per-tenant observability: lane stats flow, and the stall-report
+    # world line carries the tenant identity + scheduler verdicts
+    for t, key in ((ta, "jobA"), (tb, "jobB")):
+        stats = t.lane_stats()
+        assert stats["cycles"] >= 30, (key, stats)
+        line = t._runtime._world_status_line()
+        assert f"tenant {key}" in line and "weight" in line, line
+    # the default world is untouched by tenant traffic
+    out = hvd.allreduce(np.full(4, float(rank), np.float64),
+                        average=False, name="dflt")
+    np.testing.assert_allclose(out, sum(range(size)))
+    ta.shutdown()
+    tb.shutdown()
+
+
+def scenario_tenants_priority(hvd, rank, size):
+    """3:1 weights must skew the contended cycle share toward the
+    heavy tenant: when the heavy tenant finishes its fixed workload,
+    the equal-sized light workload is measurably behind, and the
+    light lane records real deferrals. Submissions ride a small async
+    pipeline so both lanes stay backlogged."""
+    import threading
+    heavy = hvd.create_tenant("heavy", list(range(size)), weight=3.0)
+    light = hvd.create_tenant("light", list(range(size)), weight=1.0)
+    n, depth = 400, 4
+    ssum = sum(range(1, size + 1))
+    light_done_at_heavy_done = [None]
+
+    def run(t, key):
+        pend = []
+        for i in range(n):
+            pend.append(t.allreduce_async(
+                np.full(16, float(rank + 1), np.float32),
+                average=False, name=f"{key}.g{i % depth}"))
+            if len(pend) >= depth:
+                np.testing.assert_allclose(
+                    t.synchronize(pend.pop(0)), ssum)
+        while pend:
+            np.testing.assert_allclose(t.synchronize(pend.pop(0)),
+                                       ssum)
+        if key == "h":
+            light_done_at_heavy_done[0] = \
+                light.lane_stats()["cycles"]
+
+    threads = [threading.Thread(target=run, args=(t, k))
+               for t, k in ((heavy, "h"), (light, "l"))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    h_cycles = heavy.lane_stats()["cycles"]
+    l_at_h = light_done_at_heavy_done[0]
+    # the heavy tenant held a strictly larger share of the contended
+    # window (equal weights measure ~1.0 here; 3:1 measures ~1.5 on
+    # this host since granted cycles still overlap — the quantitative
+    # bar lives in collective_bench --multitenant; a loaded CI host
+    # adds variance, so the gate here is the DIRECTION with margin
+    # and a world-total deferral proof)
+    assert l_at_h < 0.9 * h_cycles, (l_at_h, h_cycles)
+    world_deferrals = float(np.asarray(light.allreduce(
+        np.asarray([float(light.lane_stats()["deferrals"])],
+                   np.float32),
+        average=False, name="l.defer"))[0])
+    assert world_deferrals > 0, light.lane_stats()
+    heavy.shutdown()
+    light.shutdown()
+
+
+def scenario_tenants_quota(hvd, rank, size):
+    """A cycles/sec quota defers the over-quota tenant — it crawls at
+    the budget but every cycle completes exactly (deferred, never
+    corrupted) while the unlimited co-tenant runs at full speed."""
+    import threading
+    import time as _time
+    fast = hvd.create_tenant("fast", list(range(size)))
+    capped = hvd.create_tenant("capped", list(range(size)),
+                               quota_cycles_s=10.0)
+    timing = {}
+
+    def run(t, key, steps):
+        t0 = _time.monotonic()
+        _tenant_steps(t, rank, size, key, steps, numel=16)
+        timing[key] = _time.monotonic() - t0
+
+    threads = [threading.Thread(target=run, args=(fast, "f", 150)),
+               threading.Thread(target=run, args=(capped, "c", 30))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    c = capped.lane_stats()
+    # Deferral is observed per RANK; on a heavily loaded CI host one
+    # rank's natural pace can fall under the quota (nothing for its
+    # bucket to defer) — so assert on the WORLD total, with a
+    # wall-time floor as the loaded-host fallback: 30 cycles at 10/s
+    # minus the 1s burst bucket of 10 needs ~2s no matter what.
+    world_deferrals = float(np.asarray(capped.allreduce(
+        np.asarray([float(c["deferrals"])], np.float32),
+        average=False, name="c.defer"))[0])
+    assert world_deferrals > 0 or timing["c"] > 3.0, \
+        (c, timing)
+    assert timing["c"] > 1.4, timing
+    # the unlimited tenant is not dragged to the capped tenant's
+    # pace: its 5x larger workload still finishes first (brief fast
+    # deferrals around the capped lane's refill instants are correct
+    # weighted fairness, so deferral COUNTS are not compared)
+    assert timing["f"] < timing["c"], timing
+    fast.shutdown()
+    capped.shutdown()
+
+
+def scenario_tenants_fault_isolation(hvd, rank, size):
+    """SIGKILL of a rank inside tenant A ([0,1]) raises
+    WorldAbortedError naming A's dead rank on A's survivor ONLY;
+    tenant B ([2,3]) — disjoint ranks of the SAME launched fleet —
+    trains to completion with exact results and never observes an
+    abort."""
+    import signal
+    import time as _time
+    from horovod_tpu.common.status import WorldAbortedError
+    assert size == 4, "scenario expects 4 launched processes"
+    ta = hvd.create_tenant("jobA", [0, 1])
+    tb = hvd.create_tenant("jobB", [2, 3])
+    if rank in (0, 1):
+        assert ta is not None and tb is None
+        assert ta.size == 2 and ta.rank == rank
+        _tenant_steps(ta, ta.rank, 2, "a", 5, numel=16)
+        if rank == 1:
+            os.kill(os.getpid(), signal.SIGKILL)
+        # survivor: drive tenant-A collectives until the fail-fast
+        # abort surfaces, naming A's (tenant-local) rank 1
+        t0 = _time.monotonic()
+        i = 0
+        while True:
+            try:
+                ta.allreduce(np.ones(16, np.float32), average=False,
+                             name=f"a.post/{i}")
+            except WorldAbortedError as e:
+                assert e.origin_rank == 1, e
+                break
+            i += 1
+            assert _time.monotonic() - t0 < 40.0, \
+                "tenant A kept succeeding past its member's death"
+        ta.shutdown()
+        return
+    # ranks 2, 3: tenant B must be completely unaffected — train
+    # through the kill window and well past it
+    assert tb is not None and ta is None
+    assert tb.size == 2 and tb.rank == rank - 2
+    for i in range(40):
+        out = tb.allreduce(
+            np.full(16, float(tb.rank + 1) * (i + 1), np.float32),
+            average=False, name="b.g")
+        np.testing.assert_allclose(out, 3.0 * (i + 1))
+        _time.sleep(0.05)  # stretch across A's death + detection
+    assert tb.alive, "tenant B's world must survive tenant A's abort"
+    tb.shutdown()
+
+
+def scenario_tenants_service(hvd, rank, size):
+    """Service mode end to end on one launch: ranks 0-1 form a warm
+    --service fleet (HOROVOD_TPU_SERVICE=1) that trains and publishes
+    parameter snapshots; ranks 2-3 never join the fleet's world —
+    they ATTACH as a 2-replica group, pull a snapshot through the
+    broadcast fanout (gate → root → child), verify it, and DETACH.
+    The fleet trains to completion without any re-rendezvous."""
+    import time as _time
+    assert size == 4, "scenario expects 4 launched processes"
+    gate_port = int(os.environ["HOROVOD_TPU_SERVICE_PORT"])
+    # The gate speaks the fleet's HMAC'd channel framing: an attaching
+    # job must present the fleet's HOROVOD_SECRET_KEY (the service
+    # plane shares the control plane's auth boundary — an unsecured
+    # dialer is rejected at the first frame). The suite sometimes runs
+    # with a secret inherited from the environment, so thread it.
+    secret = os.environ.get("HOROVOD_SECRET_KEY", "").encode()
+    if rank >= 2:
+        # attach clients: no hvd.init() at all — a service job needs
+        # only the gate endpoint (+ secret). Generous deadlines: under
+        # a loaded CI host, interpreter+numpy startup alone can eat
+        # tens of seconds before this line runs.
+        from horovod_tpu.common import tenancy
+        print(f"[client {rank}] dialing gate 127.0.0.1:{gate_port}",
+              flush=True)
+        rep = tenancy.attach("127.0.0.1", gate_port, "evaljob",
+                             replica=rank - 2, group=2, timeout=90.0,
+                             secret=secret)
+        print(f"[client {rank}] lease {rep.lease} members "
+              f"{rep.members}", flush=True)
+        assert len(rep.members) == 2
+        version, params = rep.fetch_snapshot(min_version=1,
+                                             timeout=60.0)
+        print(f"[client {rank}] snapshot v{version}", flush=True)
+        assert version >= 1
+        np.testing.assert_array_equal(
+            params["w"], np.arange(16, dtype=np.float32) * version)
+        assert int(params["step"][0]) == version * 10
+        rep.detach()
+        return
+    # fleet ranks 0-1: a 2-rank world on the env endpoint
+    hvd.init(comm=(rank, 2))
+    from horovod_tpu.common import tenancy
+    gate = tenancy.service_gate()
+    if rank == 0:
+        assert gate is not None and gate.port == gate_port
+        print(f"[fleet 0] gate up on {gate.port} pid {os.getpid()}",
+              flush=True)
+    ssum = 3.0  # ranks contribute 1.0 and 2.0
+    for step in range(1, 61):
+        out = hvd.allreduce(np.full(8, float(rank + 1), np.float32),
+                            average=False, name="fleet.g")
+        np.testing.assert_allclose(out, ssum)
+        if rank == 0 and step % 10 == 0:
+            tenancy.publish_snapshot(
+                {"w": np.arange(16, dtype=np.float32) * (step // 10),
+                 "step": np.asarray([step], np.int64)},
+                version=step // 10)
+        _time.sleep(0.02)
+    if rank == 0:
+        # the fleet never re-rendezvoused: wait for both replicas to
+        # have come AND gone (the gate runs on daemon threads beside
+        # the world — no collective is needed to serve them, which is
+        # the point). The window covers loaded-host client startup.
+        deadline = _time.monotonic() + 120.0
+        while _time.monotonic() < deadline:
+            s = gate.stats()
+            if s["attaches"] >= 2 and s["detaches"] >= 2:
+                break
+            _time.sleep(0.1)
+        s = gate.stats()
+        assert s["attaches"] >= 2 and s["detaches"] >= 2, s
+        assert s["groups"] == {}, s
+    # a final world collective proves the fleet world is still whole
+    out = hvd.allreduce(np.full(4, float(rank + 1), np.float32),
+                        average=False, name="fleet.final")
+    np.testing.assert_allclose(out, ssum)
+
+
+scenario_tenants_service.no_auto_init = True
+
+
 def main():
     scenario, rank, size, port = (sys.argv[1], int(sys.argv[2]),
                                   int(sys.argv[3]), int(sys.argv[4]))

@@ -356,6 +356,39 @@ def test_wire_protocol_trace_code_families_fire(tmp_path):
     assert "EV_ABORT and EV_ELASTIC share byte value" in msgs
 
 
+BAD_TENANT_CODES = """
+    TENANT_ATTACH = 0
+    TENANT_LEASE = 0
+    TENANT_NAMES = 0  # name tables exempt
+    TENANT_BIG = 300
+"""
+
+
+def test_wire_protocol_tenant_code_family_fires(tmp_path):
+    """The TENANT_* service-plane frame kinds (common/tenancy.py
+    attach/lease/snapshot protocol) join the distinctness contract —
+    an aliased kind byte would let one gate frame decode as another."""
+    fs = _lint_snippet(tmp_path, BAD_TENANT_CODES, "wire-protocol",
+                       name="wire.py")
+    msgs = "\n".join(f.message for f in fs)
+    assert "TENANT_ATTACH and TENANT_LEASE share byte value" in msgs
+    assert "TENANT_BIG = 300 does not fit the u8" in msgs
+
+
+def test_wire_protocol_real_tenant_codes_distinct():
+    """Anchor the real tree: every TENANT_* kind in wire.py is
+    distinct and u8-ranged (the analyzer gate proves itself on the
+    fixture above; this proves the SHIPPED codes)."""
+    from horovod_tpu.common import wire
+    codes = {n: getattr(wire, n) for n in dir(wire)
+             if n.startswith("TENANT_") and not n.endswith("NAMES")
+             and not n.endswith("PREFIX")
+             and isinstance(getattr(wire, n), int)}
+    assert len(codes) >= 6, codes
+    assert len(set(codes.values())) == len(codes), codes
+    assert all(0 <= v <= 255 for v in codes.values()), codes
+
+
 BAD_CONTROLLER_TAGS = """
     TAG_HANDSHAKE = 1
     TAG_REQUESTS = 2
@@ -734,6 +767,56 @@ def test_world_coherence_real_overlap_inflight_is_anchored():
         ].decorators = set()
     fs = world_coherence.run(p)
     assert any("_inflight_masks" in f.message
+               and "world-replicated" in f.message for f in fs), fs
+
+
+# A rank-local mutation of a tenant's scheduling descriptor — the
+# divergence class multi-tenancy must never allow: one rank adopting
+# its LOCAL env weight/quota instead of the coordinator-broadcast
+# descriptor, so its pacing (and therefore its cycle participation)
+# marches to a different drummer than its peers'.
+BAD_TENANT_COHERENCE = """
+    class Tenant:
+        def __init__(self):
+            self._desc = None  # hvdlint: world-replicated
+
+        def apply(self, desc):
+            self._desc = dict(desc)
+
+    class Bootstrap:
+        def __init__(self):
+            self._tenant = Tenant()
+
+        def from_local_env(self, env_weight):
+            # rank-LOCAL source: this rank's env, not the broadcast
+            self._tenant.apply({"weight": env_weight})
+"""
+
+
+def test_world_coherence_fires_on_local_tenant_descriptor(tmp_path):
+    fs = _lint_snippet(tmp_path, BAD_TENANT_COHERENCE,
+                       "world-coherence")
+    msgs = "\n".join(f.message for f in fs)
+    assert "world-replicated" in msgs and "Tenant.apply" in msgs, fs
+
+
+def test_world_coherence_real_tenant_descriptor_is_anchored():
+    """The REAL tenant descriptor install must carry the
+    @world_coherent anchor — stripping it (and the module-level
+    installer coverage could flow through) fails the tree, proving
+    tenant scheduling state only ever moves on the coordinator's
+    handshake broadcast."""
+    from tools.hvdlint import world_coherence
+    p = Project([os.path.join(REPO, "horovod_tpu")])
+    qn = "horovod_tpu.common.tenancy.Tenant._apply_descriptor"
+    assert qn in p.index.functions, sorted(
+        k for k in p.index.functions if "tenancy" in k)[:20]
+    p.index.functions[qn].decorators = set()
+    p.index.functions[
+        "horovod_tpu.common.tenancy._install_descriptor"
+    ].decorators = set()
+    fs = world_coherence.run(p)
+    assert any("_desc" in f.message
                and "world-replicated" in f.message for f in fs), fs
 
 

@@ -965,6 +965,44 @@ def test_subset_world_hierarchical():
             "HOROVOD_HOSTNAME": f"fakehost{rank // 2}"})
 
 
+# -- multi-tenant collective service (docs/multitenancy.md) -----------------
+
+def test_tenants_two_concurrent_exact():
+    """Two tenants spanning one ws=4 fleet train concurrently from
+    threads; per-tenant results are exact and tenant A's sequence
+    replays bit-identically once B goes idle."""
+    run_scenario("tenants_exact", 4, timeout=180.0)
+
+
+def test_tenants_priority_weights_skew_cycle_share():
+    """A 3:1 weighting measurably shifts the contended cycle share
+    toward the heavy tenant (with real deferrals on the light lane)."""
+    run_scenario("tenants_priority", 2, timeout=180.0)
+
+
+def test_tenants_quota_defers_over_quota_tenant():
+    """A cycles/sec quota paces the capped tenant (deferred, never
+    corrupted) while its unlimited co-tenant runs free."""
+    run_scenario("tenants_quota", 2, timeout=180.0)
+
+
+def test_tenants_sigkill_isolated_to_one_tenant():
+    """SIGKILL inside tenant A aborts only A's world; disjoint tenant
+    B on the same fleet trains to completion, exact."""
+    run_scenario("tenants_fault_isolation", 4, timeout=180.0,
+                 expect_rc={1: _SIGKILL_RC})
+
+
+def test_tenants_service_attach_snapshot_detach():
+    """hvdtpurun --service semantics end to end: a 2-rank warm fleet
+    serves a 2-replica job that attaches, pulls a parameter snapshot
+    via the broadcast fanout, and detaches — no fleet re-rendezvous."""
+    gate_port = _free_port()
+    run_scenario("tenants_service", 4, timeout=240.0,
+                 extra_env={"HOROVOD_TPU_SERVICE": "1",
+                            "HOROVOD_TPU_SERVICE_PORT": str(gate_port)})
+
+
 def test_mxnet_adapter():
     """The MXNet adapter executes end-to-end against the NDArray
     protocol double under a real 2-process world."""

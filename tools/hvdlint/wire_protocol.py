@@ -251,9 +251,10 @@ def _check_module(src: SourceFile) -> List[Finding]:
                         f"silently decodes a WRONG value"))
 
     # 5 — single-byte code families: WIRE_*/ALG_* (negotiated
-    # attributes), SPAN_* (trace span kinds) and EV_* (flight
-    # recorder event codes) — distinct within each family, u8-ranged
-    for family in ("WIRE_", "ALG_", "SPAN_", "EV_"):
+    # attributes), SPAN_* (trace span kinds), EV_* (flight recorder
+    # event codes) and TENANT_* (service-plane frame kinds,
+    # common/tenancy.py) — distinct within each family, u8-ranged
+    for family in ("WIRE_", "ALG_", "SPAN_", "EV_", "TENANT_"):
         values: Dict[int, str] = {}
         for node in src.tree.body:
             if not (isinstance(node, ast.Assign)
