@@ -642,6 +642,7 @@ class Controller:
     # Control-plane byte counters + liveness tracking, installed by
     # attach_metrics. The class-attribute defaults keep every
     # unattached (metrics-off) path at a no-op method call.
+    # hvdlint: owned-by=main -- installed exactly once by attach_metrics during rendezvous, before any cycle or background thread exists (Thread.start happens-before publishes the counters); never rebound after
     _m_ctrl_rx = None
     _m_ctrl_tx = None
     _metrics_on = False
@@ -942,6 +943,7 @@ class TcpCoordinator(Controller):
             r, hello, ch = next(accepts)
             hostnames[r] = hello["hostname"]
             ch.peer = f"rank {r} ({ch.peer})"
+            # hvdlint: owned-by=main -- rendezvous runs before the world's cycle threads start (Thread.start happens-before publishes it); elastic rebuilds a fresh coordinator
             self._channels[r] = ch
             if hello.get("elastic_port") is not None:
                 elastic_ports[r] = int(hello["elastic_port"])
@@ -1720,6 +1722,7 @@ class TcpWorker(Controller):
             r, _, ch = next(accepts)
             ch.send(b"{}", TAG_HANDSHAKE)  # accept ack
             ch.peer = f"rank {r} ({ch.peer})"
+            # hvdlint: owned-by=main -- rendezvous runs before the world's cycle threads start (Thread.start happens-before publishes it)
             self._children[r] = ch
             expected.discard(r)
         srv.close()
@@ -1743,6 +1746,7 @@ class TcpWorker(Controller):
         ports = json.loads(data.decode())["roots"]
         port = int(ports[str(self.topology.cross_rank)])
         self._ch.close()
+        # hvdlint: owned-by=main -- rendezvous channel swap happens before the world's cycle threads start (Thread.start happens-before publishes it)
         self._ch = network.connect(_local_root_addr(), port, secret,
                                    timeout=start_timeout,
                                    retry_deadline=start_timeout)

@@ -21,6 +21,8 @@ import time
 from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Tuple
 
+from horovod_tpu.common import lockdep
+from horovod_tpu.common import threadcheck
 from horovod_tpu.common import logging as hlog
 from horovod_tpu.common import wire_dtype as _wd
 from horovod_tpu.common.message import (
@@ -441,10 +443,15 @@ class ResponseCache:
         if capacity <= 0:
             raise ValueError("ResponseCache capacity must be positive")
         self.capacity = capacity
+        # The cache is confined to the coordinator's cycle thread;
+        # state_fingerprint is a test probe called on quiesced worlds.
+        # hvdlint: owned-by=hvd-background -- cycle-thread-confined cache
         self.epoch = epoch0  # hvdlint: world-replicated
         # name -> entry, maintained in LRU order (first = oldest)
+        # hvdlint: owned-by=hvd-background -- cycle-thread-confined cache
         self._lru: "OrderedDict[str, _CacheEntry]" = \
             OrderedDict()  # hvdlint: world-replicated
+        # hvdlint: owned-by=hvd-background -- cycle-thread-confined cache
         self._slots: List[Optional[_CacheEntry]] = \
             []  # hvdlint: world-replicated
         # min-heap of freed slot indices
@@ -599,6 +606,14 @@ class StallInspector:
         self.shutdown_time = shutdown_time
         self.disabled = disabled
         self._last_check = time.monotonic()
+        # Warned-set is touched from two threads: the coordinator's
+        # cycle thread warns (check), while tensor_completed fires
+        # from whichever thread removes the entry (the MessageTable
+        # on_remove hook — the submitting thread on the enqueue-fail
+        # path). The membership test and the add must be atomic
+        # against the discard or a name warns twice.
+        self._warned_lock = lockdep.lock(
+            "coordinator.StallInspector._warned_lock")
         self._warned: set = set()
 
     def should_check(self) -> bool:
@@ -610,7 +625,8 @@ class StallInspector:
         """A stalled tensor finally negotiated: forget that we warned
         about it, so the SAME recurring name stalling again later in
         the process lifetime warns again (MessageTable.remove hook)."""
-        self._warned.discard(name)
+        with self._warned_lock:
+            self._warned.discard(name)
 
     def check(self, table: MessageTable, cache_stats: str = "",
               world_stats: str = "",
@@ -644,11 +660,13 @@ class StallInspector:
                 continue
             missing = [r for r in range(self.size)
                        if r not in ranks_reported]
-            if name in self._warned:
-                if self.shutdown_time > 0 and age >= self.shutdown_time:
-                    must_shutdown = True
-                continue
-            self._warned.add(name)
+            with self._warned_lock:
+                if name in self._warned:
+                    if self.shutdown_time > 0 and \
+                            age >= self.shutdown_time:
+                        must_shutdown = True
+                    continue
+                self._warned.add(name)
             hlog.warning(
                 f"One or more tensors were submitted to be reduced, "
                 f"gathered or broadcasted by subset of ranks and are "
@@ -662,3 +680,13 @@ class StallInspector:
                     f"threshold of {self.shutdown_time} s; shutting down.")
                 must_shutdown = True
         return must_shutdown
+# -- thread-affinity sanitizer (HOROVOD_TPU_THREADCHECK) ------------------
+# Both fields are cycle-thread-confined after construction; the first
+# write (the constructor, on whatever thread builds the coordinator)
+# is free by Thread.start()'s happens-before.
+threadcheck.install(ResponseCache, "epoch",
+                    "coordinator.ResponseCache.epoch",
+                    owner="hvd-background")
+threadcheck.install(StallInspector, "_last_check",
+                    "coordinator.StallInspector._last_check",
+                    owner="hvd-background")

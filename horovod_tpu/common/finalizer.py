@@ -26,6 +26,7 @@ from typing import Callable, List
 
 from horovod_tpu.common import lockdep
 from horovod_tpu.common import logging as hlog
+from horovod_tpu.common import threadcheck
 
 
 class Finalizer:
@@ -53,6 +54,7 @@ class Finalizer:
 
     @staticmethod
     def _run(fn: Callable[[], None]) -> None:
+        threadcheck.register_role("hvd-finalizer")
         try:
             fn()
         except Exception as e:  # a closure must never kill the process

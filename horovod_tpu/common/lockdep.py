@@ -58,6 +58,15 @@ def _get_mode() -> str:
     global _mode
     if _mode is None:
         raw = hconfig.env_str("HOROVOD_TPU_LOCKCHECK", "").strip().lower()
+        if not raw and hconfig.env_str(
+                "HOROVOD_TPU_THREADCHECK", "").strip():
+            # The thread-affinity sanitizer (threadcheck.py) uses this
+            # thread's held-lock stack as its "synchronized" witness;
+            # plain unwrapped locks never feed it, so arming
+            # threadcheck alone would turn every lock-protected
+            # cross-role write into a false positive.
+            raw = "warn"
+        # hvdlint: owned-by=main -- idempotent lazy cache of one env read: every racing writer stores the same value, and reset() is test-only
         _mode = _MODE_MAP.get(raw, "")
     return _mode
 

@@ -42,6 +42,7 @@ import threading
 import time
 
 from horovod_tpu.common import lockdep
+from horovod_tpu.common import threadcheck
 from bisect import bisect_left
 from typing import Callable, Dict, List, Tuple
 
@@ -503,6 +504,7 @@ class MetricsHTTPServer:
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib handler contract)
+                threadcheck.register_role("hvd-metrics-http")
                 try:
                     snap = world_fn()
                     if self.path.startswith("/metrics.json"):

@@ -346,6 +346,7 @@ class Channel:
         if self._c_secret is None:
             import ctypes
             secret = self.secret or b""
+            # hvdlint: owned-by=main -- channel-confined lazy init: a Channel is serviced by one thread at a time, and the buffer is rebuilt identically from the immutable secret
             self._c_secret = (
                 ctypes.c_uint8 * max(1, len(secret))).from_buffer_copy(
                 secret or b"\x00")

@@ -43,6 +43,7 @@ import time
 from typing import List, Optional
 
 from horovod_tpu.common import lockdep
+from horovod_tpu.common import threadcheck
 
 # Steady predictor slots are capped (runtime keeps the most recent
 # masks); more buckets than this could never all stay steady at once.
@@ -238,6 +239,7 @@ class OverlapRunner:
 
     # -- runner thread -------------------------------------------------
     def _loop(self) -> None:
+        threadcheck.register_role("hvd-overlap")
         while True:
             with self._cv:
                 while not self._stopped and (
@@ -272,3 +274,7 @@ class OverlapRunner:
                     self._on_complete()
                 except Exception:
                     pass
+# -- thread-affinity sanitizer (HOROVOD_TPU_THREADCHECK) ------------------
+threadcheck.install(OverlapRunner, "_cycles_total",
+                    "overlap.OverlapRunner._cycles_total",
+                    owner="hvd-overlap")

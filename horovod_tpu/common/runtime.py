@@ -29,6 +29,7 @@ from horovod_tpu.common import arena as harena
 from horovod_tpu.common import elastic as helastic
 from horovod_tpu.common import faults
 from horovod_tpu.common import lockdep
+from horovod_tpu.common import threadcheck
 from horovod_tpu.common import logging as hlog
 from horovod_tpu.common import metrics as hmetrics
 from horovod_tpu.common import overlap as hoverlap
@@ -108,6 +109,13 @@ class Runtime:
         # earlier build and every hook a no-op.
         self._world_id = int(getattr(config, "world_id", 0))
         self._tenant = getattr(config, "tenant_name", "")
+        # Lane binding races teardown (bind arrives from the tenant
+        # attach path while an abort is unwinding on the background
+        # loop): the lock makes bind-vs-unregister atomic and the
+        # closed flag keeps a late bind from resurrecting a lane on a
+        # dead runtime — the scheduler would hold it forever.
+        self._lane_lock = lockdep.lock("runtime.Runtime._lane_lock")
+        self._lane_closed = False
         self._tenant_lane = None
         self._dtypes: Dict[str, DataType] = {}
         # name -> elements per dim-0 row, for allgather fusion byte
@@ -457,6 +465,10 @@ class Runtime:
             "hvd_lockcheck_inversions_total",
             "lock-order inversions observed by the runtime lockdep "
             "(HOROVOD_TPU_LOCKCHECK; 0 when unarmed)")
+        self._m_affinity_violations = reg.counter(
+            "hvd_threadcheck_violations_total",
+            "thread-affinity violations observed by the runtime "
+            "sanitizer (HOROVOD_TPU_THREADCHECK; 0 when unarmed)")
         # -- elastic worlds (HOROVOD_ELASTIC, common/elastic.py) -----
         # The context survives re-inits; each new Runtime generation
         # mirrors its counters so resize history rides the PR 4 plane.
@@ -790,6 +802,7 @@ class Runtime:
 
     # -- the loop --------------------------------------------------------
     def _background_loop(self) -> None:
+        threadcheck.register_role("hvd-background")
         try:
             while self._run_loop_once():
                 pass
@@ -838,13 +851,18 @@ class Runtime:
         # Tenant lane first (stage-guarded): a dying tenant must stop
         # counting as a scheduling contender, or its co-tenants would
         # defer against a ghost until its user-level shutdown ran.
-        if self._tenant_lane is not None:
+        with self._lane_lock:
+            lane, self._tenant_lane = self._tenant_lane, None
+            self._lane_closed = True
+        # unregister OUTSIDE the lane lock: the scheduler takes its
+        # own lock, and the attach path (scheduler -> bind_tenant_lane
+        # -> lane lock) already fixes the opposite nesting order.
+        if lane is not None:
             try:
                 from horovod_tpu.common import tenancy as _tenancy
-                _tenancy.scheduler().unregister(self._tenant_lane)
+                _tenancy.scheduler().unregister(lane)
             except Exception:
                 pass
-            self._tenant_lane = None
         # Overlap runner first: its thread may sit inside a native
         # cycle against channels about to close — stop accepting work,
         # let the armed recv deadline return the call, and join. Any
@@ -1003,7 +1021,12 @@ class Runtime:
         scheduler: cycles with local work acquire the lane (QoS-
         weighted interleave + quota deferral, bounded far under the
         heartbeat deadline) and report their negotiated bytes back."""
-        self._tenant_lane = lane
+        with self._lane_lock:
+            if self._lane_closed:
+                # Teardown already unwound: binding now would leave the
+                # scheduler holding a lane no cycle loop will ever pace.
+                return
+            self._tenant_lane = lane
 
     def _stamp(self, frame: bytes) -> bytes:
         return wire.stamp_world(frame, self._world_id) \
@@ -2352,6 +2375,8 @@ class Runtime:
         self._m_arena_bytes.set(harena.total_bytes())
         self._m_queue_depth.set(len(self.tensor_table))
         self._m_lock_inversions.set_total(lockdep.inversion_count())
+        self._m_affinity_violations.set_total(
+            threadcheck.violation_count())
         self._m_trace_spans.set_total(self._trace_spans_sent)
         for r, age in self.controller.peer_heartbeat_ages().items():
             self.metrics.gauge(
@@ -2776,3 +2801,9 @@ class Runtime:
                 for e in entries:
                     if e.callback:
                         e.callback(status)
+# -- thread-affinity sanitizer (HOROVOD_TPU_THREADCHECK) ------------------
+# Checked-field ids mirror the static thread-ownership analyzer's.
+# _tenant_lane has no fixed owner: it legitimately migrates (main
+# binds, background unwinds) under Runtime._lane_lock.
+threadcheck.install(Runtime, "_tenant_lane",
+                    "runtime.Runtime._tenant_lane")

@@ -59,6 +59,7 @@ import zlib
 from typing import Dict, List, Optional
 
 from horovod_tpu.common import lockdep
+from horovod_tpu.common import threadcheck
 from horovod_tpu.common import logging as hlog
 from horovod_tpu.common import network
 from horovod_tpu.common import wire
@@ -192,8 +193,7 @@ class TenantScheduler:
     _IDLE_RESET_S = 0.25
 
     def __init__(self):
-        self._cv = threading.Condition(
-            lockdep.lock("tenancy.TenantScheduler._lock"))
+        self._cv = lockdep.condition("tenancy.TenantScheduler._lock")
         self._lanes: List[_Lane] = []
 
     def _vmax(self) -> float:
@@ -627,8 +627,7 @@ class ServiceGate:
         self._secret = secret
         self._server = network.listen(port)
         self.port = self._server.getsockname()[1]
-        self._cv = threading.Condition(
-            lockdep.lock("tenancy.ServiceGate._lock"))
+        self._cv = lockdep.condition("tenancy.ServiceGate._lock")
         self._closing = False
         # tenant name -> {"group": n, "members": {replica: (host, port)},
         #                 "chans": {replica: Channel}, "lease": id}
@@ -660,6 +659,7 @@ class ServiceGate:
 
     # -- accept / per-replica service ------------------------------------
     def _accept_loop(self) -> None:
+        threadcheck.register_role("hvd-service-gate")
         self._server.settimeout(0.5)
         while not self._closing:
             try:
@@ -677,6 +677,7 @@ class ServiceGate:
             self._threads.append(t)
 
     def _serve_replica(self, sock) -> None:
+        threadcheck.register_role("serve_replica")
         ch = None
         tenant = replica = None
         try:

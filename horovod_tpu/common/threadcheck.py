@@ -1,0 +1,208 @@
+"""Runtime thread-affinity sanitizer: ``HOROVOD_TPU_THREADCHECK``.
+
+The dynamic half of hvdlint's static ``thread-ownership`` analyzer
+(see docs/static_analysis.md), built exactly like lockdep: the static
+pass proves what its resolver can follow; this sanitizer observes
+what actually runs — callback indirection, monkeypatched seams,
+thread hops the call graph hides.
+
+Design: long-lived threads **register a role** at their entry point
+(the same role names the static analyzer derives from the spawn
+site's ``Thread(name=...)``: ``hvd-background``, ``hvd-overlap``,
+``hvd-worldtrace-writer``, ...; unregistered threads — including the
+user's — are ``main``). A handful of **checked fields** (the same
+``module.Class.attr`` ids the analyzer reports) are wrapped in a
+write-intercepting descriptor. The rule mirrors the analyzer's:
+
+* the FIRST write to a field on an object is free — that is
+  constructor initialization, published to every later thread by
+  ``Thread.start()``'s happens-before;
+* after that, a write is legal when it comes from the field's owning
+  role, or from any role while a lockdep-tracked lock is held (the
+  runtime's witness for "synchronized");
+* anything else raises :class:`ThreadAffinityError` naming the field,
+  the owning role and the trespassing role (``warn`` mode logs and
+  counts instead — production triage). Either mode feeds
+  ``hvd_threadcheck_violations_total`` on the metrics plane, mirrored
+  by the runtime collector next to the lockcheck counter.
+
+Fields declared without a fixed owner track the LAST legal writer as
+owner — right for handoff fields like ``Runtime._tenant_lane`` whose
+ownership legitimately migrates under its lock.
+
+Modes:
+
+* ``HOROVOD_TPU_THREADCHECK=1`` (or ``raise``/``on``/``true``) —
+  raise at the violating write. Armed in the multiprocess test
+  worlds, so every mp scenario doubles as an affinity regression
+  test.
+* ``HOROVOD_TPU_THREADCHECK=warn`` — log + count, never raise.
+* unset/empty — :func:`install` leaves the class untouched: checked
+  fields stay plain instance attributes (zero steady-state overhead;
+  the would-be sites are enumerable via :func:`sites` so a test can
+  assert the no-op).
+
+Arming threadcheck implicitly arms lockdep in ``warn`` mode when
+``HOROVOD_TPU_LOCKCHECK`` is unset: the "held lock" witness comes
+from lockdep's per-thread stack, which plain (unwrapped) locks never
+feed — without it every lock-protected cross-role write would be a
+false positive.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+from horovod_tpu.common import config as hconfig
+from horovod_tpu.common import lockdep
+
+
+class ThreadAffinityError(RuntimeError):
+    """Unsynchronized cross-role write to a checked field."""
+
+
+_MODE_MAP = {"1": "raise", "true": "raise", "on": "raise",
+             "raise": "raise", "warn": "warn"}
+_mode: Optional[str] = None          # None = env not read yet
+_violations = 0
+_count_lock = threading.Lock()
+_tls = threading.local()
+
+# Every field ever handed to install(), armed or not — the test
+# surface for "unarmed means untouched": (cls, attr, field_id, owner).
+_SITES: List[Tuple[type, str, str, Optional[str]]] = []
+
+MAIN_ROLE = "main"
+_OWNER_PREFIX = "_tc_owner::"
+
+
+def _get_mode() -> str:
+    global _mode
+    if _mode is None:
+        raw = hconfig.env_str(
+            "HOROVOD_TPU_THREADCHECK", "").strip().lower()
+        # hvdlint: owned-by=main -- idempotent lazy cache of one env read: every racing writer stores the same value, and reset() is test-only
+        _mode = _MODE_MAP.get(raw, "")
+    return _mode
+
+
+def enabled() -> bool:
+    return bool(_get_mode())
+
+
+def violation_count() -> int:
+    """Lifetime observed violations (mirrored to the metrics plane as
+    hvd_threadcheck_violations_total by the runtime's collector)."""
+    return _violations
+
+
+def register_role(role: str) -> None:
+    """Adopt ``role`` for the calling thread — one line at the top of
+    each long-lived thread's entry point. No-op when unarmed."""
+    if _get_mode():
+        _tls.role = role
+
+
+def current_role() -> str:
+    return getattr(_tls, "role", MAIN_ROLE)
+
+
+def sites() -> List[Tuple[type, str, str, Optional[str]]]:
+    """All registered checked-field sites, armed or not."""
+    return list(_SITES)
+
+
+def _violate(msg: str) -> None:
+    global _violations
+    with _count_lock:
+        _violations += 1
+    if _get_mode() == "raise":
+        raise ThreadAffinityError(msg)
+    from horovod_tpu.common import logging as hlog
+    hlog.warning(f"threadcheck: {msg}")
+
+
+class _Checked:
+    """Write-intercepting data descriptor for one checked field.
+
+    Values live in the instance ``__dict__`` under the ATTRIBUTE'S OWN
+    name: objects built before arming keep working after a test
+    re-arms (their plain attribute becomes the descriptor's backing
+    slot), and stripping the descriptor hands the attribute straight
+    back to normal lookup."""
+
+    __slots__ = ("attr", "field_id", "fixed_owner", "owner_slot")
+
+    def __init__(self, attr: str, field_id: str,
+                 fixed_owner: Optional[str]):
+        self.attr = attr
+        self.field_id = field_id
+        self.fixed_owner = fixed_owner
+        self.owner_slot = _OWNER_PREFIX + attr
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        try:
+            return obj.__dict__[self.attr]
+        except KeyError:
+            raise AttributeError(self.attr) from None
+
+    def __set__(self, obj, value) -> None:
+        d = obj.__dict__
+        role = current_role()
+        if self.attr not in d:
+            # First write: constructor init, published to every
+            # thread the owner starts afterwards (Thread.start
+            # happens-before). Record nothing for fixed-owner fields;
+            # seed migrating ones with the declared start.
+            d[self.owner_slot] = self.fixed_owner or role
+        else:
+            owner = d.get(self.owner_slot, MAIN_ROLE)
+            if role != owner and not lockdep._held():
+                self._violation(owner, role)
+            elif self.fixed_owner is None:
+                d[self.owner_slot] = role
+        d[self.attr] = value
+
+    def __delete__(self, obj) -> None:
+        obj.__dict__.pop(self.attr, None)
+        obj.__dict__.pop(self.owner_slot, None)
+
+    def _violation(self, owner: str, role: str) -> None:
+        _violate(
+            f"field '{self.field_id}' is owned by role '{owner}' but "
+            f"thread '{threading.current_thread().name}' (role "
+            f"'{role}') rebinds it with no lock held — take the "
+            f"owning lock, or fix the ownership story (see "
+            f"docs/troubleshooting.md)")
+
+
+def install(cls: type, attr: str, field_id: str,
+            owner: Optional[str] = None) -> None:
+    """Declare ``cls.attr`` a checked field named ``field_id`` (the
+    static analyzer's ``module.Class.attr`` id). ``owner`` pins the
+    owning role; None lets ownership migrate with each legal write.
+    Called at module import right after the class body; when unarmed
+    this records the site and touches NOTHING — the attribute stays a
+    plain instance attribute."""
+    _SITES.append((cls, attr, field_id, owner))
+    if _get_mode():
+        setattr(cls, attr, _Checked(attr, field_id, owner))
+
+
+def reset(mode: Optional[str] = None) -> None:
+    """Tests only: drop the counter, force (or re-read) the mode, and
+    re-apply or strip the descriptors across every registered site."""
+    global _mode, _violations
+    with _count_lock:
+        _violations = 0
+    _mode = _MODE_MAP.get(mode, "") if mode is not None else None
+    armed = bool(_get_mode())
+    for cls, attr, field_id, owner in _SITES:
+        current = cls.__dict__.get(attr)
+        if armed and not isinstance(current, _Checked):
+            setattr(cls, attr, _Checked(attr, field_id, owner))
+        elif not armed and isinstance(current, _Checked):
+            delattr(cls, attr)

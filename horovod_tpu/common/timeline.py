@@ -24,6 +24,7 @@ import time
 from typing import Dict, Optional
 
 from horovod_tpu.common import lockdep
+from horovod_tpu.common import threadcheck
 from horovod_tpu.common.message import RequestType
 
 # Activity names (reference: common.h:30-51 macros).
@@ -114,6 +115,7 @@ class Timeline(_NoOpTimeline):
 
     # -- writer thread (reference: timeline.h:46-74 TimelineWriter) ------
     def _write_loop(self):
+        threadcheck.register_role("hvd-timeline-writer")
         with open(self._path, "w") as f:
             f.write("[\n")
             first = True

@@ -57,6 +57,7 @@ from typing import Dict, List, Optional, Tuple
 
 from horovod_tpu.common import config as hconfig
 from horovod_tpu.common import lockdep
+from horovod_tpu.common import threadcheck
 from horovod_tpu.common import logging as hlog
 from horovod_tpu.common.wire import (
     EV_ABORT, EV_CYCLE, EV_ELASTIC, EV_FAULT, EV_MARK, EV_NAMES,
@@ -207,7 +208,7 @@ class ClockSync:
 
 
 _CLOCK: Optional[ClockSync] = None
-_CLOCK_LOCK = threading.Lock()
+_CLOCK_LOCK = lockdep.lock("trace._CLOCK_LOCK")
 
 
 def clock() -> ClockSync:
@@ -418,7 +419,7 @@ class FlightRecorder(_NoOpRecorder):
 
 
 _FLIGHT = None
-_FLIGHT_LOCK = threading.Lock()
+_FLIGHT_LOCK = lockdep.lock("trace._FLIGHT_LOCK")
 
 
 def flight():
@@ -644,6 +645,7 @@ class WorldTraceWriter:
             self.dropped_events += 1
 
     def _write_loop(self):
+        threadcheck.register_role("hvd-worldtrace-writer")
         with open(self._path, "w") as f:
             f.write("[\n")
             first = True
@@ -737,3 +739,8 @@ def clock_offsets_line() -> str:
     parts = [f"rank {r} {o * 1000.0:+.1f}ms (rtt {rtt * 1000.0:.1f}ms)"
              for r, (o, rtt) in sorted(offs.items())]
     return ", ".join(parts)
+# -- thread-affinity sanitizer (HOROVOD_TPU_THREADCHECK) ------------------
+# No fixed owner: rebound under WorldTraceWriter._lock from whichever
+# control-plane thread folds a rank's batch.
+threadcheck.install(WorldTraceWriter, "spans_written",
+                    "trace.WorldTraceWriter.spans_written")

@@ -68,6 +68,7 @@ _RESP_TYPE_OF = ResponseType._value2member_map_
 
 class _Writer:
     def __init__(self):
+        # hvdlint: owned-by=main -- codec objects are function-local: built, filled and drained inside one call frame, never shared
         self.parts = []
 
     def u8(self, v): self.parts.append(_U8.pack(v))
@@ -88,6 +89,7 @@ class _Writer:
 class _Reader:
     def __init__(self, data: bytes, offset: int = 0):
         self.data = data
+        # hvdlint: owned-by=main -- codec objects are function-local: built, consumed and dropped inside one call frame, never shared
         self.off = offset
 
     def _need(self, n: int) -> None:

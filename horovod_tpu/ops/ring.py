@@ -33,6 +33,7 @@ import numpy as np
 from horovod_tpu import native as _native
 from horovod_tpu.common import logging as hlog
 from horovod_tpu.common import network
+from horovod_tpu.common import threadcheck
 from horovod_tpu.common.metrics import NOOP_METRIC
 
 _TAG_RING_HELLO = 40
@@ -89,6 +90,7 @@ class Ring:
         err: List[Exception] = []
 
         def _send():
+            threadcheck.register_role("hvd-ring-send")
             try:
                 self._next.send(send_arr, _TAG_RING_DATA)
             except Exception as e:  # surfaced after join

@@ -331,9 +331,12 @@ class SocketBackend(CollectiveBackend):
             from horovod_tpu.ops import ring as _ring
             self._ring = _ring.establish(self._ctl, self._secret,
                                          hb=self._ring_hb)
-            if self._ring is not None \
-                    and self._m_ring_link_bytes is not None:
-                self._ring.m_link_bytes = self._m_ring_link_bytes
+            # Capture the rebindable metric hook once: a metrics-plane
+            # re-registration between the None test and the use would
+            # hand the ring a half-initialized counter.
+            m_link = self._m_ring_link_bytes
+            if self._ring is not None and m_link is not None:
+                self._ring.m_link_bytes = m_link
         return self._ring
 
     # -- allreduce -------------------------------------------------------

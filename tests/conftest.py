@@ -13,6 +13,19 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+import tempfile  # noqa: E402
+
+# The flight recorder (common/trace.py) is ON by default and dumps
+# into HOROVOD_TPU_FLIGHT_DIR (default: CWD) on every world abort.
+# test_multiprocess._base_env already points SPAWNED worlds at a
+# throwaway dir, but IN-PROCESS aborts (e.g. test_timeline driving
+# WorldAbortedError through Runtime directly) dump from this very
+# process — without a default here each such test leaves a pid-unique
+# hvd-flight-*.jsonl in the checkout. setdefault keeps any operator-
+# or test-provided dir authoritative.
+os.environ.setdefault("HOROVOD_TPU_FLIGHT_DIR",
+                      tempfile.mkdtemp(prefix="hvd-flight-conftest."))
+
 import pytest  # noqa: E402
 
 # The container's sitecustomize may already have imported jax to register

@@ -2,18 +2,26 @@
 
 Two tiers in one module, both fast/in-process (pytest.mark.lint):
 
-* the PROJECT gate — all five analyzers over ``horovod_tpu/`` must
+* the PROJECT gate — all eight analyzers over ``horovod_tpu/`` must
   report zero findings (this is the tier-1 rendering of the
   acceptance bar `python -m tools.hvdlint horovod_tpu` exits 0);
 * per-analyzer FIXTURES — for every analyzer, a known-bad snippet that
   must fire and a known-good twin that must stay silent, proving each
   detection is real rather than vacuously green;
+* real-tree MUTATION tests — each seeded historical bug class (and
+  each true positive this suite ever fixed) is textually reintroduced
+  into a scratch copy of the package and the analyzer must re-find it,
+  proving the gate is live on the shipped code, not just on fixtures;
+* the ``--changed`` cache — whole-tree replay semantics and every
+  invalidation trigger (edit, rename, pragma tweak, analyzer change);
 * runtime lockdep unit tests — inversion raise/warn/count semantics,
   condition-variable transparency, metrics mirror.
 """
 
+import glob
 import json
 import os
+import shutil
 import subprocess
 import sys
 import textwrap
@@ -1112,3 +1120,652 @@ def test_logging_lock_level_env_still_works(monkeypatch, capsys):
         assert not any(ch.isdigit() for ch in err.split("[3]")[0])
     finally:
         hlog.reset_level()
+
+
+# -- CLI --list completeness ------------------------------------------------
+
+def test_list_names_every_analyzer():
+    """--list is the discovery surface: a registered analyzer missing
+    here (or an unregistered module) is a silent hole in the gate."""
+    from tools.hvdlint.core import get_analyzers
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.hvdlint", "--list"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    listed = out.stdout.split()
+    assert listed == sorted(get_analyzers())
+    assert listed == [
+        "knobs", "lock-order", "native-codec", "native-lifetime",
+        "teardown", "thread-ownership", "wire-protocol",
+        "world-coherence"]
+
+
+# -- thread-ownership -------------------------------------------------------
+
+# check 1: compound writes from two roles, nothing ordering them
+BAD_MULTI_ROLE_WRITE = """
+    import threading
+
+    class Svc:
+        def __init__(self):
+            self._stats = {}
+            t = threading.Thread(target=self._loop,
+                                 name="hvd-background", daemon=True)
+            t.start()
+
+        def _loop(self):
+            self._stats["cycles"] = 1
+
+        def public(self):
+            self._stats["calls"] = 2
+"""
+
+GOOD_MULTI_ROLE_WRITE = """
+    import threading
+
+    class Svc:
+        def __init__(self):
+            self._lk = threading.Lock()
+            self._stats = {}
+            t = threading.Thread(target=self._loop,
+                                 name="hvd-background", daemon=True)
+            t.start()
+
+        def _loop(self):
+            with self._lk:
+                self._stats["cycles"] = 1
+
+        def public(self):
+            with self._lk:
+                self._stats["calls"] = 2
+"""
+
+
+def test_thread_ownership_multi_role_write_fires(tmp_path):
+    fs = _lint_snippet(tmp_path, BAD_MULTI_ROLE_WRITE,
+                       "thread-ownership")
+    msgs = "\n".join(f.message for f in fs)
+    assert "compound writes" in msgs and "hvd-background" in msgs, fs
+
+
+def test_thread_ownership_locked_writes_clean(tmp_path):
+    assert _lint_snippet(tmp_path, GOOD_MULTI_ROLE_WRITE,
+                         "thread-ownership") == []
+
+
+# check 2: single writer, foreign lock-free reader, no snapshot-swap
+BAD_UNPUBLISHED_WRITE = """
+    import threading
+
+    class Svc:
+        def __init__(self):
+            self._table = {}
+            t = threading.Thread(target=self._loop,
+                                 name="hvd-background", daemon=True)
+            t.start()
+
+        def _loop(self):
+            self._table["x"] = 1
+
+        def read(self):
+            return self._table.get("x")
+"""
+
+# the snapshot-swap idiom: the writer rebinds a freshly built dict in
+# one assignment — a lock-free reader sees old or new, never a hybrid
+GOOD_SNAPSHOT_SWAP = """
+    import threading
+
+    class Svc:
+        def __init__(self):
+            self._table = {}
+            t = threading.Thread(target=self._loop,
+                                 name="hvd-background", daemon=True)
+            t.start()
+
+        def _loop(self):
+            self._table = {"x": 1}
+
+        def read(self):
+            return self._table.get("x")
+"""
+
+
+def test_thread_ownership_unpublished_write_fires(tmp_path):
+    fs = _lint_snippet(tmp_path, BAD_UNPUBLISHED_WRITE,
+                       "thread-ownership")
+    msgs = "\n".join(f.message for f in fs)
+    assert "read from role(s)" in msgs and "['main']" in msgs, fs
+
+
+def test_thread_ownership_snapshot_swap_clean(tmp_path):
+    assert _lint_snippet(tmp_path, GOOD_SNAPSHOT_SWAP,
+                         "thread-ownership") == []
+
+
+# check 3: the _on_arrivals shape — a rebindable hook read twice with
+# a rebind possible between the reads (if self.hook: self.hook())
+BAD_CAPTURE_ONCE = """
+    class Svc:
+        _hook = None
+
+        def attach(self, cb):
+            self._hook = cb
+
+        def fire(self):
+            if self._hook is not None:
+                self._hook(1)
+"""
+
+GOOD_CAPTURE_ONCE = """
+    class Svc:
+        _hook = None
+
+        def attach(self, cb):
+            self._hook = cb
+
+        def fire(self):
+            hook = self._hook
+            if hook is not None:
+                hook(1)
+"""
+
+
+def test_thread_ownership_capture_once_fires(tmp_path):
+    fs = _lint_snippet(tmp_path, BAD_CAPTURE_ONCE, "thread-ownership")
+    msgs = "\n".join(f.message for f in fs)
+    assert "capture it into a local once" in msgs, fs
+
+
+def test_thread_ownership_captured_hook_clean(tmp_path):
+    assert _lint_snippet(tmp_path, GOOD_CAPTURE_ONCE,
+                         "thread-ownership") == []
+
+
+def test_thread_ownership_sees_through_inheritance(tmp_path):
+    """A base-declared hook read from a derived-class method is the
+    SAME storage — the exact split that hid the original
+    Controller._on_arrivals bug from a per-class field model."""
+    code = """
+        class Base:
+            _hook = None
+
+            def attach(self, cb):
+                self._hook = cb
+
+        class Derived(Base):
+            def fire(self):
+                if self._hook is not None:
+                    self._hook(1)
+    """
+    fs = _lint_snippet(tmp_path, code, "thread-ownership")
+    msgs = "\n".join(f.message for f in fs)
+    assert "mod.Base._hook" in msgs, fs
+
+
+# check 4: the mark_done shape — gate published before the payload a
+# lock-free reader keys on
+BAD_PUBLISH_ORDER = """
+    import threading
+
+    class Table:
+        def __init__(self):
+            self._lk = threading.Lock()
+            self._res = {}
+            self._out = {}
+
+        def done(self, h, status, output):
+            with self._lk:
+                self._res[h] = status
+                self._out[h] = output
+
+        def poll(self, h):
+            return self._res.get(h) is not None
+
+        def get(self, h):
+            return self._out[h]
+"""
+
+GOOD_PUBLISH_ORDER = """
+    import threading
+
+    class Table:
+        def __init__(self):
+            self._lk = threading.Lock()
+            self._res = {}
+            self._out = {}
+
+        def done(self, h, status, output):
+            with self._lk:
+                self._out[h] = output
+                self._res[h] = status
+
+        def poll(self, h):
+            return self._res.get(h) is not None
+
+        def get(self, h):
+            return self._out[h]
+"""
+
+
+def test_thread_ownership_publish_order_fires(tmp_path):
+    fs = _lint_snippet(tmp_path, BAD_PUBLISH_ORDER, "thread-ownership")
+    msgs = "\n".join(f.message for f in fs)
+    assert "before storing payload" in msgs, fs
+
+
+def test_thread_ownership_payload_first_clean(tmp_path):
+    assert _lint_snippet(tmp_path, GOOD_PUBLISH_ORDER,
+                         "thread-ownership") == []
+
+
+def test_thread_ownership_pragma_suppresses_with_justification(tmp_path):
+    code = BAD_MULTI_ROLE_WRITE.replace(
+        'self._stats["calls"] = 2',
+        'self._stats["calls"] = 2  '
+        '# hvdlint: owned-by=main -- single-writer in this app')
+    assert _lint_snippet(tmp_path, code, "thread-ownership") == []
+
+
+def test_thread_ownership_pragma_requires_justification(tmp_path):
+    code = BAD_MULTI_ROLE_WRITE.replace(
+        'self._stats["calls"] = 2',
+        'self._stats["calls"] = 2  # hvdlint: owned-by=main')
+    fs = _lint_snippet(tmp_path, code, "thread-ownership")
+    msgs = "\n".join(f.message for f in fs)
+    assert "justification" in msgs, fs
+
+
+# -- native-lifetime --------------------------------------------------------
+
+BAD_INLINE_TEMPORARY = """
+    import ctypes
+    import numpy as np
+
+    def call(lib, x):
+        lib.hvd_pack(np.ascontiguousarray(x).ctypes.data_as(
+            ctypes.c_void_p))
+"""
+
+GOOD_NAMED_BUFFER = """
+    import ctypes
+    import numpy as np
+
+    def call(lib, x):
+        buf = np.ascontiguousarray(x)
+        lib.hvd_pack(buf.ctypes.data_as(ctypes.c_void_p))
+"""
+
+
+def test_native_lifetime_inline_temporary_fires(tmp_path):
+    fs = _lint_snippet(tmp_path, BAD_INLINE_TEMPORARY,
+                       "native-lifetime")
+    msgs = "\n".join(f.message for f in fs)
+    assert "unnamed temporary" in msgs, fs
+
+
+def test_native_lifetime_named_buffer_clean(tmp_path):
+    assert _lint_snippet(tmp_path, GOOD_NAMED_BUFFER,
+                         "native-lifetime") == []
+
+
+BAD_TEMP_CALLBACK = """
+    import ctypes
+
+    ON_IDLE = ctypes.CFUNCTYPE(None)
+
+    def install(lib, f):
+        lib.hvd_set_idle(ON_IDLE(f))
+"""
+
+GOOD_OWNED_CALLBACK = """
+    import ctypes
+
+    ON_IDLE = ctypes.CFUNCTYPE(None)
+
+    class Hooks:
+        def install(self, lib, f):
+            self._cb = ON_IDLE(f)
+            lib.hvd_set_idle(self._cb)
+"""
+
+
+def test_native_lifetime_temp_callback_fires(tmp_path):
+    fs = _lint_snippet(tmp_path, BAD_TEMP_CALLBACK, "native-lifetime")
+    msgs = "\n".join(f.message for f in fs)
+    assert "CFUNCTYPE" in msgs, fs
+
+
+def test_native_lifetime_owned_callback_clean(tmp_path):
+    assert _lint_snippet(tmp_path, GOOD_OWNED_CALLBACK,
+                         "native-lifetime") == []
+
+
+BAD_ARENA_CACHE = """
+    import ctypes
+
+    class Ring:
+        def __init__(self):
+            self._ptr_cache = {}
+
+        def send(self, arena, n):
+            buf = arena.ensure(n)
+            key = ("send", n)
+            c = self._ptr_cache.get(key)
+            if c is None:
+                c = buf.ctypes.data_as(ctypes.c_void_p)
+                self._ptr_cache[key] = c
+            return c
+"""
+
+GOOD_ARENA_CACHE = """
+    import ctypes
+
+    class Ring:
+        def __init__(self):
+            self._ptr_cache = {}
+
+        def send(self, arena, n):
+            buf = arena.ensure(n)
+            key = ("send", n, arena.generation)
+            c = self._ptr_cache.get(key)
+            if c is None:
+                c = buf.ctypes.data_as(ctypes.c_void_p)
+                self._ptr_cache[key] = c
+            return c
+"""
+
+
+def test_native_lifetime_arena_cache_fires(tmp_path):
+    fs = _lint_snippet(tmp_path, BAD_ARENA_CACHE, "native-lifetime")
+    msgs = "\n".join(f.message for f in fs)
+    assert "generation" in msgs, fs
+
+
+def test_native_lifetime_generation_keyed_cache_clean(tmp_path):
+    assert _lint_snippet(tmp_path, GOOD_ARENA_CACHE,
+                         "native-lifetime") == []
+
+
+# -- real-tree mutation gates ----------------------------------------------
+# Each test reintroduces one shipped (or would-ship) bug into a scratch
+# copy of the package and asserts the analyzer re-finds it — the proof
+# that the gate bites on the real tree, not just on fixtures. The
+# mutated shapes are the three historical bug classes from the module
+# docstring of tools/hvdlint/thread_ownership.py plus the three true
+# positives this analyzer found (and this PR fixed) in the tree.
+
+@pytest.fixture(scope="module")
+def mut_tree(tmp_path_factory):
+    dst = str(tmp_path_factory.mktemp("mut") / "horovod_tpu")
+    shutil.copytree(os.path.join(REPO, "horovod_tpu"), dst,
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    return dst
+
+
+def _mutate_and_lint(tree, rel, transform, analyzer):
+    full = os.path.join(tree, rel)
+    with open(full) as f:
+        orig = f.read()
+    mutated = transform(orig)
+    assert mutated != orig, f"mutation anchor vanished in {rel}"
+    with open(full, "w") as f:
+        f.write(mutated)
+    try:
+        return lint_paths([tree], [analyzer])
+    finally:
+        with open(full, "w") as f:
+            f.write(orig)
+
+
+def test_mutation_on_arrivals_double_read_refound(mut_tree):
+    """Historical bug #1: the _on_arrivals hook read twice while
+    attach_trace can rebind it between the reads."""
+    def revert(s):
+        old = ("        on_arrivals = self._on_arrivals\n"
+               "        track = (expect_tag == TAG_REQUESTS\n"
+               "                 and on_arrivals is not None)")
+        assert old in s
+        s = s.replace(old,
+                      "        track = (expect_tag == TAG_REQUESTS\n"
+                      "                 and self._on_arrivals "
+                      "is not None)", 1)
+        return s.replace("on_arrivals(arrivals)",
+                         "self._on_arrivals(arrivals)")
+    fs = _mutate_and_lint(mut_tree, "common/controller.py", revert,
+                          "thread-ownership")
+    msgs = "\n".join(f.message for f in fs)
+    assert "controller.Controller._on_arrivals" in msgs \
+        and "capture it into a local once" in msgs, fs
+
+
+def test_mutation_mark_done_order_swap_refound(mut_tree):
+    """Historical bug #2: mark_done publishing the status gate before
+    the output payload that lock-free wait() keys on."""
+    def swap(s):
+        old = ("            self._outputs[handle] = output\n"
+               "            self._results[handle] = status")
+        assert old in s
+        return s.replace(
+            old,
+            "            self._results[handle] = status\n"
+            "            self._outputs[handle] = output", 1)
+    fs = _mutate_and_lint(mut_tree, "common/tensor_table.py", swap,
+                          "thread-ownership")
+    msgs = "\n".join(f.message for f in fs)
+    assert "tensor_table.HandleManager._results" in msgs \
+        and "before storing payload" in msgs, fs
+
+
+def test_mutation_bucket_sets_in_place_refound(mut_tree):
+    """Historical bug #3: note_bucket_names mutating the set in place
+    instead of snapshot-swapping a fresh frozenset."""
+    def aug(s):
+        old = "        self._bucket_sets = cur | {s}"
+        assert old in s
+        return s.replace(old, "        self._bucket_sets |= {s}", 1)
+    fs = _mutate_and_lint(mut_tree, "common/runtime.py", aug,
+                          "thread-ownership")
+    msgs = "\n".join(f.message for f in fs)
+    assert "runtime.Runtime._bucket_sets" in msgs, fs
+
+
+def test_mutation_coordinator_pragma_strip_refound(mut_tree):
+    """The ResponseCache audit is load-bearing: stripping the owned-by
+    pragmas must re-flag the fields, proving the clean tree is clean
+    because of reviewed justifications, not analyzer blindness."""
+    def strip(s):
+        return "".join(ln for ln in s.splitlines(True)
+                       if "hvdlint: owned-by" not in ln)
+    fs = _mutate_and_lint(mut_tree, "common/coordinator.py", strip,
+                          "thread-ownership")
+    msgs = "\n".join(f.message for f in fs)
+    assert "coordinator.ResponseCache" in msgs, fs
+
+
+def test_mutation_native_inline_temp_refound(mut_tree):
+    """native-lifetime real-tree gate: inlining pack()'s output buffer
+    into the call expression must be re-found."""
+    def inline(s):
+        assert "out.ctypes.data_as" in s
+        return s.replace("out.ctypes.data_as",
+                         "np.empty(total, dtype).ctypes.data_as", 1)
+    fs = _mutate_and_lint(mut_tree, "native.py", inline,
+                          "native-lifetime")
+    msgs = "\n".join(f.message for f in fs)
+    assert "unnamed temporary" in msgs, fs
+
+
+def test_mutation_steady_generation_strip_refound(mut_tree):
+    """native-lifetime real-tree gate: dropping the arena generation
+    from steady's iovec cache keys must be re-found (ensure()
+    reallocates on growth; a stale pointer bundle writes freed
+    memory)."""
+    def strip(s):
+        assert s.count("scratch.generation") >= 2
+        return s.replace("scratch.generation", "0")
+    fs = _mutate_and_lint(mut_tree, "common/steady.py", strip,
+                          "native-lifetime")
+    msgs = "\n".join(f.message for f in fs)
+    assert "generation" in msgs, fs
+
+
+def test_regression_stall_inspector_warned_lock(mut_tree):
+    """True positive #1 fixed by this analyzer: StallInspector._warned
+    was mutated from the caller thread with no lock while the
+    background sweep also writes it. Reverting the lock re-fires."""
+    def unlock(s):
+        old = ("        with self._warned_lock:\n"
+               "            self._warned.discard(name)")
+        assert old in s
+        return s.replace(old, "        self._warned.discard(name)", 1)
+    fs = _mutate_and_lint(mut_tree, "common/coordinator.py", unlock,
+                          "thread-ownership")
+    msgs = "\n".join(f.message for f in fs)
+    assert "coordinator.StallInspector._warned" in msgs, fs
+
+
+def test_regression_socket_ops_hook_capture(mut_tree):
+    """True positive #2: the ring's metric hook was tested then used
+    (two reads) while attach_metrics can rebind it between them."""
+    def revert(s):
+        old = ("            m_link = self._m_ring_link_bytes\n"
+               "            if self._ring is not None and m_link "
+               "is not None:\n"
+               "                self._ring.m_link_bytes = m_link")
+        assert old in s
+        return s.replace(
+            old,
+            "            if self._ring is not None and \\\n"
+            "                    self._m_ring_link_bytes "
+            "is not None:\n"
+            "                self._ring.m_link_bytes = "
+            "self._m_ring_link_bytes", 1)
+    fs = _mutate_and_lint(mut_tree, "ops/socket_ops.py", revert,
+                          "thread-ownership")
+    msgs = "\n".join(f.message for f in fs)
+    assert "socket_ops.SocketBackend._m_ring_link_bytes" in msgs, fs
+
+
+def test_regression_tenant_lane_handoff_lock(mut_tree):
+    """True positive #3: teardown handed _tenant_lane off with no lock
+    while the scheduler's attach path rebinds it from its own
+    thread. Reverting the lane lock re-fires."""
+    def unlock(s):
+        old = ("        with self._lane_lock:\n"
+               "            lane, self._tenant_lane = "
+               "self._tenant_lane, None\n"
+               "            self._lane_closed = True")
+        assert old in s
+        return s.replace(
+            old,
+            "        lane, self._tenant_lane = "
+            "self._tenant_lane, None\n"
+            "        self._lane_closed = True", 1)
+    fs = _mutate_and_lint(mut_tree, "common/runtime.py", unlock,
+                          "thread-ownership")
+    msgs = "\n".join(f.message for f in fs)
+    assert "runtime.Runtime._tenant_lane" in msgs, fs
+
+
+# -- the --changed cache ----------------------------------------------------
+
+def _seed_pkg(tmp_path):
+    pkg = tmp_path / "cpkg"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        "import os\nX = os.environ.get('HOROVOD_CACHE_PROBE')\n")
+    (pkg / "b.py").write_text("Y = 1\n")
+    return pkg
+
+
+def test_cache_replays_when_nothing_changed(tmp_path):
+    from tools.hvdlint import cache as hcache
+    pkg = _seed_pkg(tmp_path)
+    cf = str(tmp_path / "c.json")
+    findings = lint_paths([str(pkg)], ["knobs"])
+    assert findings, "seed must produce a finding"
+    hcache.save([str(pkg)], ["knobs"], cf, findings)
+    replay = hcache.load([str(pkg)], ["knobs"], cf)
+    assert replay is not None
+    assert [f.to_dict() for f in replay] == \
+        [f.to_dict() for f in findings]
+
+
+def test_cache_survives_mtime_touch(tmp_path):
+    from tools.hvdlint import cache as hcache
+    pkg = _seed_pkg(tmp_path)
+    cf = str(tmp_path / "c.json")
+    hcache.save([str(pkg)], ["knobs"], cf,
+                lint_paths([str(pkg)], ["knobs"]))
+    # mtime bump, identical content: sha1 fallback must still replay
+    a = pkg / "a.py"
+    os.utime(a, (os.path.getmtime(a) + 10,) * 2)
+    assert hcache.load([str(pkg)], ["knobs"], cf) is not None
+
+
+def test_cache_invalidated_by_edit(tmp_path):
+    from tools.hvdlint import cache as hcache
+    pkg = _seed_pkg(tmp_path)
+    cf = str(tmp_path / "c.json")
+    hcache.save([str(pkg)], ["knobs"], cf,
+                lint_paths([str(pkg)], ["knobs"]))
+    (pkg / "b.py").write_text("Y = 2\n")
+    assert hcache.load([str(pkg)], ["knobs"], cf) is None
+
+
+def test_cache_invalidated_by_rename(tmp_path):
+    from tools.hvdlint import cache as hcache
+    pkg = _seed_pkg(tmp_path)
+    cf = str(tmp_path / "c.json")
+    hcache.save([str(pkg)], ["knobs"], cf,
+                lint_paths([str(pkg)], ["knobs"]))
+    os.rename(pkg / "b.py", pkg / "b2.py")
+    assert hcache.load([str(pkg)], ["knobs"], cf) is None
+
+
+def test_cache_invalidated_by_pragma_change(tmp_path):
+    """A pragma edit changes no code object but DOES change findings —
+    it must invalidate like any other content change."""
+    from tools.hvdlint import cache as hcache
+    pkg = _seed_pkg(tmp_path)
+    cf = str(tmp_path / "c.json")
+    hcache.save([str(pkg)], ["knobs"], cf,
+                lint_paths([str(pkg)], ["knobs"]))
+    a = pkg / "a.py"
+    a.write_text(a.read_text() + "# hvdlint: disable=knobs -- probe\n")
+    assert hcache.load([str(pkg)], ["knobs"], cf) is None
+
+
+def test_cache_invalidated_by_analyzer_selection(tmp_path):
+    from tools.hvdlint import cache as hcache
+    pkg = _seed_pkg(tmp_path)
+    cf = str(tmp_path / "c.json")
+    hcache.save([str(pkg)], ["knobs"], cf,
+                lint_paths([str(pkg)], ["knobs"]))
+    assert hcache.load([str(pkg)], ["knobs", "teardown"], cf) is None
+
+
+def test_cache_cli_end_to_end(tmp_path):
+    pkg = _seed_pkg(tmp_path)
+    cf = str(tmp_path / "cli.json")
+    cmd = [sys.executable, "-m", "tools.hvdlint", str(pkg),
+           "--changed", "--cache-file", cf]
+    first = subprocess.run(cmd, cwd=REPO, capture_output=True,
+                           text=True, timeout=120)
+    assert first.returncode == 1 and os.path.exists(cf)
+    second = subprocess.run(cmd, cwd=REPO, capture_output=True,
+                            text=True, timeout=120)
+    assert second.returncode == 1
+    assert second.stdout == first.stdout
+
+
+# -- flight-recorder hygiene ------------------------------------------------
+
+def test_no_stray_flight_dumps_at_repo_root():
+    """In-process aborts used to dump hvd-flight-*.jsonl into the CWD
+    (the checkout, under pytest). tests/conftest.py now defaults
+    HOROVOD_TPU_FLIGHT_DIR to a throwaway dir; a stray file here means
+    some path bypassed it."""
+    strays = glob.glob(os.path.join(REPO, "hvd-flight-*.jsonl"))
+    assert strays == [], strays

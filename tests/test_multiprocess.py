@@ -36,6 +36,11 @@ def _base_env(extra_env=None):
     # — an acquisition-order inversion anywhere in the runtime raises
     # LockInversionError instead of someday deadlocking a real job.
     base.setdefault("HOROVOD_TPU_LOCKCHECK", "1")
+    # Same deal for the thread-affinity sanitizer (common/threadcheck
+    # .py): every checked field's cross-role write discipline is
+    # re-proven by every spawned world, raising ThreadAffinityError
+    # at the violating write instead of losing an update in prod.
+    base.setdefault("HOROVOD_TPU_THREADCHECK", "1")
     # The default-on flight recorder dumps into CWD on every abort;
     # point every spawned world at a throwaway dir so abort-path tests
     # don't litter the checkout with pid-unique postmortems (tests

@@ -1,0 +1,244 @@
+"""Runtime thread-affinity sanitizer (common/threadcheck.py).
+
+Unit tier for the dynamic half of hvdlint's thread-ownership
+analyzer: raise/warn/disabled modes, the first-write-free rule,
+lock-held cross-role writes, owner migration for unpinned fields, the
+unarmed no-op contract (checked fields stay plain attributes, sites
+enumerable), and the metrics-plane mirror of the violation counter.
+The mp tier arms HOROVOD_TPU_THREADCHECK=1 in every spawned world
+(tests/test_multiprocess.py::_base_env), so each multiprocess
+scenario doubles as a zero-violation affinity regression test; this
+module proves the sanitizer's own semantics in-process.
+"""
+
+import threading
+
+import pytest
+
+from horovod_tpu.common import lockdep, threadcheck
+from horovod_tpu.common.threadcheck import ThreadAffinityError
+
+pytestmark = pytest.mark.lint
+
+
+@pytest.fixture()
+def armed():
+    """raise-mode threadcheck + warn-mode lockdep (the held-lock
+    witness), restored to env-driven defaults afterwards."""
+    threadcheck.reset("raise")
+    lockdep.reset("warn")
+    try:
+        yield
+    finally:
+        threadcheck.reset()
+        lockdep.reset()
+
+
+def _toy(owner=None):
+    class Toy:
+        pass
+    threadcheck.install(Toy, "x", "test.Toy.x", owner=owner)
+    return Toy
+
+
+def _in_role(role, fn):
+    """Run fn on a thread registered under ``role``; re-raise its
+    exception (if any) in the caller."""
+    box = {}
+
+    def run():
+        threadcheck.register_role(role)
+        try:
+            fn()
+        except BaseException as e:  # noqa: BLE001 - relayed to caller
+            box["exc"] = e
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join()
+    if "exc" in box:
+        raise box["exc"]
+
+
+def test_first_write_free_then_fixed_owner_enforced(armed):
+    Toy = _toy(owner="hvd-background")
+    obj = Toy()
+    obj.x = 1  # constructor-style init from main: always free
+    assert obj.x == 1
+    with pytest.raises(ThreadAffinityError) as ei:
+        obj.x = 2  # second write from main, no lock, owner is bg
+    msg = str(ei.value)
+    assert "test.Toy.x" in msg and "hvd-background" in msg \
+        and "troubleshooting" in msg
+    # the violating write was refused, not stored
+    assert obj.x == 1
+    # the owning role writes freely
+    _in_role("hvd-background", lambda: setattr(obj, "x", 3))
+    assert obj.x == 3
+
+
+def test_cross_role_write_legal_under_lockdep_lock(armed):
+    Toy = _toy(owner="hvd-background")
+    obj = Toy()
+    obj.x = 1
+    lk = lockdep.lock("threadcheck_test.L")
+    with lk:
+        obj.x = 2  # main trespasses WITH a tracked lock held: legal
+    assert obj.x == 2 and threadcheck.violation_count() == 0
+
+
+def test_owner_migrates_for_unpinned_fields(armed):
+    Toy = _toy(owner=None)
+    obj = Toy()
+    obj.x = 1  # first write: owner seeds to main
+    lk = lockdep.lock("threadcheck_test.M")
+
+    def locked_write():
+        with lk:
+            obj.x = 2  # legal (lock held) -> ownership migrates
+
+    _in_role("hvd-overlap", locked_write)
+    assert obj.x == 2
+    _in_role("hvd-overlap", lambda: setattr(obj, "x", 3))  # now owner
+    with pytest.raises(ThreadAffinityError):
+        obj.x = 4  # main lost ownership at the handoff
+    assert threadcheck.violation_count() == 1
+
+
+def test_warn_mode_counts_without_raising(armed, capsys):
+    threadcheck.reset("warn")
+    Toy = _toy(owner="hvd-background")
+    obj = Toy()
+    obj.x = 1
+    obj.x = 2  # violation: logged + counted, value still stored
+    obj.x = 3
+    assert obj.x == 3
+    assert threadcheck.violation_count() == 2
+    assert "test.Toy.x" in capsys.readouterr().err
+
+
+def test_unarmed_is_a_plain_attribute():
+    threadcheck.reset("")  # force-disable regardless of ambient env
+    try:
+        Toy = _toy(owner="hvd-background")
+        # install() recorded the site but touched nothing
+        assert "x" not in Toy.__dict__
+        assert (Toy, "x", "test.Toy.x", "hvd-background") \
+            in threadcheck.sites()
+        obj = Toy()
+        obj.x = 1
+        obj.x = 2  # any thread, any order: no descriptor, no checks
+        assert obj.x == 2 and threadcheck.violation_count() == 0
+        # register_role is a no-op too: no thread-local state accrues
+        threadcheck.register_role("hvd-background")
+        assert threadcheck.current_role() == threadcheck.MAIN_ROLE
+    finally:
+        threadcheck.reset()
+
+
+def test_runtime_sites_enumerated_and_unarmed_by_default():
+    """The shipped install() sites are visible unarmed (the no-op
+    contract the ISSUE pins): importing the wired modules registers
+    the checked fields, yet none of the classes carry a descriptor
+    until armed."""
+    from horovod_tpu.common import coordinator, overlap  # noqa: F401
+    from horovod_tpu.common import runtime, trace  # noqa: F401
+
+    threadcheck.reset("")  # force-disable, stripping any leftovers
+    try:
+        ids = {fid for _cls, _attr, fid, _own in threadcheck.sites()}
+        assert {
+            "runtime.Runtime._tenant_lane",
+            "coordinator.ResponseCache.epoch",
+            "coordinator.StallInspector._last_check",
+            "overlap.OverlapRunner._cycles_total",
+            "trace.WorldTraceWriter.spans_written",
+        } <= ids, ids
+        for cls, attr, _fid, _own in threadcheck.sites():
+            assert not isinstance(cls.__dict__.get(attr),
+                                  threadcheck._Checked), (cls, attr)
+    finally:
+        threadcheck.reset()
+
+
+def test_reset_arms_and_strips_descriptors():
+    Toy = _toy()
+    threadcheck.reset("raise")
+    try:
+        assert isinstance(Toy.__dict__["x"], threadcheck._Checked)
+    finally:
+        threadcheck.reset("")
+    assert "x" not in Toy.__dict__
+    threadcheck.reset()
+
+
+def test_objects_built_before_arming_keep_working():
+    """The descriptor backs values in the instance __dict__ under the
+    attribute's own name, so pre-arm objects transparently fall under
+    checking when a test re-arms mid-flight."""
+    threadcheck.reset("")
+    Toy = _toy(owner="hvd-background")
+    obj = Toy()
+    obj.x = 1  # plain attribute write, pre-arm
+    threadcheck.reset("raise")
+    lockdep.reset("warn")
+    try:
+        assert obj.x == 1  # readable through the descriptor
+        # no owner was recorded pre-arm, so the object defaults to
+        # main ownership (forgiving: pre-arm objects were built by
+        # the test's own thread) — main keeps writing...
+        obj.x = 2
+        assert obj.x == 2
+        # ...but a foreign role is checked immediately
+        with pytest.raises(ThreadAffinityError):
+            _in_role("hvd-overlap", lambda: setattr(obj, "x", 3))
+    finally:
+        threadcheck.reset()
+        lockdep.reset()
+
+
+def test_env_arming_and_lockdep_coupling(monkeypatch):
+    """HOROVOD_TPU_THREADCHECK=1 arms raise mode from the env, and
+    implicitly arms lockdep in warn mode when LOCKCHECK is unset —
+    threadcheck's 'synchronized' witness is lockdep's held stack,
+    which plain unwrapped locks never feed."""
+    monkeypatch.setenv("HOROVOD_TPU_THREADCHECK", "1")
+    monkeypatch.delenv("HOROVOD_TPU_LOCKCHECK", raising=False)
+    threadcheck.reset(None)  # None = re-read the env
+    lockdep.reset(None)
+    try:
+        assert threadcheck.enabled()
+        assert threadcheck._get_mode() == "raise"
+        assert lockdep._get_mode() == "warn"
+    finally:
+        monkeypatch.delenv("HOROVOD_TPU_THREADCHECK", raising=False)
+        threadcheck.reset()
+        lockdep.reset()
+
+
+def test_threadcheck_counter_reaches_metrics_plane(monkeypatch):
+    """hvd_threadcheck_violations_total mirrors violation_count()
+    through the runtime collector, next to the lockcheck counter."""
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    threadcheck.reset("warn")
+    lockdep.reset("warn")
+    monkeypatch.setenv("HOROVOD_TPU_METRICS", "1")
+    try:
+        Toy = _toy(owner="hvd-background")
+        obj = Toy()
+        obj.x = 1
+        obj.x = 2  # main vs hvd-background, no lock: counted
+        assert threadcheck.violation_count() >= 1
+        hvd.init()
+        try:
+            view = hvd.metrics()
+            rec = view["local"]["hvd_threadcheck_violations_total"]
+            assert rec["v"] >= 1.0, rec
+            assert rec["v"] == float(threadcheck.violation_count())
+        finally:
+            hvd.shutdown()
+    finally:
+        threadcheck.reset()
+        lockdep.reset()

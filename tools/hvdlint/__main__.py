@@ -9,6 +9,7 @@ import argparse
 import json
 import sys
 
+from tools.hvdlint import cache
 from tools.hvdlint.core import get_analyzers, lint_paths
 
 
@@ -25,6 +26,15 @@ def main(argv=None) -> int:
                         help="machine-readable findings on stdout")
     parser.add_argument("--list", action="store_true",
                         help="list available analyzers and exit")
+    parser.add_argument("--changed", action="store_true",
+                        help="incremental mode: replay the cached "
+                             "result when no scanned file (or "
+                             "analyzer) changed; any change re-runs "
+                             "the FULL suite — per-file caching is "
+                             "unsound for cross-module analyzers")
+    parser.add_argument("--cache-file", default=None,
+                        help="cache location for --changed "
+                             "(default: .hvdlint_cache.json)")
     args = parser.parse_args(argv)
 
     if args.list:
@@ -33,8 +43,16 @@ def main(argv=None) -> int:
         return 0
     analyzers = [a.strip() for a in args.analyzer.split(",") if a.strip()] \
         or None
+    paths = args.paths or ["horovod_tpu"]
+    selected = analyzers or sorted(get_analyzers())
+    cache_file = args.cache_file or cache.DEFAULT_CACHE
     try:
-        findings = lint_paths(args.paths or ["horovod_tpu"], analyzers)
+        findings = cache.load(paths, selected, cache_file) \
+            if args.changed else None
+        if findings is None:
+            findings = lint_paths(paths, analyzers)
+            if args.changed:
+                cache.save(paths, selected, cache_file, findings)
     except (ValueError, OSError, SyntaxError) as e:
         print(f"hvdlint: {e}", file=sys.stderr)
         return 2

@@ -1,0 +1,119 @@
+"""Incremental-mode result cache for the hvdlint CLI (``--changed``).
+
+Every analyzer in the suite is cross-module by design — the lock
+graph, the thread-role cones, the C header mirror all read the WHOLE
+tree — so caching findings per file is unsound: an edit in module A
+can create or retire a finding reported against module B (rebinding a
+lock name, spawning a thread into a new role, deleting a C
+declaration). The only sound granularity is the tree: the cache
+stores one fingerprint of every scanned file plus the finding list it
+produced, and ANY change (edit, rename, add, delete, pragma tweak —
+or an edit to the analyzers themselves) discards the whole entry and
+re-runs the full suite. On a clean re-run the tier-1 gate pays one
+stat() per file instead of a parse + eight analyses.
+
+Validation is two-tier per file: the stat fast path (mtime_ns + size
+unchanged ⇒ unchanged) and a sha1 fallback so a touch(1)-style mtime
+bump without a content change still replays the cache.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional
+
+from tools.hvdlint.core import Finding
+
+VERSION = 1
+DEFAULT_CACHE = ".hvdlint_cache.json"
+
+
+def iter_py(paths: List[str]) -> List[str]:
+    """The exact file set core.Project would scan, without parsing."""
+    out: List[str] = []
+    for root in paths:
+        root = os.path.abspath(root)
+        if os.path.isfile(root):
+            out.append(root)
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
+            out.extend(os.path.join(dirpath, fn)
+                       for fn in sorted(filenames)
+                       if fn.endswith(".py"))
+    return out
+
+
+def _sha1(path: str) -> str:
+    h = hashlib.sha1()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 16), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _tool_stamp() -> str:
+    """Fingerprint of the analyzer suite itself: editing a checker is
+    as much a tree change as editing the tree."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha1()
+    for fn in sorted(os.listdir(here)):
+        if fn.endswith(".py"):
+            h.update(fn.encode())
+            with open(os.path.join(here, fn), "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+def fingerprint(paths: List[str]) -> Dict[str, dict]:
+    files: Dict[str, dict] = {}
+    for p in iter_py(paths):
+        st = os.stat(p)
+        files[p] = {"mtime": st.st_mtime_ns, "size": st.st_size,
+                    "sha1": _sha1(p)}
+    return files
+
+
+def load(paths: List[str], analyzers: List[str],
+         cache_file: str) -> Optional[List[Finding]]:
+    """Replay the cached findings iff NOTHING changed: same tool
+    build, same analyzer selection, same file set, same contents.
+    Returns None on any miss (caller re-runs and saves)."""
+    try:
+        with open(cache_file) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if payload.get("version") != VERSION \
+            or payload.get("tool") != _tool_stamp() \
+            or payload.get("analyzers") != sorted(analyzers):
+        return None
+    old = payload.get("files", {})
+    current = iter_py(paths)
+    if set(old) != set(current):
+        return None  # add/delete/rename
+    for p, rec in old.items():
+        try:
+            st = os.stat(p)
+        except OSError:
+            return None
+        if st.st_mtime_ns == rec["mtime"] and st.st_size == rec["size"]:
+            continue  # stat fast path
+        if _sha1(p) != rec["sha1"]:
+            return None  # real content change -> full re-run
+    return [Finding(**d) for d in payload.get("findings", [])]
+
+
+def save(paths: List[str], analyzers: List[str], cache_file: str,
+         findings: List[Finding]) -> None:
+    payload = {"version": VERSION, "tool": _tool_stamp(),
+               "analyzers": sorted(analyzers),
+               "files": fingerprint(paths),
+               "findings": [f.to_dict() for f in findings]}
+    tmp = cache_file + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, cache_file)

@@ -38,6 +38,15 @@ _PRAGMA_RE = re.compile(
     r"#\s*hvdlint:\s*(?:disable=)?([\w,-]+)"
     r"(?:\s*--\s*(\S.*))?")
 _MARKER_RE = re.compile(r"#\s*hvdlint:\s*world-replicated\b")
+# Field-scoped audit pragmas (thread-ownership analyzer): attach to a
+# field's declaration or any write site; the justification after
+# ``--`` is mandatory, exactly like disable= pragmas.
+_OWNED_BY_RE = re.compile(
+    r"#\s*hvdlint:\s*owned-by=([\w.-]+)"
+    r"(?:\s*--\s*(\S.*))?")
+_SNAPSHOT_RE = re.compile(
+    r"#\s*hvdlint:\s*snapshot-swapped\b"
+    r"(?:\s*--\s*(\S.*))?")
 
 
 @dataclass(frozen=True)
@@ -69,6 +78,10 @@ class SourceFile:
         self.suppressions: Dict[int, set] = {}
         self.bad_pragmas: List[int] = []    # pragma without justification
         self.replicated_lines: set = set()  # '# hvdlint: world-replicated'
+        # line -> audited owner role ('# hvdlint: owned-by=<role> -- why')
+        self.owned_by_lines: Dict[int, str] = {}
+        # line present in '# hvdlint: snapshot-swapped -- why'
+        self.snapshot_lines: set = set()
         self._scan_comments()
 
     def _scan_comments(self) -> None:
@@ -80,6 +93,18 @@ class SourceFile:
                 line = tok.start[0]
                 if _MARKER_RE.search(tok.string):
                     self.replicated_lines.add(line)
+                    continue
+                m = _OWNED_BY_RE.search(tok.string)
+                if m:
+                    if not m.group(2):
+                        self.bad_pragmas.append(line)
+                    self.owned_by_lines[line] = m.group(1)
+                    continue
+                m = _SNAPSHOT_RE.search(tok.string)
+                if m:
+                    if not m.group(1):
+                        self.bad_pragmas.append(line)
+                    self.snapshot_lines.add(line)
                     continue
                 m = _PRAGMA_RE.search(tok.string)
                 if not m or "disable" not in tok.string:
@@ -114,6 +139,7 @@ _SIMPLE_FACTORIES = {
     "queue.Queue": ("queue",), "queue.LifoQueue": ("queue",),
     "queue.PriorityQueue": ("queue",), "queue.SimpleQueue": ("queue",),
     "socket.socket": ("socket",), "network.listen": ("socket",),
+    "threading.local": ("tlocal",),
 }
 
 
@@ -597,10 +623,12 @@ class Resolver:
 # runner
 
 def get_analyzers() -> Dict[str, object]:
-    from tools.hvdlint import (knobs, lock_order, native_codec, teardown,
-                               wire_protocol, world_coherence)
-    mods = (lock_order, wire_protocol, native_codec, world_coherence,
-            teardown, knobs)
+    from tools.hvdlint import (knobs, lock_order, native_codec,
+                               native_lifetime, teardown,
+                               thread_ownership, wire_protocol,
+                               world_coherence)
+    mods = (lock_order, thread_ownership, wire_protocol, native_codec,
+            native_lifetime, world_coherence, teardown, knobs)
     return {m.NAME: m for m in mods}
 
 

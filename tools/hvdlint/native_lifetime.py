@@ -1,0 +1,240 @@
+"""native-lifetime: buffer ownership across the GIL-releasing boundary.
+
+The native core (``native/hvdtpu.cc``) runs every ``hvd_*`` entry
+point with the GIL released; the Python side hands it raw addresses
+(``arr.ctypes.data``), ctypes callback thunks, and iovec bundles built
+over fusion-arena views. None of those carry a reference — the
+address is an integer, the thunk a C function pointer — so the PYTHON
+expression that produced them must keep the owner alive for as long
+as the native side may touch the memory. Three historical bug
+classes, each with a fixed exemplar in the tree:
+
+1. **Inline temporaries.** ``X(...).ctypes.data`` takes the address
+   of an array nothing names: the temporary is reclaimed when the
+   statement ends (or, for a nested call argument, possibly before
+   the outer call even runs), and the native side scribbles through
+   freed memory. Only *rooted* expressions are provably alive —
+   ``out.ctypes.data`` (local), ``self._buf.ctypes.data``
+   (attribute chain), ``result[off:off + n].ctypes.data`` (a view
+   whose base a name keeps alive, steady.py's scatter loop). The
+   rule is therefore syntactic: walk off ``.ctypes.data`` /
+   ``.ctypes.data_as`` through attributes and subscripts; a ``Call``
+   at the root is flagged, a ``Name``/attribute chain is not.
+
+2. **Callback thunks without a long-lived owner.** A CFUNCTYPE
+   instance IS the executable thunk; if the only reference is a call
+   argument or a dropped local, a native entry that re-enters it
+   after Python moves on calls through freed code (the NULL_ON_IDLE
+   class — native.py's module-level ``NULL_ON_IDLE = ON_IDLE_FUNC(0)``
+   is the fixed form, controller's ``self._steady_on_idle = ...`` the
+   instance-owned one). Instantiating a known functype anywhere other
+   than a module-level or ``self.``-attribute assignment is flagged.
+
+3. **Arena pointer bundles cached without a generation key.** A
+   FusionArena grows by REALLOCATING (``ensure`` bumps
+   ``generation``); views taken before the growth stay valid (numpy
+   keeps the old base alive) but point into the OLD allocation — a
+   memoized iovec built from them silently diverges from the views a
+   resubmission writes through. Any function that builds ctypes
+   pointers over arena views (``.typed(...)`` / ``.view(...)`` on a
+   receiver it also ``ensure``s) and stores them in a ``cache``
+   container must read ``.generation`` to key the bundle
+   (steady.py:_c_coord is the canonical shape).
+
+Residual blind spots (accepted): functype TYPES are recognized only
+when bound at module level to a ``ctypes.CFUNCTYPE(...)`` result
+(per-call ``CFUNCTYPE(...)(f)`` double-calls are caught, aliased
+types through locals are not); check 3 is function-scoped — a bundle
+built in one function and cached in another is invisible; ownership
+through containers (a list that outlives the call holding the
+temporary) is not modeled, so a true positive of class 1 may have a
+container keeping it alive — audit before suppressing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from tools.hvdlint.core import Finding, Project, SourceFile, dotted_name
+
+NAME = "native-lifetime"
+
+
+# -- shared walking helpers -----------------------------------------------
+
+def _root(node: ast.AST) -> ast.AST:
+    """Strip attribute/subscript/starred wrappers down to the owning
+    expression: the thing whose liveness keeps the pointer valid."""
+    while True:
+        if isinstance(node, ast.Attribute):
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Starred):
+            node = node.value
+        else:
+            return node
+
+
+def _describe(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on 3.9+
+        return "<expr>"
+
+
+def _is_ptr_attr(node: ast.AST) -> bool:
+    """True for ``X.ctypes.data`` / ``X.ctypes.data_as`` accesses."""
+    return (isinstance(node, ast.Attribute)
+            and node.attr in ("data", "data_as")
+            and isinstance(node.value, ast.Attribute)
+            and node.value.attr == "ctypes")
+
+
+# -- check 1: inline temporaries ------------------------------------------
+
+def _check_temporaries(src: SourceFile, findings: List[Finding]) -> None:
+    for node in ast.walk(src.tree):
+        if not _is_ptr_attr(node):
+            continue
+        owner = _root(node.value.value)
+        if isinstance(owner, ast.Call):
+            findings.append(Finding(
+                NAME, src.path, node.lineno,
+                f"pointer taken from an unnamed temporary "
+                f"({_describe(node.value.value)}): the array is "
+                f"reclaimed when the statement ends, and the "
+                f"GIL-releasing native side writes through freed "
+                f"memory — bind it to a name that outlives the call"))
+
+
+# -- check 2: CFUNCTYPE ownership -----------------------------------------
+
+def _functype_names(project: Project) -> Set[str]:
+    """Names bound at module level to a ctypes.CFUNCTYPE(...) result,
+    anywhere in the scanned tree (e.g. native.ON_IDLE_FUNC)."""
+    names: Set[str] = set()
+    for src in project.files:
+        for node in src.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            callee = dotted_name(node.value.func) or ""
+            if callee.rsplit(".", 1)[-1] != "CFUNCTYPE":
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+    return names
+
+
+def _is_functype_call(node: ast.Call, functypes: Set[str]) -> bool:
+    callee = dotted_name(node.func) or ""
+    if callee and callee.rsplit(".", 1)[-1] in functypes:
+        return True
+    # Per-call double construction: ctypes.CFUNCTYPE(None)(f).
+    if isinstance(node.func, ast.Call):
+        inner = dotted_name(node.func.func) or ""
+        if inner.rsplit(".", 1)[-1] == "CFUNCTYPE":
+            return True
+    return False
+
+
+def _owned_target(stmt: ast.stmt) -> bool:
+    """True when the statement stores its value on a self attribute —
+    the instance owns the thunk for its own lifetime."""
+    if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+        return False
+    targets = stmt.targets if isinstance(stmt, ast.Assign) \
+        else [stmt.target]
+    return any(isinstance(t, ast.Attribute)
+               and isinstance(t.value, ast.Name) and t.value.id == "self"
+               for t in targets)
+
+
+def _check_functypes(src: SourceFile, functypes: Set[str],
+                     findings: List[Finding]) -> None:
+    # Module-level assignments are long-lived by construction; self-
+    # attribute stores are owned for the instance's life. Collect the
+    # line spans of both so instantiations inside them pass.
+    ok_lines: Set[int] = set()
+    for node in src.tree.body:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            for sub in ast.walk(node):
+                ok_lines.add(getattr(sub, "lineno", 0))
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)) \
+                and _owned_target(node):
+            for sub in ast.walk(node):
+                ok_lines.add(getattr(sub, "lineno", 0))
+
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.Call)
+                and _is_functype_call(node, functypes)):
+            continue
+        if node.lineno in ok_lines:
+            continue
+        findings.append(Finding(
+            NAME, src.path, node.lineno,
+            f"CFUNCTYPE thunk built without a long-lived owner "
+            f"({_describe(node)}): a native entry that re-enters the "
+            f"callback after this frame unwinds calls through freed "
+            f"code — store it at module level (the NULL_ON_IDLE "
+            f"pattern) or on self before handing it to the core"))
+
+
+# -- check 3: arena pointer caches ----------------------------------------
+
+def _arena_receivers(fn: ast.AST) -> Set[str]:
+    """Names the function treats as a growable arena: receivers of
+    .ensure()/.typed() calls. '.view' alone is too generic
+    (memoryview/ndarray both have it) to classify a receiver."""
+    strong: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.attr in ("ensure", "typed"):
+            strong.add(node.func.value.id)
+    return strong
+
+
+def _check_arena_caches(src: SourceFile, findings: List[Finding]) -> None:
+    for fn in ast.walk(src.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        arenas = _arena_receivers(fn)
+        if not arenas:
+            continue
+        takes_ptr = any(_is_ptr_attr(n) for n in ast.walk(fn))
+        stores_cache = any(
+            isinstance(n, (ast.Assign, ast.AnnAssign))
+            and any(isinstance(t, ast.Subscript)
+                    and "cache" in _describe(t.value).lower()
+                    for t in (n.targets if isinstance(n, ast.Assign)
+                              else [n.target]))
+            for n in ast.walk(fn))
+        if not (takes_ptr and stores_cache):
+            continue
+        if any(isinstance(n, ast.Attribute) and n.attr == "generation"
+               for n in ast.walk(fn)):
+            continue
+        findings.append(Finding(
+            NAME, src.path, fn.lineno,
+            f"{fn.name} caches ctypes pointers over arena views "
+            f"({', '.join(sorted(arenas))}) without keying on "
+            f".generation: ensure() REALLOCATES on growth, so a "
+            f"resubmission writes the new allocation while the "
+            f"memoized iovec still points at the old one — key the "
+            f"bundle on the arena's generation (_c_coord pattern)"))
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    functypes = _functype_names(project)
+    for src in project.files:
+        _check_temporaries(src, findings)
+        _check_functypes(src, functypes, findings)
+        _check_arena_caches(src, findings)
+    return findings

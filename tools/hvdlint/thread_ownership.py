@@ -1,0 +1,842 @@
+"""thread-ownership: the thread-role graph + the data-race surface.
+
+Every cross-thread bug this tree has shipped so far was a *write/read
+pair split across threads with nothing ordering them*: the
+``_on_arrivals`` hook read twice while attach_trace could rebind it
+between the reads, ``mark_done`` publishing the status store before
+the output store that a lock-free ``wait()`` keys on, and
+``note_bucket_names`` mutating a set in place that the background
+loop reads without the lock. lockdep (PR 5) cannot see any of these —
+they are races on plain attributes, not lock misuse — so this
+analyzer rebuilds the thread structure statically:
+
+**Role graph.** Every ``threading.Thread(target=...)`` allocation
+site defines a role, named by its constant ``name=`` kwarg (the same
+names ``common/threadcheck.py`` registers at runtime) or the target's
+short name. Call-graph reachability — the same resolution machinery
+lock_order uses — assigns each function the set of roles it may run
+under; everything else runs as ``main``, and ``main`` propagates
+through the call graph like any other role (a helper called from both
+the public API and the background loop runs under both).
+
+**Checks**, per instance attribute (``module.Class.attr`` — the
+allocation-site identity shared with lockdep and threadcheck) and
+per ``global``-declared module variable:
+
+1. *multi-role-write*: compound writes (augmented assignment, item
+   store, mutating method call, rebind of a non-fresh value) from two
+   or more roles with no common held lock. Plain rebinds of fresh /
+   immutable values are exempt: a GIL-atomic flag store
+   (``self._running = False``) is the sanctioned stop signal.
+
+2. *unpublished-write*: a field written by exactly one role but read
+   from another, where the writes neither hold a common lock nor use
+   the snapshot-swap idiom (a single assignment of a freshly built
+   object — the only in-place-mutation-free way a lock-free reader
+   can observe it).
+
+3. *capture-once*: a rebindable hook (class-body default ``None``,
+   rebound outside ``__init__``) read more than once inside one
+   function with no lock shared with the rebind sites — the reader
+   must capture the hook into a local once, or a concurrent rebind
+   lands between the reads (``if self.hook: self.hook()`` is the
+   classic TypeError-under-race shape).
+
+4. *publish-order*: a function storing both a lock-free *gate* field
+   (one whose value reaches an ``if``/``while`` test or comparison
+   with no lock held — the readiness flag wait-style readers poll)
+   and a payload field that also has lock-free readers must store the
+   payload FIRST; publishing the gate first lets a racing reader
+   release a payload that is not yet visible.
+
+Audited exceptions carry field pragmas (justification mandatory)::
+
+    self._table = t  # hvdlint: owned-by=hvd-background -- why safe
+    self._snap = new  # hvdlint: snapshot-swapped -- why readers ok
+
+Known blind spots (accepted): calls through stored callbacks do not
+extend a role's cone (``entry.callback(...)`` — the runtime checker
+covers those paths); Thread targets that are nested functions are not
+indexed; writes inside a function that itself spawns a thread are
+treated as pre-``start()`` initialization (happens-before via
+``Thread.start``); attribute writes on non-``self`` receivers are not
+tracked.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.hvdlint.core import (
+    Finding, FuncInfo, Project, dotted_name, iter_executed,
+)
+
+NAME = "thread-ownership"
+
+MAIN_ROLE = "main"
+
+# Infra-typed attributes (locks, queues, events, threads …) have their
+# own synchronization story; the lock/teardown analyzers own them.
+_INFRA_TAGS = {"lock", "cond", "cond_alias", "event", "queue", "thread",
+               "socket", "tlocal"}
+
+_MUTATORS = {"append", "appendleft", "add", "update", "extend", "insert",
+             "remove", "discard", "clear", "pop", "popleft", "popitem",
+             "setdefault", "sort", "reverse", "write"}
+
+_FRESH_CALLS = {"set", "frozenset", "dict", "list", "tuple", "sorted",
+                "bytearray", "type"}
+
+
+class _Access:
+    __slots__ = ("field", "kind", "line", "held", "fresh", "in_test",
+                 "scalar")
+
+    def __init__(self, field: str, kind: str, line: int, held: tuple,
+                 fresh: bool = False, in_test: bool = False,
+                 scalar: bool = False):
+        self.field = field
+        # kind: read | rebind | aug | item | mutate
+        self.kind = kind
+        self.line = line
+        self.held = held
+        self.fresh = fresh
+        self.in_test = in_test
+        self.scalar = scalar  # rebind of an int/float/bool constant
+
+    @property
+    def is_write(self) -> bool:
+        return self.kind != "read"
+
+
+class _FuncFacts:
+    def __init__(self):
+        self.accesses: List[_Access] = []
+        self.calls: List[str] = []
+        self.call_sites: List[Tuple[str, tuple]] = []  # (target, held)
+        # (role_name, target_qualname | None, line)
+        self.spawns: List[Tuple[str, Optional[str], int]] = []
+        # lock-free local -> field it snapshots (one-hop dataflow for
+        # gate detection: res = self._results.get(h); if res is None:)
+        self.snap_locals: Dict[str, str] = {}
+        # (field, held-at-test): a gate candidate — only lock-free
+        # tests survive once caller-held locks are inherited
+        self.gate_marks: List[Tuple[str, tuple]] = []
+
+
+def _declared_attrs(ci) -> Set[str]:
+    """Attributes a class itself declares: class-body assignments plus
+    every ``self.x`` store anywhere in its own methods. Cached on the
+    ClassIndex (one AST walk per class per run)."""
+    cached = getattr(ci, "_to_declared", None)
+    if cached is not None:
+        return cached
+    declared: Set[str] = set()
+    for node in ci.node.body:
+        if isinstance(node, ast.Assign):
+            declared.update(t.id for t in node.targets
+                            if isinstance(t, ast.Name))
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            declared.add(node.target.id)
+    for node in ast.walk(ci.node):
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.ctx, (ast.Store, ast.Del)) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self":
+            declared.add(node.attr)
+    ci._to_declared = declared
+    return declared
+
+
+def _owning_class(project: Project, ci, attr: str, seen=None):
+    """The class in ``ci``'s ancestry that declares ``attr``, or None.
+    Inheritance must NOT split a field: the ``Controller._on_arrivals``
+    hook read from a ``TcpCoordinator`` method is the same storage —
+    keying accesses by the accessing class would hide every
+    base-declared/derived-read race from all four checks."""
+    if seen is None:
+        seen = set()
+    if id(ci) in seen:
+        return None
+    seen.add(id(ci))
+    if attr in _declared_attrs(ci):
+        return ci
+    for base in ci.bases:
+        if not base:
+            continue
+        name = base.rsplit(".", 1)[-1]
+        bci = ci.module.classes.get(name) or \
+            project.index.class_by_name(name)
+        if bci is None:
+            continue
+        owner = _owning_class(project, bci, attr, seen)
+        if owner is not None:
+            return owner
+    return None
+
+
+def _field_id(info: FuncInfo, attr: str,
+              project: Optional[Project] = None) -> Optional[str]:
+    if info.cls is None:
+        return None
+    ci = info.cls
+    if project is not None:
+        owner = _owning_class(project, ci, attr)
+        if owner is not None:
+            ci = owner
+    return f"{ci.module.src.shortname}.{ci.name}.{attr}"
+
+
+def _field_tag(info: FuncInfo, attr: str,
+               project: Optional[Project] = None) -> Optional[tuple]:
+    if info.cls is None:
+        return None
+    tag = info.cls.attr_types.get(attr)
+    if tag is None and project is not None:
+        owner = _owning_class(project, info.cls, attr)
+        if owner is not None and owner is not info.cls:
+            tag = owner.attr_types.get(attr)
+    return tag
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """attr when ``node`` is exactly ``self.<attr>``."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _is_fresh(expr: ast.AST, fresh_locals: Set[str]) -> bool:
+    """True when the RHS builds a new (or immutable) object — the
+    snapshot-swap requirement: readers see the old object or the new
+    one, never a half-mutated hybrid."""
+    if isinstance(expr, (ast.Constant, ast.JoinedStr, ast.Dict, ast.List,
+                         ast.Set, ast.Tuple, ast.DictComp, ast.ListComp,
+                         ast.SetComp, ast.GeneratorExp, ast.BinOp,
+                         ast.UnaryOp, ast.Compare, ast.BoolOp,
+                         ast.Lambda)):
+        return True
+    if isinstance(expr, ast.IfExp):
+        return _is_fresh(expr.body, fresh_locals) and \
+            _is_fresh(expr.orelse, fresh_locals)
+    if isinstance(expr, ast.Name):
+        return expr.id in fresh_locals
+    if isinstance(expr, ast.Call):
+        d = dotted_name(expr.func) or ""
+        tail = d.rsplit(".", 1)[-1]
+        return tail in _FRESH_CALLS or (tail[:1].isupper())
+    return False
+
+
+def _role_of_spawn(call: ast.Call) -> Optional[str]:
+    """Role name from the Thread() ``name=`` kwarg, else None (caller
+    falls back to the target's short name)."""
+    for kw in call.keywords:
+        if kw.arg != "name":
+            continue
+        if isinstance(kw.value, ast.Constant) and \
+                isinstance(kw.value.value, str):
+            return kw.value.value
+        if isinstance(kw.value, ast.JoinedStr):
+            parts = [v.value for v in kw.value.values
+                     if isinstance(v, ast.Constant)]
+            return "".join(str(p) for p in parts).rstrip("-_.") or None
+    return None
+
+
+def _resolve_target(expr: ast.AST, info: FuncInfo,
+                    project: Project) -> Optional[str]:
+    """Qualname of a Thread ``target=`` callable, or None."""
+    resolver = project.resolver
+    d = dotted_name(expr)
+    if d is None:
+        return None
+    if d.startswith("self.") and info.cls is not None:
+        rest = d.split(".", 1)[1]
+        if "." not in rest:
+            return resolver._method(info.cls, rest)
+        obj, meth = rest.rsplit(".", 1)
+        if "." not in obj:
+            tag = info.cls.attr_types.get(obj)
+            if tag and tag[0] == "class":
+                cls = resolver._class_by_qualname(tag[1])
+                if cls is not None:
+                    return resolver._method(cls, meth)
+        return None
+    if "." not in d:
+        if d in info.module.functions:
+            return f"{info.module.modname}.{d}"
+        return None
+    head, meth = d.rsplit(".", 1)
+    if "." not in head and head in info.module.imports:
+        target = resolver._module_of(info.module.imports[head])
+        if target is not None and meth in target.functions:
+            return f"{target.modname}.{meth}"
+    return None
+
+
+def _is_thread_ctor(call: ast.Call, info: FuncInfo) -> bool:
+    d = dotted_name(call.func)
+    if d is None:
+        return False
+    return d.rsplit(".", 1)[-1] == "Thread"
+
+
+class _Walker:
+    """Statement walk with held-lock tracking (lock_order's recursion
+    shape) that records field accesses, resolvable calls and thread
+    spawns for one function."""
+
+    def __init__(self, info: FuncInfo, project: Project,
+                 facts: _FuncFacts, globals_declared: Set[str]):
+        self.info = info
+        self.project = project
+        self.facts = facts
+        self.globals_declared = globals_declared
+        self.fresh_locals: Set[str] = set()
+        self.src = info.module.src
+
+    # -- field bookkeeping -------------------------------------------
+
+    def _record(self, attr: str, kind: str, line: int, held: tuple,
+                fresh: bool = False, in_test: bool = False,
+                is_global: bool = False, scalar: bool = False) -> None:
+        if is_global:
+            field = f"{self.info.module.src.shortname}.{attr}"
+            tag = self.info.module.attr_types.get(attr)
+        else:
+            field = _field_id(self.info, attr, self.project)
+            tag = _field_tag(self.info, attr, self.project)
+        if field is None:
+            return
+        if tag is not None and tag[0] in _INFRA_TAGS:
+            return
+        if tag is not None and tag[0] == "class" and kind == "mutate":
+            return  # method calls on owned objects are the callee's story
+        fresh = fresh or line in self.src.snapshot_lines or \
+            (line - 1) in self.src.snapshot_lines
+        if in_test and kind == "read":
+            self.facts.gate_marks.append((field, held))
+        self.facts.accesses.append(
+            _Access(field, kind, line, held, fresh, in_test, scalar))
+
+    # -- expressions -------------------------------------------------
+
+    def scan_expr(self, expr: ast.AST, held: tuple,
+                  in_test: bool = False) -> None:
+        if expr is None:
+            return
+        consumed: Set[int] = set()
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                if _is_thread_ctor(node, self.info):
+                    self._record_spawn(node)
+                recv = node.func
+                if isinstance(recv, ast.Attribute):
+                    attr = _self_attr(recv.value)
+                    if attr is not None:
+                        consumed.add(id(recv.value))
+                        kind = "mutate" if recv.attr in _MUTATORS \
+                            else "read"
+                        self._record(attr, kind, node.lineno, held,
+                                     in_test=in_test)
+                target = self.project.resolver.resolve_call(
+                    node, self.info)
+                if target is not None:
+                    self.facts.calls.append(target)
+                    self.facts.call_sites.append((target, held))
+            elif isinstance(node, ast.Compare):
+                for fld in self._fields_in(node):
+                    self.facts.gate_marks.append((fld, held))
+        for node in ast.walk(expr):
+            if id(node) in consumed:
+                continue
+            attr = _self_attr(node)
+            if attr is not None and isinstance(node.ctx, ast.Load):
+                self._record(attr, "read", node.lineno, held,
+                             in_test=in_test)
+            elif isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    node.id in self.globals_declared:
+                self._record(node.id, "read", node.lineno, held,
+                             in_test=in_test, is_global=True)
+        if in_test:
+            for node in ast.walk(expr):
+                if isinstance(node, ast.Name) and \
+                        node.id in self.facts.snap_locals:
+                    self.facts.gate_marks.append(
+                        (self.facts.snap_locals[node.id], held))
+
+    def _fields_in(self, expr: ast.AST) -> List[str]:
+        out = []
+        for node in ast.walk(expr):
+            attr = _self_attr(node)
+            if attr is not None and not attr.isupper():
+                fld = _field_id(self.info, attr, self.project)
+                tag = _field_tag(self.info, attr, self.project)
+                if fld and (tag is None or tag[0] not in _INFRA_TAGS):
+                    out.append(fld)
+            elif isinstance(node, ast.Name) and \
+                    node.id in self.facts.snap_locals:
+                out.append(self.facts.snap_locals[node.id])
+        return out
+
+    def _record_spawn(self, call: ast.Call) -> None:
+        target = None
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target = _resolve_target(kw.value, self.info, self.project)
+        role = _role_of_spawn(call)
+        if role is None and target is not None:
+            role = target.rsplit(".", 1)[-1].lstrip("_")
+        if role is not None:
+            self.facts.spawns.append((role, target, call.lineno))
+
+    # -- statements --------------------------------------------------
+
+    def _store_target(self, tgt: ast.AST, value: Optional[ast.AST],
+                      line: int, held: tuple, aug: bool) -> None:
+        attr = _self_attr(tgt)
+        if attr is not None:
+            if aug:
+                self._record(attr, "aug", line, held)
+            else:
+                fresh = value is not None and \
+                    _is_fresh(value, self.fresh_locals)
+                scalar = isinstance(value, ast.Constant) and \
+                    isinstance(value.value, (bool, int, float))
+                self._record(attr, "rebind", line, held, fresh=fresh,
+                             scalar=scalar)
+            return
+        if isinstance(tgt, ast.Subscript):
+            sub_attr = _self_attr(tgt.value)
+            if sub_attr is not None:
+                self._record(sub_attr, "item", line, held)
+                return
+            if isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id in self.globals_declared:
+                self._record(tgt.value.id, "item", line, held,
+                             is_global=True)
+            return
+        if isinstance(tgt, ast.Name) and tgt.id in self.globals_declared:
+            if aug:
+                self._record(tgt.id, "aug", line, held, is_global=True)
+            else:
+                fresh = value is not None and \
+                    _is_fresh(value, self.fresh_locals)
+                self._record(tgt.id, "rebind", line, held, fresh=fresh,
+                             is_global=True)
+            return
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._store_target(el, None, line, held, aug)
+
+    def walk(self, stmts, held: tuple) -> None:
+        resolver = self.project.resolver
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.Global):
+                self.globals_declared.update(stmt.names)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                new_held = held
+                for item in stmt.items:
+                    self.scan_expr(item.context_expr, held)
+                    lk = resolver.lock_of_expr(item.context_expr,
+                                               self.info)
+                    if lk is not None:
+                        new_held = new_held + (lk[1],)
+                self.walk(stmt.body, new_held)
+                continue
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                    else [stmt.target]
+                if stmt.value is not None:
+                    self.scan_expr(stmt.value, held)
+                for tgt in targets:
+                    self._store_target(tgt, stmt.value, stmt.lineno,
+                                       held, aug=False)
+                    if isinstance(tgt, ast.Subscript):
+                        self.scan_expr(tgt.slice, held)
+                # one-hop snapshot local: res = self._results.get(h)
+                if isinstance(stmt, ast.Assign) and \
+                        len(targets) == 1 and \
+                        isinstance(targets[0], ast.Name) and \
+                        stmt.value is not None:
+                    name = targets[0].id
+                    if not held:
+                        flds = self._fields_in(stmt.value)
+                        if len(set(flds)) == 1:
+                            self.facts.snap_locals[name] = flds[0]
+                    if _is_fresh(stmt.value, self.fresh_locals):
+                        self.fresh_locals.add(name)
+                continue
+            if isinstance(stmt, ast.AugAssign):
+                self.scan_expr(stmt.value, held)
+                self._store_target(stmt.target, None, stmt.lineno, held,
+                                   aug=True)
+                continue
+            if isinstance(stmt, ast.Delete):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        attr = _self_attr(tgt.value)
+                        if attr is not None:
+                            self._record(attr, "item", stmt.lineno, held)
+                        self.scan_expr(tgt.slice, held)
+                continue
+            if isinstance(stmt, (ast.If, ast.While)):
+                self.scan_expr(stmt.test, held, in_test=True)
+                self.walk(stmt.body, held)
+                self.walk(stmt.orelse, held)
+                continue
+            if isinstance(stmt, ast.Assert):
+                self.scan_expr(stmt.test, held, in_test=True)
+                continue
+            # everything else: scan child expressions, recurse into
+            # child statement blocks under the same held set
+            for _f, value in ast.iter_fields(stmt):
+                values = value if isinstance(value, list) else [value]
+                for v in values:
+                    if isinstance(v, ast.stmt):
+                        self.walk([v], held)
+                    elif isinstance(v, ast.AST):
+                        self.scan_expr(v, held)
+
+
+def _gather(project: Project) -> Dict[str, _FuncFacts]:
+    facts: Dict[str, _FuncFacts] = {}
+    for qn, info in project.index.functions.items():
+        f = _FuncFacts()
+        g: Set[str] = set()
+        for node in iter_executed(info.node):
+            if isinstance(node, ast.Global):
+                g.update(node.names)
+        _Walker(info, project, f, g).walk(info.node.body, ())
+        facts[qn] = f
+    return facts
+
+
+def _inherit_locks(facts: Dict[str, _FuncFacts]) -> Dict[str, Set[str]]:
+    """Locks provably held on ENTRY to each function: when every
+    resolvable call site of F holds lock L, F's body runs under L
+    (the ``_register_idents`` shape — a private helper always invoked
+    with the owner's lock held). Functions with no resolvable callers
+    (public API, thread targets) inherit nothing."""
+    callers: Dict[str, List[Tuple[str, tuple]]] = {}
+    for qn, ff in facts.items():
+        for tgt, held in ff.call_sites:
+            if tgt in facts:
+                callers.setdefault(tgt, []).append((qn, held))
+    inherited: Dict[str, Set[str]] = {qn: set() for qn in facts}
+    for _round in range(10):
+        changed = False
+        for f, sites in callers.items():
+            eff: Optional[Set[str]] = None
+            for caller, held in sites:
+                s = set(held) | inherited[caller]
+                eff = s if eff is None else (eff & s)
+            if eff and eff - inherited[f]:
+                inherited[f] |= eff
+                changed = True
+        if not changed:
+            break
+    return inherited
+
+
+def role_map(project: Project,
+             facts: Optional[Dict[str, _FuncFacts]] = None
+             ) -> Dict[str, Set[str]]:
+    """qualname -> set of role names the function may run under."""
+    if facts is None:
+        facts = _gather(project)
+    roles: Dict[str, Set[str]] = {qn: set() for qn in facts}
+    # thread roles: BFS from every spawn target
+    queue: List[Tuple[str, str]] = []
+    for f in facts.values():
+        for role, target, _line in f.spawns:
+            if target is not None and target in roles:
+                queue.append((target, role))
+    while queue:
+        qn, role = queue.pop()
+        if role in roles[qn]:
+            continue
+        roles[qn].add(role)
+        for callee in facts[qn].calls:
+            if callee in roles:
+                queue.append((callee, role))
+    # main: everything not exclusively inside a thread cone, propagated
+    queue2 = [qn for qn, r in roles.items() if not r]
+    for qn in queue2:
+        roles[qn].add(MAIN_ROLE)
+    while queue2:
+        qn = queue2.pop()
+        for callee in facts[qn].calls:
+            if callee in roles and MAIN_ROLE not in roles[callee]:
+                roles[callee].add(MAIN_ROLE)
+                queue2.append(callee)
+    return roles
+
+
+class _Field:
+    __slots__ = ("writes", "reads", "default_none", "decl_line",
+                 "owned_by", "path", "scalar_init")
+
+    def __init__(self):
+        self.writes: List[Tuple[str, _Access]] = []   # (qualname, access)
+        self.reads: List[Tuple[str, _Access]] = []
+        self.default_none = False
+        self.decl_line = 0
+        self.owned_by: Optional[str] = None
+        self.path = ""
+        # initialized to an int/float/bool constant: a single-writer
+        # augmented counter on it is a GIL-atomic rebind of an
+        # immutable value (readers see a stale-but-consistent number)
+        self.scalar_init = False
+
+
+def _collect_fields(project: Project, facts: Dict[str, _FuncFacts]
+                    ) -> Dict[str, _Field]:
+    fields: Dict[str, _Field] = {}
+
+    def get(fid: str, path: str) -> _Field:
+        f = fields.get(fid)
+        if f is None:
+            f = fields[fid] = _Field()
+            f.path = path
+        return f
+
+    for qn, ff in facts.items():
+        info = project.index.functions[qn]
+        path = info.module.src.path
+        src = info.module.src
+        for a in ff.accesses:
+            f = get(a.field, path)
+            if a.line in src.owned_by_lines:
+                f.owned_by = src.owned_by_lines[a.line]
+            elif (a.line - 1) in src.owned_by_lines:
+                f.owned_by = src.owned_by_lines[a.line - 1]
+            if a.is_write:
+                f.writes.append((qn, a))
+                if a.scalar and qn.rsplit(".", 1)[-1] == "__init__":
+                    f.scalar_init = True
+            else:
+                f.reads.append((qn, a))
+    # class-body defaults: `_on_arrivals = None` declares a rebindable
+    # hook; a pragma on the declaration line audits the whole field.
+    for mod in project.index.modules.values():
+        src = mod.src
+        for ci in mod.classes.values():
+            for node in ci.node.body:
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    continue
+                fid = f"{src.shortname}.{ci.name}.{node.targets[0].id}"
+                if fid not in fields:
+                    continue
+                f = fields[fid]
+                f.decl_line = node.lineno
+                if isinstance(node.value, ast.Constant) and \
+                        node.value.value is None:
+                    f.default_none = True
+                if isinstance(node.value, ast.Constant) and \
+                        isinstance(node.value.value, (bool, int, float)):
+                    f.scalar_init = True
+                for ln in (node.lineno, node.lineno - 1):
+                    if ln in src.owned_by_lines:
+                        f.owned_by = src.owned_by_lines[ln]
+    return fields
+
+
+def _init_like(qn: str, facts: Dict[str, _FuncFacts]) -> bool:
+    """__init__ and thread-spawning functions: their writes precede the
+    racing thread's existence (happens-before via Thread.start)."""
+    name = qn.rsplit(".", 1)[-1]
+    if name in ("__init__", "_reset_for_tests"):
+        return True
+    return bool(facts[qn].spawns)
+
+
+def _common_lock(accesses: List[_Access]) -> Optional[str]:
+    common: Optional[Set[str]] = None
+    for a in accesses:
+        s = set(a.held)
+        common = s if common is None else (common & s)
+        if not common:
+            return None
+    return sorted(common)[0] if common else None
+
+
+def _roles_of(qn: str, roles: Dict[str, Set[str]]) -> Set[str]:
+    return roles.get(qn, {MAIN_ROLE})
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    facts = _gather(project)
+    roles = role_map(project, facts)
+    inherited = _inherit_locks(facts)
+    for qn, ff in facts.items():
+        inh = inherited.get(qn)
+        if inh:
+            extra = tuple(sorted(inh))
+            for a in ff.accesses:
+                a.held = a.held + tuple(
+                    x for x in extra if x not in a.held)
+    fields = _collect_fields(project, facts)
+
+    # gate fields: lock-free tested somewhere (publish-order check);
+    # both maps carry the functions doing the lock-free access so the
+    # check can require an accessor OUTSIDE the writer.
+    gates: Dict[str, Set[str]] = {}
+    lockfree_read: Dict[str, Set[str]] = {}
+    for qn, ff in facts.items():
+        inh = inherited.get(qn) or set()
+        for fld, held in ff.gate_marks:
+            if not held and not inh:
+                gates.setdefault(fld, set()).add(qn)
+        for a in ff.accesses:
+            if not a.held and not _init_like(qn, facts):
+                if a.kind == "read" or a.kind == "mutate":
+                    lockfree_read.setdefault(a.field, set()).add(qn)
+
+    for fid, f in sorted(fields.items()):
+        if f.owned_by is not None:
+            continue
+        live_writes = [(qn, a) for qn, a in f.writes
+                       if not _init_like(qn, facts)]
+        live_reads = [(qn, a) for qn, a in f.reads
+                      if not _init_like(qn, facts)]
+        if not live_writes:
+            continue
+        write_roles: Set[str] = set()
+        for qn, _a in live_writes:
+            write_roles |= _roles_of(qn, roles)
+
+        # -- check 1: compound writes from >= 2 roles, no common lock
+        compound = [(qn, a) for qn, a in live_writes
+                    if a.kind in ("aug", "item", "mutate")
+                    or (a.kind == "rebind" and not a.fresh)]
+        if len(write_roles) >= 2 and compound:
+            if _common_lock([a for _qn, a in live_writes]) is None:
+                qn, a = compound[-1]
+                writers = sorted({q.rsplit(".", 1)[-1]
+                                  for q, _x in live_writes})
+                findings.append(Finding(
+                    NAME, f.path, a.line,
+                    f"field '{fid}' has compound writes from roles "
+                    f"{sorted(write_roles)} ({', '.join(writers)}) with "
+                    f"no common lock — concurrent read-modify-write "
+                    f"loses updates; guard every write with one lock or "
+                    f"audit with '# hvdlint: owned-by=<role> -- why'"))
+                continue
+
+        # -- check 2: single-writer field read from another role
+        if len(write_roles) >= 1:
+            reader_roles: Set[str] = set()
+            for qn, _a in live_reads:
+                reader_roles |= _roles_of(qn, roles)
+            foreign = reader_roles - write_roles
+            if foreign and len(write_roles) == 1:
+                locked = _common_lock([a for _qn, a in live_writes])
+                all_snapshot = all(
+                    a.kind == "rebind" and a.fresh
+                    for _qn, a in live_writes)
+                # a single-writer counter on a scalar-initialized field
+                # is a GIL-atomic rebind of an immutable value: readers
+                # see a stale-but-consistent number, never a torn one
+                scalar_counter = f.scalar_init and all(
+                    a.kind == "aug" or (a.kind == "rebind" and a.fresh)
+                    for _qn, a in live_writes)
+                if locked is None and not all_snapshot \
+                        and not scalar_counter:
+                    qn, a = live_writes[-1]
+                    findings.append(Finding(
+                        NAME, f.path, a.line,
+                        f"field '{fid}' is written by role "
+                        f"{sorted(write_roles)} but read from role(s) "
+                        f"{sorted(foreign)} with no lock on the writes "
+                        f"and no snapshot-swap (single assignment of a "
+                        f"freshly built object) — a lock-free reader "
+                        f"can observe a half-mutated value; swap a "
+                        f"fresh object, lock the writes, or audit with "
+                        f"'# hvdlint: snapshot-swapped -- why'"))
+
+    # -- check 3: capture-once hooks ---------------------------------
+    for fid, f in sorted(fields.items()):
+        if f.owned_by is not None or not f.default_none:
+            continue
+        rebinds = [(qn, a) for qn, a in f.writes
+                   if a.kind == "rebind"
+                   and qn.rsplit(".", 1)[-1] != "__init__"]
+        if not rebinds:
+            continue
+        rebind_funcs = {qn for qn, _a in rebinds}
+        rebind_held = [a for _qn, a in rebinds]
+        per_func: Dict[str, List[_Access]] = {}
+        for qn, a in f.reads:
+            if qn in rebind_funcs:
+                continue
+            per_func.setdefault(qn, []).append(a)
+        for qn, reads in sorted(per_func.items()):
+            if len(reads) < 2:
+                continue
+            lk = _common_lock(reads + rebind_held)
+            if lk is not None:
+                continue
+            lines = sorted(a.line for a in reads)
+            findings.append(Finding(
+                NAME, f.path, lines[1],
+                f"hook '{fid}' is read {len(reads)} times in "
+                f"{qn.rsplit('.', 1)[-1]} (lines {lines}) while another "
+                f"role can rebind it between the reads — capture it "
+                f"into a local once (one read) and use the local"))
+
+    # -- check 4: publish-order --------------------------------------
+    # Writer shape: a function storing gate + payload under one lock
+    # (the mark_done shape — unlocked multi-field writes are already
+    # checks 1/2's findings). Reader shape: the gate is tested
+    # lock-free by some OTHER function, and the payload has lock-free
+    # accessors outside the writer too.
+    for qn, ff in sorted(facts.items()):
+        if _init_like(qn, facts):
+            continue
+        by_field: Dict[str, List[_Access]] = {}
+        for a in ff.accesses:
+            if a.is_write and a.held:
+                by_field.setdefault(a.field, []).append(a)
+        for gate in sorted(set(by_field) & set(gates)):
+            gf = fields.get(gate)
+            if gf is not None and gf.owned_by is not None:
+                continue
+            if not (gates[gate] - {qn}):
+                continue  # only the writer itself tests it
+            for payload in sorted(set(by_field) & set(lockfree_read)):
+                if payload == gate or payload in gates:
+                    continue
+                if not (lockfree_read[payload] - {qn}):
+                    continue
+                if _common_lock(by_field[gate] + by_field[payload]) \
+                        is None:
+                    continue
+                first_gate = min(a.line for a in by_field[gate])
+                late_payload = [a for a in by_field[payload]
+                                if a.line > first_gate]
+                if late_payload:
+                    findings.append(Finding(
+                        NAME,
+                        project.index.functions[qn].module.src.path,
+                        first_gate,
+                        f"{qn.rsplit('.', 1)[-1]} publishes gate field "
+                        f"'{gate}' (lock-free readers test it) before "
+                        f"storing payload '{payload}' (line "
+                        f"{late_payload[0].line}) — a racing reader "
+                        f"that sees the gate may read a payload that "
+                        f"is not yet visible; store the payload first"))
+                    break  # one payload witness per (writer, gate)
+    return findings
