@@ -1857,6 +1857,468 @@ def worker_ragged_allgather(rank: int, size: int) -> None:
     print("RESULT " + json.dumps(report), flush=True)
 
 
+# -- kernel-side wire speed (PR 16: batched reactor, int8 codec, -------
+# chunked relay) -------------------------------------------------------
+
+KERNEL_GATHER_STEPS = 40
+KERNEL_GATHER_BYTES = 16 << 10   # per-rank allgather block
+KERNEL_RELAY_STEPS = 30
+KERNEL_RELAY_BYTES = 1 << 20     # broadcast payload through the tree
+
+
+def worker_kernel_gather(rank: int, size: int) -> None:
+    """Batched-gather leg: an allgather loop on the socket star at
+    ws=8 — every op the coordinator collects one frame from each of
+    the other 7 ranks (the N-sequential-recvs pattern the reactor
+    turns into one batched submission) and broadcasts the ~128 KiB
+    world blob (over the MSG_ZEROCOPY threshold). Run in reactor-on /
+    HOROVOD_TPU_REACTOR=0 pairs by the orchestrator; the wire bytes
+    are identical, only how readiness is learned differs."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init()
+    n = KERNEL_GATHER_BYTES // 4
+    x = np.full(n, float(rank), np.float32)
+    for _ in range(5):
+        hvd.allgather(x, name="kg")
+    m0 = hvd.metrics()["local"]
+    hvd.barrier(name="kg.b0")
+    t0 = time.perf_counter()
+    for _ in range(KERNEL_GATHER_STEPS):
+        out = hvd.allgather(x, name="kg")
+    hvd.barrier(name="kg.b1")
+    elapsed = time.perf_counter() - t0
+    m1 = hvd.metrics()["local"]
+    assert np.asarray(out).nbytes == size * KERNEL_GATHER_BYTES
+    report = {
+        "bytes_per_rank": KERNEL_GATHER_BYTES,
+        "steps": KERNEL_GATHER_STEPS,
+        "us_per_op": round(elapsed * 1e6 / KERNEL_GATHER_STEPS, 1),
+    }
+
+    def _v(m, name):
+        rec = m.get(name)
+        if rec is None:
+            return 0.0
+        return rec["v"] if "v" in rec else rec.get("count", 0)
+
+    if m1:
+        report["data_copies"] = int(_v(m1, "hvd_data_copies_total")
+                                    - _v(m0, "hvd_data_copies_total"))
+        report["reactor_batches"] = int(
+            _v(m1, "hvd_reactor_batch_size")
+            - _v(m0, "hvd_reactor_batch_size"))
+        report["zerocopy_sends"] = int(
+            _v(m1, "hvd_zerocopy_sends_total")
+            - _v(m0, "hvd_zerocopy_sends_total"))
+    if os.environ.get("HVD_EXPECT_REACTOR") == "1" and rank == 0 and m1:
+        from horovod_tpu import native as _nat
+        if _nat.get() is not None:
+            assert report.get("reactor_batches", 0) > 0, \
+                "batched reactor never engaged (the A/B is vacuous)"
+    if rank == 0:
+        print("RESULT " + json.dumps(report), flush=True)
+    hvd.shutdown()
+
+
+def worker_kernel_relay(rank: int, size: int) -> None:
+    """Chunked-relay leg: a 1 MiB broadcast loop on a 4-fake-host
+    hierarchical world — the coordinator's frame reaches each host's
+    local root, which forwards it to its leaves. With the reactor on,
+    the root cuts through chunk-by-chunk (hvd_relay_frame, 256 KiB
+    chunks) instead of store-and-forward; off restores the classic
+    buffer-then-send relay, wire bytes identical."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init()
+    n = KERNEL_RELAY_BYTES // 4
+    x = np.full(n, float(rank), np.float32)
+    for _ in range(3):
+        out = hvd.broadcast(x, root_rank=0, name="kr")
+    m0 = hvd.metrics()["local"]
+    hvd.barrier(name="kr.b0")
+    t0 = time.perf_counter()
+    for _ in range(KERNEL_RELAY_STEPS):
+        out = hvd.broadcast(x, root_rank=0, name="kr")
+    hvd.barrier(name="kr.b1")
+    elapsed = time.perf_counter() - t0
+    m1 = hvd.metrics()["local"]
+    np.testing.assert_allclose(np.asarray(out)[0], 0.0)
+    report = {
+        "payload_bytes": KERNEL_RELAY_BYTES,
+        "steps": KERNEL_RELAY_STEPS,
+        "us_per_op": round(elapsed * 1e6 / KERNEL_RELAY_STEPS, 1),
+    }
+    if m1:
+        def _v(m, name):
+            rec = m.get(name)
+            if rec is None:
+                return 0.0
+            return rec["v"] if "v" in rec else rec.get("count", 0)
+        report["data_copies"] = int(_v(m1, "hvd_data_copies_total")
+                                    - _v(m0, "hvd_data_copies_total"))
+    if rank == 0:
+        print("RESULT " + json.dumps(report), flush=True)
+    hvd.shutdown()
+
+
+def _kernel_codec_leg() -> dict:
+    """Native int8 codec vs the numpy reference, in-process (no world
+    needed: the codec is rank-local CPU work). Times the fused
+    quantize+error-feedback pass and the dequantize pass on a 4 MiB
+    f32 gradient against the classic numpy triple / astype-multiply
+    round-trip, and spot-checks bit identity while at it."""
+    import numpy as np
+    sys.path.insert(0, REPO)
+    from horovod_tpu import native as _nat
+    from horovod_tpu.common import wire_dtype as wd
+
+    if _nat.get() is None or not hasattr(_nat.get(), "hvd_quant8"):
+        return {"skipped": "native core unavailable"}
+    n = 1 << 20
+    rng = np.random.RandomState(5)
+    arr = rng.randn(n).astype(np.float32)
+    res0 = (rng.randn(n) * 0.01).astype(np.float32)
+    buf = np.empty(4 + n, np.uint8)
+    out = np.empty(n, np.float32)
+    reps = 21
+
+    def _med(f):
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            f()
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        return ts[len(ts) // 2]
+
+    # bit-identity spot check on fresh residual chains
+    ref_buf = np.empty_like(buf)
+    wd._quantize_numpy((arr + res0), ref_buf)
+    nat_buf = np.empty_like(buf)
+    r = res0.copy()
+    assert _nat.quant8(arr, nat_buf, residual=r, residual_out=r)
+    bit_identical = bool(nat_buf.tobytes() == ref_buf.tobytes())
+
+    res_n = res0.copy()
+    t_qn = _med(lambda: _nat.quant8(arr, buf, residual=res_n,
+                                    residual_out=res_n))
+    state = {"res": res0.copy()}
+
+    def _np_triple():
+        comp = arr + state["res"]
+        wd._quantize_numpy(comp, buf)
+        scale = float(buf[:4].view(np.float32)[0])
+        sent = buf[4:].view(np.int8).astype(np.float32) \
+            * np.float32(scale)
+        state["res"] = comp - sent
+
+    t_qp = _med(_np_triple)
+    t_dn = _med(lambda: _nat.dequant8(buf, out))
+
+    def _np_deq():
+        scale = float(buf[:4].view(np.float32)[0])
+        np.multiply(buf[4:].view(np.int8).astype(np.float32),
+                    np.float32(scale), out=out)
+
+    t_dp = _med(_np_deq)
+    return {
+        "elems": n,
+        "reps": reps,
+        "bit_identical": bit_identical,
+        "quant_ef_native_us": round(t_qn * 1e6, 1),
+        "quant_ef_numpy_us": round(t_qp * 1e6, 1),
+        "quant_speedup": round(t_qp / t_qn, 2),
+        "dequant_native_us": round(t_dn * 1e6, 1),
+        "dequant_numpy_us": round(t_dp * 1e6, 1),
+        "dequant_speedup": round(t_dp / t_dn, 2),
+        "roundtrip_speedup": round((t_qp + t_dp) / (t_qn + t_dn), 2),
+    }
+
+
+def _kernel_gather_discipline_leg() -> dict:
+    """The batched-submission claim, isolated: ws=8 star fan-in (7
+    peer channels) with every peer's 16 KiB TAG_DATA frame already in
+    its socket buffer, then time ONE hvd_gather_frames_batched drain
+    against the 7 sequential Channel.recv_into calls it replaced (the
+    exact reactor-off fallback discipline). Pre-queuing removes the
+    peers' own send scheduling — on this one-core host a live world
+    measures the scheduler, not the recv discipline — so the ratio is
+    pure submission cost: 1 native call + one readiness batch vs 7
+    (ctypes call + poll + read chain) round trips. Legs alternate
+    rep-by-rep (drift-robust), median reported."""
+    import ctypes as ct
+    import socket
+
+    import numpy as np
+    sys.path.insert(0, REPO)
+    from horovod_tpu import native as _nat
+    from horovod_tpu.common import network
+
+    lib = _nat.get()
+    if lib is None or not hasattr(lib, "hvd_gather_frames_batched"):
+        return {"skipped": "native core unavailable"}
+    TAG_DATA = 4
+    npeers = 7
+    frame = 16 << 10
+    reps = 41
+    pairs = [socket.socketpair() for _ in range(npeers)]
+    senders = [network.Channel(a, b"") for a, _ in pairs]
+    recv_chans = [network.Channel(b, b"") for _, b in pairs]
+    fds = (ct.c_int * npeers)(*[b.fileno() for _, b in pairs])
+    payloads = [np.full(frame // 4, float(i), np.float32)
+                for i in range(npeers)]
+    bufs = [np.empty(frame, np.uint8) for _ in range(npeers)]
+    bufptrs = (ct.c_void_p * npeers)(*[b.ctypes.data for b in bufs])
+    caps = (ct.c_int64 * npeers)(*[frame] * npeers)
+    lens = (ct.c_int64 * npeers)()
+    done = (ct.c_uint8 * npeers)()
+    arrive = (ct.c_double * npeers)()
+    batches = (ct.c_int32 * npeers)()
+    nb = ct.c_int(0)
+    dev_idx = ct.c_int(-1)
+    dev_buf = ct.POINTER(ct.c_uint8)()
+    dev_len = ct.c_int64(0)
+    dev_tag = ct.c_uint8(0)
+    skip = (ct.c_uint8 * 1)(5)  # TAG_PING
+    sec = (ct.c_uint8 * 1)()
+
+    def _queue():
+        for ch, p in zip(senders, payloads):
+            ch.send(p, TAG_DATA)
+
+    def _drain_batched():
+        ct.memset(done, 0, npeers)
+        nb.value = 0
+        rc = lib.hvd_gather_frames_batched(
+            fds, npeers, sec, 0, TAG_DATA, bufptrs, caps, lens,
+            skip, 1, 5000, -1, _nat.NULL_ON_IDLE, done, arrive,
+            batches, ct.byref(nb), ct.byref(dev_idx),
+            ct.byref(dev_buf), ct.byref(dev_len), ct.byref(dev_tag))
+        assert rc == 0, f"batched gather rc {rc}"
+
+    def _drain_seq():
+        for ch, b in zip(recv_chans, bufs):
+            tag, n = ch.recv_into(b)
+            assert tag == TAG_DATA and n == frame
+
+    tb, ts = [], []
+    for _ in range(reps):
+        _queue()
+        t0 = time.perf_counter()
+        _drain_batched()
+        tb.append(time.perf_counter() - t0)
+        _queue()
+        t0 = time.perf_counter()
+        _drain_seq()
+        ts.append(time.perf_counter() - t0)
+    for a, b in pairs:
+        a.close()
+        b.close()
+    tb.sort()
+    ts.sort()
+    mb, ms = tb[len(tb) // 2], ts[len(ts) // 2]
+    flags = _nat.build_flags()
+    return {
+        "peers": npeers,
+        "frame_bytes": frame,
+        "reps": reps,
+        "backend": "io_uring" if (flags & 0x2) else "poll",
+        "batched_us": round(mb * 1e6, 1),
+        "sequential_us": round(ms * 1e6, 1),
+        "speedup": round(ms / mb, 2),
+    }
+
+
+def _kernel_relay_discipline_leg() -> dict:
+    """The cut-through claim, isolated: one local root relaying a
+    1 MiB upstream frame to its leaf (the 4-fake-host ws=8 shape) —
+    hvd_relay_frame with the production 256 KiB chunks vs the classic
+    store-and-forward it replaced (Channel.recv to a fresh bytes,
+    then Channel.send per child). Sender and leaf drainers run as
+    threads; the measured span covers the full relay op including
+    the leaves' receipt. Legs alternate rep-by-rep, median."""
+    import ctypes as ct
+    import socket
+    import threading
+
+    import numpy as np
+    sys.path.insert(0, REPO)
+    from horovod_tpu import native as _nat
+    from horovod_tpu.common import network
+
+    lib = _nat.get()
+    if lib is None or not hasattr(lib, "hvd_relay_frame"):
+        return {"skipped": "native core unavailable"}
+    TAG_DATA = 4
+    nchild = 1
+    frame = 1 << 20
+    chunk = 256 << 10
+    reps = 15
+    up_a, up_b = socket.socketpair()
+    kid_pairs = [socket.socketpair() for _ in range(nchild)]
+    up_send = network.Channel(up_a, b"")
+    up_recv = network.Channel(up_b, b"")
+    relay_kid = [network.Channel(a, b"") for a, _ in kid_pairs]
+    kid_recv = [network.Channel(b, b"") for _, b in kid_pairs]
+    child_fds = (ct.c_int * nchild)(*[a.fileno() for a, _ in kid_pairs])
+    payload = np.random.RandomState(0).randint(0, 255, frame, np.uint8)
+    buf = np.empty(frame, np.uint8)
+    win = (ct.c_uint8 * frame).from_buffer(buf)
+    sec = (ct.c_uint8 * 1)()
+    skip = (ct.c_uint8 * 2)(7, 8)  # TAG_METRICS, TAG_TRACE
+    out_len = ct.c_int64(0)
+    out_tag = ct.c_uint8(0)
+    spill = ct.POINTER(ct.c_uint8)()
+
+    def _sender():
+        up_send.send(payload, TAG_DATA)
+
+    def _drainer(ch):
+        tag, data = ch.recv()
+        assert tag == TAG_DATA and len(data) == frame
+
+    def _spawn():
+        th = [threading.Thread(target=_sender)]
+        th += [threading.Thread(target=_drainer, args=(c,))
+               for c in kid_recv]
+        for t in th:
+            t.start()
+        return th
+
+    def _run_cut_through():
+        th = _spawn()
+        t0 = time.perf_counter()
+        rc = lib.hvd_relay_frame(
+            up_b.fileno(), child_fds, nchild, TAG_DATA,
+            ct.addressof(win), frame, sec, 0, skip, 2, chunk,
+            5000, -1, ct.byref(out_len), ct.byref(out_tag),
+            ct.byref(spill))
+        assert rc == 0, f"relay rc {rc}"
+        for t in th:
+            t.join()
+        return time.perf_counter() - t0
+
+    def _run_classic():
+        th = _spawn()
+        t0 = time.perf_counter()
+        tag, data = up_recv.recv()
+        assert tag == TAG_DATA
+        for c in relay_kid:
+            c.send(data, TAG_DATA)
+        for t in th:
+            t.join()
+        return time.perf_counter() - t0
+
+    tc, tp = [], []
+    for _ in range(reps):
+        tc.append(_run_cut_through())
+        tp.append(_run_classic())
+    del win
+    up_a.close()
+    up_b.close()
+    for a, b in kid_pairs:
+        a.close()
+        b.close()
+    tc.sort()
+    tp.sort()
+    mc, mp = tc[len(tc) // 2], tp[len(tp) // 2]
+    return {
+        "children": nchild,
+        "frame_bytes": frame,
+        "chunk_bytes": chunk,
+        "reps": reps,
+        "cut_through_us": round(mc * 1e6, 1),
+        "store_forward_us": round(mp * 1e6, 1),
+        "speedup": round(mp / mc, 2),
+    }
+
+
+def _kernel_bench_section(np_: int) -> dict:
+    """The PR 16 kernel-wire A/B: batched gather at ws=np_ on the
+    socket star and the chunked hierarchical relay on 4 fake hosts,
+    each reactor-on vs HOROVOD_TPU_REACTOR=0 (wire bytes identical,
+    recv/send discipline differs), plus the in-process int8 codec
+    timing. The headline ratios come from the ISOLATED discipline
+    legs (pre-queued frames, alternating reps): a one-core host
+    schedules one world process at a time, so live-world A/B numbers
+    measure the scheduler and sit near 1.0 regardless of recv
+    discipline — they are recorded as context. World protocols as
+    for --steady-only: isolated alternating legs plus SIMULTANEOUS
+    pairs."""
+    import threading
+    base = {"HOROVOD_TPU_SHM": "0", "HOROVOD_TPU_RING_THRESHOLD": "-1",
+            "HOROVOD_TPU_METRICS": "1"}
+    on_env = dict(base, HOROVOD_TPU_REACTOR="1", HVD_EXPECT_REACTOR="1")
+    off_env = dict(base, HOROVOD_TPU_REACTOR="0")
+
+    def _ab(mode, per_rank_env=None, iso_reps=3, pair_reps=2):
+        iso_on, iso_off, iso_ratios = [], [], []
+        for _ in range(iso_reps):
+            a = _run_world(mode, np_, timeout=600.0, extra_env=on_env,
+                           per_rank_env=per_rank_env)
+            b = _run_world(mode, np_, timeout=600.0, extra_env=off_env,
+                           per_rank_env=per_rank_env)
+            iso_on.append(a)
+            iso_off.append(b)
+            iso_ratios.append(b["us_per_op"] / a["us_per_op"])
+        ratios = []
+        for _ in range(pair_reps):
+            pair = {}
+
+            def _go(key, env):
+                pair[key] = _run_world(mode, np_, timeout=600.0,
+                                       extra_env=env,
+                                       per_rank_env=per_rank_env)
+
+            ta = threading.Thread(target=_go, args=("on", on_env))
+            tb = threading.Thread(target=_go, args=("off", off_env))
+            ta.start()
+            tb.start()
+            ta.join()
+            tb.join()
+            ratios.append(pair["off"]["us_per_op"]
+                          / pair["on"]["us_per_op"])
+        iso_on.sort(key=lambda d: d["us_per_op"])
+        iso_off.sort(key=lambda d: d["us_per_op"])
+        iso_ratios.sort()
+        ratios.sort()
+        return {
+            "reactor_on": iso_on[len(iso_on) // 2],
+            "reactor_off": iso_off[len(iso_off) // 2],
+            "isolated_ratios": [round(r, 2) for r in iso_ratios],
+            "isolated_speedup": round(
+                iso_ratios[len(iso_ratios) // 2], 2),
+            "pair_ratios": [round(r, 2) for r in ratios],
+        }
+
+    gather_disc = _kernel_gather_discipline_leg()
+    relay_disc = _kernel_relay_discipline_leg()
+    gather = _ab("kernel_gather")
+    relay = _ab("kernel_relay",
+                per_rank_env=lambda r: {
+                    "HOROVOD_HOSTNAME": f"fakehost{r // (np_ // 4)}"})
+    codec = _kernel_codec_leg()
+    out = {
+        "world_size": np_,
+        "cores": os.cpu_count(),
+        "batched_gather": {"discipline": gather_disc, "world": gather},
+        "int8_codec": codec,
+        "hier_chunked_relay": {"discipline": relay_disc,
+                               "world": relay},
+    }
+    if "speedup" in gather_disc:
+        out["gather_meets_1_25x"] = gather_disc["speedup"] >= 1.25
+    if "speedup" in relay_disc:
+        out["relay_meets_1_2x"] = relay_disc["speedup"] >= 1.2
+    if "roundtrip_speedup" in codec:
+        out["codec_meets_1_3x"] = codec["roundtrip_speedup"] >= 1.3
+    return out
+
+
+
 def _run_single_proc(worker: str, timeout: float = 300.0) -> dict:
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -1940,7 +2402,8 @@ def main() -> None:
                              "overhead", "autotune_value", "cache",
                              "elastic", "compression",
                              "compression_autotune", "overlap",
-                             "trace_toggle", "multitenant"])
+                             "trace_toggle", "multitenant",
+                             "kernel_gather", "kernel_relay"])
     ap.add_argument("--rank", type=int)
     ap.add_argument("--size", type=int)
     ap.add_argument("--skip-variants", action="store_true",
@@ -1982,6 +2445,14 @@ def main() -> None:
                          "protocol, plus the 3:1 priority-weight "
                          "cycle-share shift) and merge it into "
                          "RESULTS_cpu.json")
+    ap.add_argument("--kernel", action="store_true",
+                    help="run just the kernel-side wire-speed A/B "
+                         "(batched reactor gather at ws=np, chunked "
+                         "hierarchical relay on np//2 fake hosts, "
+                         "each vs HOROVOD_TPU_REACTOR=0; isolated + "
+                         "simultaneous-pair protocols; plus the "
+                         "in-process native int8 codec timing) and "
+                         "merge it into RESULTS_cpu.json")
     ap.add_argument("--compression", action="store_true",
                     help="run just the wire-compression/two-level "
                          "grid ((algorithm x dtype x bucket) medians "
@@ -2005,6 +2476,8 @@ def main() -> None:
          "overlap": worker_overlap,
          "trace_toggle": worker_trace_toggle,
          "multitenant": worker_multitenant,
+         "kernel_gather": worker_kernel_gather,
+         "kernel_relay": worker_kernel_relay,
          "overhead": worker_overhead}[args.worker](
              args.rank, args.size)
         return
@@ -2055,6 +2528,36 @@ def main() -> None:
             json.dump(merged, fh, indent=2)
             fh.write("\n")
         print(f"merged multitenant into {results_path}")
+        return
+
+    if args.kernel:
+        print(f"== kernel-side wire speed A/B (np={np_}, "
+              f"reactor on/off) ==", flush=True)
+        kw = _kernel_bench_section(np_)
+        g, r, c = (kw["batched_gather"], kw["hier_chunked_relay"],
+                   kw["int8_codec"])
+        print(f"  batched gather {g['discipline'].get('speedup', 'n/a')}x "
+              f"(>=1.25 pass={kw.get('gather_meets_1_25x')}, "
+              f"world {g['world']['isolated_speedup']}x)   "
+              f"int8 codec roundtrip "
+              f"{c.get('roundtrip_speedup', 'n/a')}x "
+              f"(>=1.3 pass={kw.get('codec_meets_1_3x')}, "
+              f"bit_identical={c.get('bit_identical')})   "
+              f"chunked relay {r['discipline'].get('speedup', 'n/a')}x "
+              f"(>=1.2 pass={kw.get('relay_meets_1_2x')}, "
+              f"world {r['world']['isolated_speedup']}x)   copies on="
+              f"{g['world']['reactor_on'].get('data_copies')}",
+              flush=True)
+        try:
+            with open(results_path) as fh:
+                merged = json.load(fh)
+        except (OSError, ValueError):
+            merged = {}
+        merged["kernel_wire"] = kw
+        with open(results_path, "w") as fh:
+            json.dump(merged, fh, indent=2)
+            fh.write("\n")
+        print(f"merged kernel_wire into {results_path}")
         return
 
     if args.compression:

@@ -26,6 +26,7 @@ from typing import Optional
 
 from horovod_tpu.common import lockdep
 from horovod_tpu.common import logging as hlog
+from horovod_tpu.common import network
 from horovod_tpu.common.config import Config
 from horovod_tpu.common.controller import (
     Controller, LocalController, TcpCoordinator, TcpWorker,
@@ -88,6 +89,13 @@ def _build_runtime(cfg: Config, coordinator_listener=None,
     secret = cfg.secret_key.encode() if cfg.secret_key else b""
     size = cfg.size if cfg.size > 0 else 1
     rank = cfg.rank if cfg.rank >= 0 else 0
+    # Kernel-side wire knobs (docs/performance.md Layer 6): the
+    # MSG_ZEROCOPY send threshold is a channel-layer module hook (it
+    # gates sends made during rendezvous too), the reactor switch is
+    # stamped on the controller below once it exists. Both are purely
+    # rank-local recv/send disciplines — the wire stays byte-identical
+    # — so heterogeneous worlds interoperate.
+    network.set_zerocopy_threshold(cfg.zerocopy_send_threshold)
     elastic_port = elastic_ctx.port if elastic_ctx is not None \
         and size > 1 else None
 
@@ -127,6 +135,10 @@ def _build_runtime(cfg: Config, coordinator_listener=None,
                                heartbeat_timeout=cfg.heartbeat_timeout_s,
                                elastic_port=elastic_port,
                                world_id=cfg.world_id)
+    # Rank-local reactor opt-out (HOROVOD_TPU_REACTOR=0): the batched
+    # recv discipline and the chunked-relay legs fall back to the
+    # sequential/store-and-forward paths on THIS rank only.
+    controller._reactor = cfg.reactor
 
     # Install the world-identical elastic membership (the
     # coordinator's broadcast endpoint map) for this generation.

@@ -106,6 +106,27 @@ class Config:
     # the wire format is identical either way).
     zero_copy: bool = True
 
+    # Batched-submission reactor (docs/performance.md Layer 6): the
+    # coordinator's N per-cycle peer recvs collapse into ONE native
+    # readiness loop (hvd_gather_frames_batched — io_uring when the
+    # build and kernel both have it, poll(2) otherwise, byte-identical
+    # either way), and the hierarchical root/leaf relay legs switch to
+    # the chunked cut-through relay (hvd_relay_frame).
+    # HOROVOD_TPU_REACTOR=0 restores the sequential recv loop and the
+    # store-and-forward relay; heterogeneous worlds are safe — the
+    # wire format is identical either way.
+    reactor: bool = True
+
+    # Frames at or above this many payload bytes go out via
+    # MSG_ZEROCOPY (kernel pins the pages instead of copying them into
+    # the socket buffer; completion notifications are drained before
+    # the send returns). Below it the plain copying send wins — the
+    # pin/notify overhead beats the copy only for large frames.
+    # 0 disables zerocopy sends entirely; the
+    # hvd_zerocopy_copied_total counter surfaces kernels/paths that
+    # silently degrade to copying (loopback always does).
+    zerocopy_send_threshold: int = 64 * 1024
+
     # Ring data plane for the socket backend (TPU-native extension): host
     # payloads at or above this size ride the bandwidth-optimal 2-phase
     # ring (ops/ring.py) instead of the star through rank 0 — the TCP
@@ -365,6 +386,10 @@ class Config:
         c.cache_speculative = _env_bool("HOROVOD_CACHE_SPECULATIVE",
                                         c.cache_speculative)
         c.zero_copy = _env_bool("HOROVOD_TPU_ZERO_COPY", c.zero_copy)
+        c.reactor = _env_bool("HOROVOD_TPU_REACTOR", c.reactor)
+        c.zerocopy_send_threshold = _env_int(
+            "HOROVOD_TPU_ZEROCOPY_SEND_THRESHOLD",
+            c.zerocopy_send_threshold)
         c.ring_threshold_bytes = _env_int(
             "HOROVOD_TPU_RING_THRESHOLD", c.ring_threshold_bytes)
         c.shm_enabled = _env_bool("HOROVOD_TPU_SHM", c.shm_enabled)

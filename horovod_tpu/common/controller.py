@@ -366,6 +366,10 @@ class _NativeFanout:
                              timeout_s / (2.0 * max(1, len(fds))))
             hb = (timeout_s, interval_s, on_idle)
         self._hb = hb
+        # Lazily-built ctypes ON_IDLE thunk for the batched reactor
+        # (gather_into); cached so the callback object outlives the
+        # native calls that fire it.
+        self._on_idle_c = None
 
     @classmethod
     def create(cls, channels, secret: bytes, hb=None, on_metrics=None,
@@ -517,11 +521,21 @@ class _NativeFanout:
                  exclude_rank: Optional[int] = None) -> None:
         ct = self._ct
         if exclude_rank is None:
+            fd_list = self._fd_list
             fds, n = self._fds, len(self.ranks)
         else:
-            sub = [fd for r, fd in zip(self.ranks, self._fds)
-                   if r != exclude_rank]
-            fds, n = (ct.c_int * len(sub))(*sub), len(sub)
+            fd_list = [fd for r, fd in zip(self.ranks, self._fds)
+                       if r != exclude_rank]
+            fds, n = (ct.c_int * len(fd_list))(*fd_list), len(fd_list)
+        # Large frames (the coordinator's world blobs) ride the
+        # MSG_ZEROCOPY leg when the threshold is armed — pages pinned
+        # per send instead of copied into every peer's socket buffer.
+        hb = self._hb
+        if network.zc_fanout_send(
+                self._lib, fd_list, tag, payload, self._secret_buf,
+                len(self._secret),
+                int(hb[0] * 1000) if hb is not None else -1):
+            return
         buf = self._as_u8(payload)
         rc = self._lib.hvd_broadcast_frame(
             fds, n, tag, buf, len(payload), self._secret_buf,
@@ -544,6 +558,129 @@ class _NativeFanout:
         if rc != 0:
             raise ConnectionError(f"native scatter failed: errno {-rc}")
 
+    # -- batched-submission reactor (docs/performance.md Layer 6) --------
+    @property
+    def batched_ok(self) -> bool:
+        """True when the loaded core exports the batched reactor entry
+        (a stale pre-reactor .so simply keeps the sequential path)."""
+        return hasattr(self._lib, "hvd_gather_frames_batched")
+
+    def gather_into(self, expect_tag: int, views: Dict[int, object]):
+        """One frame per peer straight into caller-owned writable
+        buffers via the batched-submission reactor
+        (hvd_gather_frames_batched): readiness across every channel is
+        discovered in ONE submission per wakeup (io_uring when the
+        build and kernel carry it, poll(2) otherwise) and each ready
+        frame is read to completion in C with the GIL released — the
+        recv-into mirror of :meth:`gather`, minus the per-slice
+        malloc/copy round-trips. Out-of-band frames keep the exact
+        _recv_data_into semantics: PINGs are absorbed in C,
+        METRICS/TRACE bounce out as deviations, are dispatched here
+        and the call resumes with the done[] map intact (a peer's
+        delivered frame is never re-read). Returns
+        ``({rank: length}, {rank: arrive stamp}, [frames-per-wakeup])``.
+        """
+        ct = self._ct
+        from horovod_tpu import native as _native
+        n = len(self.ranks)
+        order = self.ranks
+        mvs = [memoryview(network.as_byte_view(views[r]))
+               for r in order]
+        # Writable ctypes windows over the caller buffers: kept in a
+        # list so the pointers stay live across the (possibly
+        # re-entered) native call.
+        wins = [(ct.c_uint8 * len(mv)).from_buffer(mv) if len(mv)
+                else (ct.c_uint8 * 1)() for mv in mvs]
+        bufs = (ct.c_void_p * n)(*[ct.addressof(w) for w in wins])
+        caps = (ct.c_int64 * n)(*[len(mv) for mv in mvs])
+        lens = (ct.c_int64 * n)()
+        done = (ct.c_uint8 * n)()
+        arrive = (ct.c_double * n)()
+        batch_sizes = (ct.c_int32 * n)()
+        nbatches = ct.c_int(0)
+        dev_idx = ct.c_int(-1)
+        dev_buf = ct.POINTER(ct.c_uint8)()
+        dev_len = ct.c_int64(0)
+        dev_tag = ct.c_uint8(0)
+        skip = (ct.c_uint8 * 1)(TAG_PING)
+        if self._hb is None:
+            timeout_ms = interval_ms = -1
+            timeout_s = 0.0
+            on_idle_c = ct.cast(None, _native.ON_IDLE_FUNC)
+        else:
+            timeout_s, interval_s, on_idle = self._hb
+            timeout_ms = max(1, int(timeout_s * 1000))
+            interval_ms = max(1, int(interval_s * 1000))
+            if self._on_idle_c is None:
+                # The ctypes thunk must outlive every native call that
+                # may fire it — cache it for the fanout's lifetime.
+                self._on_idle_c = _native.ON_IDLE_FUNC(on_idle)
+            on_idle_c = self._on_idle_c
+        while True:
+            rc = self._lib.hvd_gather_frames_batched(
+                self._fds, n, self._secret_buf, len(self._secret),
+                expect_tag, bufs, caps, lens, skip, 1,
+                timeout_ms, interval_ms, on_idle_c, done, arrive,
+                batch_sizes, ct.byref(nbatches), ct.byref(dev_idx),
+                ct.byref(dev_buf), ct.byref(dev_len),
+                ct.byref(dev_tag))
+            if rc == 0:
+                break
+            if rc == 1:
+                # Deviation: one authenticated non-PING, non-expected
+                # frame was pulled off a peer; dispatch it and resume
+                # the batch (the peer stays pending — its real frame
+                # is still owed, exactly like _recv_data_into).
+                r = order[dev_idx.value]
+                tag = dev_tag.value
+                if dev_buf:
+                    payload = ct.string_at(dev_buf, dev_len.value)
+                    self._lib.hvd_free(dev_buf)
+                    dev_buf = ct.POINTER(ct.c_uint8)()
+                else:
+                    payload = b""
+                if tag == TAG_METRICS:
+                    if self._on_metrics is not None:
+                        self._on_metrics(r, payload)
+                    continue
+                if tag == TAG_TRACE:
+                    if self._on_trace is not None:
+                        self._on_trace(r, payload)
+                    continue
+                if tag == TAG_ABORT:
+                    origin, cause = heartbeat.decode_abort(payload)
+                    raise _abort_error(origin, cause, resolved=True)
+                if tag != expect_tag:
+                    raise ConnectionError(
+                        f"expected tag {expect_tag} from rank {r}, "
+                        f"got {tag}")
+                # expect_tag but drained to the spill: the frame
+                # overflowed its preallocated buffer.
+                raise ConnectionError(
+                    f"data frame of {dev_len.value} bytes from rank "
+                    f"{r} overflows {caps[dev_idx.value]}-byte buffer")
+            if rc == -errno.ETIMEDOUT:
+                waiting = [order[i] for i in range(n) if not done[i]]
+                raise _abort_error(
+                    waiting[0] if waiting else -1,
+                    f"no control frame from rank(s) {waiting} for "
+                    f"{timeout_s:g}s — peer presumed dead (heartbeat "
+                    f"timeout; raise HOROVOD_HEARTBEAT_TIMEOUT if "
+                    f"peers legitimately stall longer)")
+            i = dev_idx.value
+            if 0 <= i < n:
+                r = order[i]
+                raise _abort_error(
+                    r, f"control channel to rank {r} failed during "
+                       f"the batched gather: errno {-rc}")
+            raise ConnectionError(
+                f"batched native gather failed: errno {-rc}")
+        out = {r: int(lens[i]) for i, r in enumerate(order)}
+        self.last_arrivals = {r: arrive[i]
+                              for i, r in enumerate(order) if arrive[i]}
+        return out, self.last_arrivals, \
+            list(batch_sizes[:min(nbatches.value, n)])
+
 
 def _as_buffer(payload):
     """Normalize a data-plane payload to a flat byte view. Callers may
@@ -552,6 +689,15 @@ def _as_buffer(payload):
     if payload is None:
         return None
     return network.as_byte_view(payload)
+
+
+# Cut-through chunk size for the hierarchical relay legs
+# (hvd_relay_frame): a local root forwards each chunk downstream as it
+# arrives, so a leaf's read of chunk i overlaps the root's read of
+# chunk i+1 and the per-hop latency approaches max(up, down) instead
+# of up + down. 256 KiB keeps the resident window small while still
+# amortizing syscalls on multi-MB broadcast payloads.
+_RELAY_CHUNK_BYTES = 256 * 1024
 
 
 class Topology:
@@ -646,6 +792,15 @@ class Controller:
     _m_ctrl_rx = None
     _m_ctrl_tx = None
     _metrics_on = False
+    # Batched-submission reactor (docs/performance.md Layer 6):
+    # enabled by default; the runtime overrides from
+    # HOROVOD_TPU_REACTOR so one rank can opt out and the world stays
+    # wire byte-identical (the knob only picks this rank's LOCAL recv
+    # discipline).
+    _reactor = True
+    # Frames completed per reactor wakeup (histogram); None until
+    # attach_metrics runs — the unattached path records nothing.
+    _m_reactor_batch = None
 
     def attach_metrics(self, registry) -> None:
         """Install control-plane instrumentation from the runtime's
@@ -657,6 +812,25 @@ class Controller:
         self._m_ctrl_tx = registry.counter(
             'hvd_control_bytes_total{direction="tx"}',
             "control-plane bytes sent by this rank")
+        # Reactor observability: how many frames each batched wakeup
+        # delivered (1s everywhere = the reactor is engaged but the
+        # world trickles; missing series = sequential fallback), plus
+        # the MSG_ZEROCOPY send counters maintained by the channel
+        # layer's module hooks (network.py — a genuinely zero-copy
+        # send ticks sends only; sends == copied means the kernel
+        # degraded every one to a copy, e.g. loopback).
+        self._m_reactor_batch = registry.histogram(
+            "hvd_reactor_batch_size",
+            "frames completed per batched-reactor wakeup",
+            [1, 2, 4, 8, 16, 32])
+        network.attach_zerocopy_metrics(
+            registry.counter(
+                "hvd_zerocopy_sends_total",
+                "frames sent with MSG_ZEROCOPY by this rank"),
+            registry.counter(
+                "hvd_zerocopy_copied_total",
+                "MSG_ZEROCOPY completions the kernel degraded to a "
+                "plain copy"))
         self._metrics_on = bool(registry.enabled)
 
     def send_metrics(self, payload: bytes) -> None:
@@ -1389,6 +1563,9 @@ class TcpCoordinator(Controller):
                 mv[:len(data)] = data
                 lens[r] = len(data)
             return lens
+        if self._reactor and self._fanout is not None \
+                and self._fanout.batched_ok:
+            return self._gather_data_into_batched(outs)
         lens = [0] * self._size
         try:
             for r, ch in self._channels.items():
@@ -1397,6 +1574,35 @@ class TcpCoordinator(Controller):
             raise
         except (ConnectionError, OSError) as e:
             self._raise_transport(e)
+        return lens
+
+    def _gather_data_into_batched(self, outs) -> List[int]:
+        """Reactor data gather: every worker's TAG_DATA frame lands
+        straight in its preallocated buffer through ONE batched
+        readiness submission per wakeup (_NativeFanout.gather_into)
+        instead of N sequential Python recv loops. Wire-identical to
+        the sequential path — only this rank's recv scheduling
+        changes — so HOROVOD_TPU_REACTOR may differ across ranks."""
+        fan = self._fanout
+        lens = [0] * self._size
+        try:
+            got, _arrivals, batches = fan.gather_into(
+                TAG_DATA, {r: outs[r] for r in self._channels})
+        except WorldAbortedError:
+            raise
+        except (ConnectionError, OSError) as e:
+            self._raise_transport(e)
+        for r, n in got.items():
+            lens[r] = n
+        hist = self._m_reactor_batch
+        if hist is not None:
+            for b in batches:
+                hist.observe(b)
+        if self._metrics_on:
+            now = time.monotonic()
+            for r in got:
+                self._last_seen[r] = now
+            self._m_ctrl_rx.inc(sum(got.values()))
         return lens
 
     def broadcast_data_into(self, payload, out,
@@ -1675,6 +1881,10 @@ class TcpWorker(Controller):
         # liveness timestamps for peer_heartbeat_ages (metrics only)
         self._up_seen = time.monotonic()
         self._child_seen: Dict[int, float] = {}
+        # Reusable landing buffer for the chunked cut-through relay's
+        # bytes-returning legs (lazily sized; frames past its capacity
+        # spill to a native malloc for that call only).
+        self._relay_buf: Optional[bytearray] = None
         if (info.get("hier") and self.topology.cross_rank != 0
                 and self.topology.local_size > 1):
             _, host_members = host_groups(hostnames)
@@ -1982,6 +2192,8 @@ class TcpWorker(Controller):
         return None
 
     def broadcast_responses(self, payload: Optional[bytes]) -> bytes:
+        if self._relay_native_ok():
+            return self._relay_up_to_children(TAG_RESPONSES)[1]
         data = self._recv_up(TAG_RESPONSES)
         self._send_children(data, TAG_RESPONSES)
         return data
@@ -2008,6 +2220,8 @@ class TcpWorker(Controller):
             self._send_up(data, TAG_DATA)
             self._send_children(data, TAG_DATA, exclude_rank=root_rank)
             return data
+        if self._relay_native_ok():
+            return self._relay_up_to_children(TAG_DATA)[1]
         data = self._recv_up(TAG_DATA)
         self._send_children(data, TAG_DATA)
         return data
@@ -2079,6 +2293,140 @@ class TcpWorker(Controller):
                 self._m_ctrl_rx.inc(n)
             return n
 
+    # -- chunked cut-through relay (docs/performance.md Layer 6) ---------
+    def _relay_native_ok(self) -> bool:
+        """The cast-while-receiving relay leg is available: reactor on
+        for this rank, leaves to serve, and a native core exporting
+        hvd_relay_frame (a stale pre-reactor .so keeps the
+        store-and-forward path — the wire is identical either way)."""
+        if not (self._reactor and self._children):
+            return False
+        from horovod_tpu import native as _native
+        lib = _native.get()
+        return lib is not None and hasattr(lib, "hvd_relay_frame")
+
+    def _relay_up_to_children(self, expect_tag: int, out=None):
+        """One upward frame relayed to every leaf cast-while-receiving
+        (hvd_relay_frame): header + digest go downstream before the
+        first payload byte, then each _RELAY_CHUNK_BYTES chunk forwards
+        as it arrives — replacing the recv-whole-frame-then-send
+        store-and-forward of _recv_up + _send_children with a
+        cut-through pipeline, wire byte-identical. METRICS/TRACE strays
+        are dropped in C (same tolerance as _recv_up); PING and ABORT
+        bounce back here so liveness relays downward and abort decode
+        keep their exact sequential semantics. Returns ``(nbytes,
+        payload)`` — payload is bytes when ``out`` is None, else None
+        with the frame landed in ``out``."""
+        import ctypes as ct
+        from horovod_tpu import native as _native
+        lib = _native.get()
+        if out is not None:
+            mv = memoryview(network.as_byte_view(out))
+        else:
+            if self._relay_buf is None:
+                self._relay_buf = bytearray(1 << 20)
+            mv = memoryview(self._relay_buf)
+        win = (ct.c_uint8 * len(mv)).from_buffer(mv) if len(mv) \
+            else (ct.c_uint8 * 1)()
+        kids = sorted(self._children)
+        child_fds = (ct.c_int * len(kids))(
+            *[self._children[r].sock.fileno() for r in kids])
+        try:
+            up_fd = self._ch.sock.fileno()
+        except OSError:
+            up_fd = -1
+        if up_fd < 0:
+            raise _abort_error(
+                self._up_rank,
+                f"control channel to {self._ch.peer} closed before "
+                f"the relay")
+        secret = self._ch.secret or b""
+        sbuf = (ct.c_uint8 * max(1, len(secret))).from_buffer_copy(
+            secret or b"\x00")
+        skip = (ct.c_uint8 * 2)(TAG_METRICS, TAG_TRACE)
+        if self._hb_timeout and self._hb_timeout > 0:
+            t_s, i_s = _hb_normalized(self._hb_timeout,
+                                      self._hb_interval)
+            timeout_ms = max(1, int(t_s * 1000))
+            interval_ms = max(1, int(i_s * 1000))
+        else:
+            timeout_ms = interval_ms = -1
+        out_len = ct.c_int64(0)
+        out_tag = ct.c_uint8(0)
+        spill = ct.POINTER(ct.c_uint8)()
+        while True:
+            rc = lib.hvd_relay_frame(
+                up_fd, child_fds, len(kids), expect_tag,
+                ct.addressof(win), len(mv), sbuf, len(secret),
+                skip, 2, _RELAY_CHUNK_BYTES, timeout_ms, interval_ms,
+                ct.byref(out_len), ct.byref(out_tag), ct.byref(spill))
+            if rc == 2:
+                # Deviation: an authenticated non-stray frame that is
+                # NOT the expected one — it was absorbed, not relayed.
+                tag = out_tag.value
+                if spill:
+                    payload = ct.string_at(spill, out_len.value)
+                    lib.hvd_free(spill)
+                    spill = ct.POINTER(ct.c_uint8)()
+                else:
+                    payload = b""
+                if self._metrics_on:
+                    self._up_seen = time.monotonic()
+                if tag == TAG_PING:
+                    if self._trace_on:
+                        self._note_ping(payload)
+                    self._relay_children_safe(payload, TAG_PING)
+                    continue
+                if tag == TAG_ABORT:
+                    origin, cause = heartbeat.decode_abort(payload)
+                    self._relay_children_safe(payload, TAG_ABORT)
+                    raise _abort_error(origin, cause, resolved=True)
+                raise ConnectionError(
+                    f"expected tag {expect_tag} from {self._ch.peer}, "
+                    f"got {tag}")
+            if rc == 1:
+                # Expected frame, relayed, but bigger than the landing
+                # buffer: the payload rode through a native spill.
+                n = out_len.value
+                payload = ct.string_at(spill, n) if spill else b""
+                if spill:
+                    lib.hvd_free(spill)
+                    spill = ct.POINTER(ct.c_uint8)()
+                if out is not None:
+                    raise ConnectionError(
+                        f"frame of {n} bytes from {self._ch.peer} "
+                        f"overflows {len(mv)}-byte buffer")
+                if self._metrics_on:
+                    self._up_seen = time.monotonic()
+                    self._m_ctrl_rx.inc(n)
+                return n, payload
+            if rc == 0:
+                n = out_len.value
+                if self._metrics_on:
+                    self._up_seen = time.monotonic()
+                    self._m_ctrl_rx.inc(n)
+                return n, (bytes(mv[:n]) if out is None else None)
+            if rc == -errno.ETIMEDOUT:
+                raise _abort_error(
+                    self._up_rank,
+                    f"no data from {self._ch.peer} for "
+                    f"{self._hb_timeout:g}s — peer presumed dead "
+                    f"(heartbeat timeout; raise "
+                    f"HOROVOD_HEARTBEAT_TIMEOUT if peers legitimately "
+                    f"stall longer)")
+            # A child write failure surfaces with the same negative rc
+            # as an upward read failure — probe the leaves to blame
+            # the right tier (mirror of _raise_child_transport).
+            dead = _dead_peers(self._children)
+            if dead:
+                raise _abort_error(
+                    dead[0],
+                    f"relay to local leaves failed: errno {-rc}")
+            raise _abort_error(
+                self._up_rank,
+                f"control channel to {self._ch.peer} failed during "
+                f"the chunked relay: errno {-rc}")
+
     def gather_data_into(self, payload, outs) -> Optional[List[int]]:
         self._gather_up(_as_buffer(payload), TAG_DATA)
         return None
@@ -2097,6 +2445,8 @@ class TcpWorker(Controller):
             mv = memoryview(network.as_byte_view(out))
             mv[:len(data)] = data
             return len(data)
+        if self._relay_native_ok():
+            return self._relay_up_to_children(TAG_DATA, out=out)[0]
         n = self._recv_up_into(out, TAG_DATA)
         if self._children:
             self._send_children(

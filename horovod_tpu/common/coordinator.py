@@ -237,9 +237,17 @@ def construct_response(table: MessageTable, name: str,
                         postscale_factor=first.postscale_factor,
                         wire_dtype=wire)
     if op == RequestType.ALLGATHER:
+        # Same min-resolve as the allreduce branch, then the int8 ->
+        # bf16 degrade: gathered blocks concatenate into ONE payload,
+        # which cannot carry per-rank int8 scale headers (see
+        # wire_dtype.allgather_wire).
+        wire = _wd.allgather_wire(
+            _wd.resolve(req.wire_dtype for req in requests)) \
+            if first.tensor_type in _wd.COMPRESSIBLE else _wd.WIRE_NONE
         return Response(response_type=ResponseType.ALLGATHER,
                         tensor_names=[name], devices=devices,
-                        tensor_sizes=tensor_sizes)
+                        tensor_sizes=tensor_sizes,
+                        wire_dtype=wire)
     if op == RequestType.BROADCAST:
         return Response(response_type=ResponseType.BROADCAST,
                         tensor_names=[name], devices=devices)
@@ -250,9 +258,17 @@ def construct_response(table: MessageTable, name: str,
         numel = 1
         for d in first.tensor_shape:
             numel *= d
+        # Full negotiation including int8: the star leg dequantizes
+        # per-rank contributions into a full-precision accumulator and
+        # requantizes per OUTPUT slice, so per-rank scales never mix
+        # (ops/socket_ops.py). Ring routing degrades via ring_wire at
+        # the backend, exactly like allreduce.
+        wire = _wd.resolve(req.wire_dtype for req in requests) \
+            if first.tensor_type in _wd.COMPRESSIBLE else _wd.WIRE_NONE
         return Response(response_type=ResponseType.REDUCESCATTER,
                         tensor_names=[name], devices=devices,
-                        tensor_sizes=[numel])
+                        tensor_sizes=[numel],
+                        wire_dtype=wire)
     if op == RequestType.BARRIER:
         return Response(response_type=ResponseType.BARRIER,
                         tensor_names=[name])

@@ -31,6 +31,70 @@ _DIGEST_LEN = 32
 # is a measurable fraction of per-op cost.
 _INLINE_SEND = 16 * 1024
 
+# MSG_ZEROCOPY send gate: frames with at least this many payload bytes
+# go out via hvd_sendv_zc (kernel pins the pages; completions drained
+# before return), smaller ones keep the plain copying sendmsg. < 0
+# disables — the import-time default, so the module stands alone; the
+# runtime arms it from Config.zerocopy_send_threshold
+# (HOROVOD_TPU_ZEROCOPY_SEND_THRESHOLD) via set_zerocopy_threshold.
+_ZC_THRESHOLD = -1
+# Counter hooks rebound by attach_zerocopy_metrics (runtime metrics
+# registry): sends that went out zero-copy, and completions where the
+# kernel silently degraded to a copy (loopback always does).
+_ZC_SENDS_METRIC = None
+_ZC_COPIED_METRIC = None
+
+
+def set_zerocopy_threshold(threshold: int) -> None:
+    """Arm (or disarm with <= 0) the MSG_ZEROCOPY send threshold for
+    every Channel in this process."""
+    global _ZC_THRESHOLD
+    _ZC_THRESHOLD = threshold if threshold > 0 else -1
+
+
+def attach_zerocopy_metrics(sends, copied) -> None:
+    """Bind the hvd_zerocopy_sends_total / hvd_zerocopy_copied_total
+    counters (None detaches)."""
+    global _ZC_SENDS_METRIC, _ZC_COPIED_METRIC
+    _ZC_SENDS_METRIC = sends
+    _ZC_COPIED_METRIC = copied
+
+
+def zc_fanout_send(lib, fds, tag: int, payload,
+                   secret_buf, secret_len: int,
+                   timeout_ms: int = -1) -> bool:
+    """MSG_ZEROCOPY leg for the coordinator fanout broadcast
+    (_NativeFanout.send_all): a frame at/above the armed threshold
+    goes out as one hvd_sendv_zc per peer — pages pinned once per
+    send, completions drained before return, so the caller keeps the
+    exact buffer-lifetime contract of hvd_broadcast_frame. Returns
+    False when the gate is closed (threshold disarmed, frame too
+    small, or a pre-zerocopy .so): the caller then keeps its single
+    hvd_broadcast_frame call. Same wire bytes either way."""
+    view = as_byte_view(payload)
+    if _ZC_THRESHOLD < 0 or len(view) < _ZC_THRESHOLD \
+            or not hasattr(lib, "hvd_sendv_zc"):
+        return False
+    import ctypes
+    import numpy as np
+    arr = np.frombuffer(view, np.uint8)  # zero-copy address probe
+    ptrs = (ctypes.c_void_p * 1)(arr.ctypes.data)
+    lens = (ctypes.c_int64 * 1)(len(arr))
+    for fd in fds:
+        zs = ctypes.c_int(0)
+        zcopied = ctypes.c_int(0)
+        rc = lib.hvd_sendv_zc(fd, tag, ptrs, lens, 1, secret_buf,
+                              secret_len, timeout_ms,
+                              ctypes.byref(zs), ctypes.byref(zcopied))
+        if rc != 0:
+            raise ConnectionError(
+                f"zero-copy broadcast failed: errno {-rc}")
+        if _ZC_SENDS_METRIC is not None and zs.value:
+            _ZC_SENDS_METRIC.inc(zs.value)
+        if _ZC_COPIED_METRIC is not None and zcopied.value:
+            _ZC_COPIED_METRIC.inc(zcopied.value)
+    return True
+
 
 def as_byte_view(payload):
     """Flat byte view over any C-contiguous buffer; bytes pass through.
@@ -258,6 +322,29 @@ class Channel:
             arr = np.frombuffer(v, np.uint8)  # zero-copy address probe
             keep.append(arr)
             ptrs[i] = arr.ctypes.data
+        if _ZC_THRESHOLD >= 0 and total >= _ZC_THRESHOLD \
+                and hasattr(lib, "hvd_sendv_zc"):
+            # MSG_ZEROCOPY leg: same frame bytes, pages pinned instead
+            # of copied; the native call drains every completion before
+            # returning (bounded by the armed deadline), so ``keep``
+            # may be dropped the moment it returns — exactly the
+            # lifetime contract of the plain path.
+            hb = self._hb
+            timeout_ms = int(hb[0] * 1000) if hb else -1
+            zs = ctypes.c_int(0)
+            zcopied = ctypes.c_int(0)
+            rc = lib.hvd_sendv_zc(
+                self.sock.fileno(), tag, ptrs, lens, n,
+                self._secret_buf(), len(self.secret or b""),
+                timeout_ms, ctypes.byref(zs), ctypes.byref(zcopied))
+            if rc != 0:
+                raise ConnectionError(
+                    f"send to {self.peer} failed: errno {-rc}")
+            if _ZC_SENDS_METRIC is not None and zs.value:
+                _ZC_SENDS_METRIC.inc(zs.value)
+            if _ZC_COPIED_METRIC is not None and zcopied.value:
+                _ZC_COPIED_METRIC.inc(zcopied.value)
+            return True
         rc = lib.hvd_sendv(self.sock.fileno(), tag, ptrs, lens, n,
                            self._secret_buf(), len(self.secret or b""))
         if rc != 0:

@@ -536,9 +536,11 @@ class Runtime:
             bi = htrace.build_info()
             reg.gauge(
                 f'hvd_build_info{{version="{bi["version"]}",'
-                f'native="{bi["native"]}",knobs="{bi["knobs"]}"}}',
+                f'native="{bi["native"]}",knobs="{bi["knobs"]}",'
+                f'flags="{bi["flags"]}"}}',
                 "build identity: package version, native .so build "
-                "hash, armed-knobs digest (value is always 1)",
+                "hash, armed-knobs digest, kernel-feature flags "
+                "(io_uring/zerocopy; value is always 1)",
                 agg=hmetrics.AGG_MAX).set(1)
 
         # -- world trace plane (HOROVOD_TPU_TRACE, common/trace.py) ----
@@ -731,11 +733,17 @@ class Runtime:
                       dtype: DataType) -> int:
         """This rank's wire-dtype bid for one request: the configured
         compression for float32/float64 allreduces (the gradient
-        path), none for everything else. The coordinator min-resolves
-        the world's bids per tensor, so a divergent knob degrades the
-        verdict instead of the world."""
-        if self._wire_propose and request_type == RequestType.ALLREDUCE \
-                and dtype in _wd.COMPRESSIBLE:
+        path), allgathers and reducescatters — every payload-moving
+        collective with a meaningful reduced-precision rendering —
+        none for everything else. The coordinator min-resolves the
+        world's bids per tensor (and degrades int8 allgathers to bf16,
+        since a concatenated world blob cannot carry per-rank scales),
+        so a divergent knob degrades the verdict instead of the
+        world."""
+        if self._wire_propose and dtype in _wd.COMPRESSIBLE \
+                and request_type in (RequestType.ALLREDUCE,
+                                     RequestType.ALLGATHER,
+                                     RequestType.REDUCESCATTER):
             return self._wire_propose
         return _wd.WIRE_NONE
 

@@ -890,35 +890,102 @@ class AttachedReplica:
                        timeout: float = 60.0):
         """-> (version, {name: numpy array}). Root: request + receive
         from the gate; children: receive the relayed frame from their
-        tree parent. Every replica then relays onward."""
-        if self.replica == 0:
-            self._ch.send(wire.serialize_tenant_attach(
-                wire.TENANT_SNAPSHOT_REQ, 0, 0, self.tenant,
-                int(min_version), self.group, "", 0), SERVICE_TAG)
-            tag, frame = self._ch.recv()
-            if tag != SERVICE_TAG:
-                raise ConnectionError(f"unexpected tag {tag}")
-        else:
-            self._listener.settimeout(timeout)
-            sock, _ = self._listener.accept()
-            sock.settimeout(timeout)
-            ch = network.Channel(sock, self._secret)
+        tree parent. Every replica relays onward — children connect
+        FIRST so the native cut-through (hvd_relay_frame, the same
+        chunked relay the hierarchical data plane rides) can stream
+        each chunk downstream while it is still arriving; deep trees
+        then pay one frame time plus depth chunk times instead of
+        depth frame times. Wire byte-identical to the classic
+        recv-then-send leg, which remains the fallback."""
+        kid_chs: List = []
+        try:
+            for kid in self._children():
+                host, port = self.members[kid]
+                kid_chs.append(network.connect(
+                    host, port, self._secret, timeout=timeout,
+                    retry_deadline=timeout))
+            src_owned = None
+            if self.replica == 0:
+                self._ch.send(wire.serialize_tenant_attach(
+                    wire.TENANT_SNAPSHOT_REQ, 0, 0, self.tenant,
+                    int(min_version), self.group, "", 0), SERVICE_TAG)
+                src = self._ch
+            else:
+                self._listener.settimeout(timeout)
+                sock, _ = self._listener.accept()
+                sock.settimeout(timeout)
+                src_owned = network.Channel(sock, self._secret)
+                src = src_owned
             try:
-                tag, frame = ch.recv()
-                if tag != SERVICE_TAG:
-                    raise ConnectionError(f"unexpected tag {tag}")
+                frame = self._relay_recv(src, kid_chs, timeout)
+                if frame is None:  # classic store-and-forward
+                    tag, frame = src.recv()
+                    if tag != SERVICE_TAG:
+                        raise ConnectionError(f"unexpected tag {tag}")
+                    for kid_ch in kid_chs:
+                        kid_ch.send(frame, SERVICE_TAG)
             finally:
-                ch.close()
-        for kid in self._children():
-            host, port = self.members[kid]
-            kid_ch = network.connect(host, port, self._secret,
-                                     timeout=timeout,
-                                     retry_deadline=timeout)
-            try:
-                kid_ch.send(frame, SERVICE_TAG)
-            finally:
-                kid_ch.close()
+                if src_owned is not None:
+                    src_owned.close()
+        finally:
+            for kid_ch in kid_chs:
+                try:
+                    kid_ch.close()
+                except OSError:
+                    pass
         return wire.parse_tenant_snapshot(frame)
+
+    # Cut-through chunk size — matches the hierarchical data plane's
+    # (common/controller.py _RELAY_CHUNK_BYTES rationale).
+    _RELAY_CHUNK_BYTES = 256 * 1024
+    _RELAY_BUF_BYTES = 1 << 20
+
+    def _relay_recv(self, src, kid_chs, timeout: float):
+        """One SERVICE_TAG frame from ``src`` streamed to the
+        pre-connected children chunk-by-chunk (hvd_relay_frame).
+        Returns the payload bytes, or None when the native relay
+        cannot run (no lib / stale pre-reactor .so) — the caller then
+        takes the classic leg. A non-SERVICE_TAG frame is a protocol
+        error on this plane, relayed or not."""
+        from horovod_tpu import native as _native
+        lib = _native.get()
+        if lib is None or not hasattr(lib, "hvd_relay_frame"):
+            return None
+        import ctypes as ct
+        try:
+            src_fd = src.sock.fileno()
+            fds = [ch.sock.fileno() for ch in kid_chs]
+        except OSError:
+            return None
+        kid_fds = (ct.c_int * max(1, len(fds)))(*(fds or [-1]))
+        buf = bytearray(self._RELAY_BUF_BYTES)
+        win = (ct.c_uint8 * len(buf)).from_buffer(buf)
+        secret = self._secret or b""
+        sbuf = (ct.c_uint8 * max(1, len(secret))).from_buffer_copy(
+            secret or b"\x00")
+        out_len = ct.c_int64(0)
+        out_tag = ct.c_uint8(0)
+        spill = ct.POINTER(ct.c_uint8)()
+        rc = lib.hvd_relay_frame(
+            src_fd, kid_fds, len(fds), SERVICE_TAG,
+            ct.addressof(win), len(buf), sbuf, len(secret),
+            None, 0, self._RELAY_CHUNK_BYTES,
+            max(1, int(timeout * 1000)), -1,
+            ct.byref(out_len), ct.byref(out_tag), ct.byref(spill))
+        if rc == 2:
+            # Deviation (absorbed, not relayed): free the bounce and
+            # fail exactly like the classic leg's tag check.
+            if spill:
+                lib.hvd_free(spill)
+            raise ConnectionError(f"unexpected tag {out_tag.value}")
+        if rc == 1:  # relayed, payload spilled past the buffer
+            payload = ct.string_at(spill, out_len.value)
+            lib.hvd_free(spill)
+            return payload
+        if rc == 0:
+            return bytes(buf[:out_len.value])
+        raise ConnectionError(
+            f"snapshot relay failed: errno {-rc}")
 
     def detach(self) -> None:
         """Leave the service plane; the fleet never notices beyond the

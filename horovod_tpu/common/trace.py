@@ -501,12 +501,21 @@ def knobs_digest() -> str:
 
 
 def build_info() -> Dict[str, str]:
-    """{version, native .so hash, armed-knobs digest} — the identity
-    triplet postmortems need to tell WHICH build produced a dump."""
+    """{version, native .so hash, armed-knobs digest, kernel-feature
+    flags} — the identity postmortems need to tell WHICH build
+    produced a dump. ``flags`` decodes hvd_build_flags: bit0 io_uring
+    compiled in (Makefile probe), bit1 io_uring usable at runtime,
+    bit2 MSG_ZEROCOPY compiled in; "none" for a pre-reactor .so."""
     from horovod_tpu import __version__
+    from horovod_tpu import native as _native
+    f = _native.build_flags()
+    names = [name for bit, name in
+             ((1, "io_uring"), (2, "io_uring_rt"), (4, "zerocopy"))
+             if f & bit]
     return {"version": __version__,
             "native": _native_build_hash(),
-            "knobs": knobs_digest()}
+            "knobs": knobs_digest(),
+            "flags": "+".join(names) if names else "none"}
 
 
 # ---------------------------------------------------------------------------

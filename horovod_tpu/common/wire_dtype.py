@@ -113,6 +113,16 @@ def ring_wire(wire: int) -> int:
     return WIRE_BF16 if wire == WIRE_INT8 else wire
 
 
+def allgather_wire(wire: int) -> int:
+    """The wire dtype an ALLGATHER verdict can carry: the gathered
+    world blob is ONE payload whose blocks concatenate byte-for-byte,
+    and per-rank int8 scale headers cannot ride inside a single
+    contiguous buffer, so int8 degrades to bf16 (the cast wires
+    concatenate losslessly). Stamped by the coordinator, so the
+    degrade is world-identical like :func:`ring_wire`'s."""
+    return WIRE_BF16 if wire == WIRE_INT8 else wire
+
+
 def resolve(codes) -> int:
     """The world's common denominator for one tensor's proposals: the
     LEAST aggressive request wins, so a single rank launched with
@@ -212,32 +222,96 @@ def decompress(buf, wire: int, src_np_dtype, count: int) -> np.ndarray:
 
 # -- int8 with error feedback ------------------------------------------
 
-def quantize(arr: np.ndarray) -> np.ndarray:
-    """f32/f64 -> [f32 scale | int8 lanes] as one uint8 buffer. Scale
-    is max|x|/127 (1.0 for an all-zero tensor so dequantize is exact);
-    lanes round to nearest."""
+# Fallback-copy observability hook (hvd_data_copies_total — the SAME
+# counter as socket_ops/runtime by registry name-memoization, attached
+# by SocketBackend.attach_metrics). The numpy codec legs materialize
+# payload-sized temporaries the native codec (hvd_quant8/hvd_dequant8)
+# deletes; ticking them per leg keeps "is the zero-copy plane
+# engaged" an honest single metrics read. None (unattached) records
+# nothing.
+_COPY_METRIC = None
+
+
+def attach_copy_counter(metric) -> None:
+    global _COPY_METRIC
+    _COPY_METRIC = metric
+
+
+def _count_copy() -> None:
+    m = _COPY_METRIC
+    if m is not None:
+        m.inc()
+
+
+def _quantize_numpy(arr: np.ndarray, buf: np.ndarray) -> None:
+    """The numpy codec leg (bit-identical reference of hvd_quant8's
+    plain mode): payload-sized temporaries and all — counted as ONE
+    fallback copy."""
     n = arr.size
     scale = float(np.max(np.abs(arr))) / 127.0 if n else 0.0
     if scale == 0.0:
         scale = 1.0
-    buf = np.empty(_INT8_HDR + n, np.uint8)
     buf[:_INT8_HDR].view(np.float32)[0] = scale
     q = buf[_INT8_HDR:].view(np.int8)
     # two-step on purpose: rint in float, clip, then narrow — a direct
     # int8 cast of an out-of-range float is undefined in numpy
-    tmp = np.rint(arr * (1.0 / scale))
+    tmp = np.rint(arr * (arr.dtype.type(1.0 / scale)))
     np.clip(tmp, -127, 127, out=tmp)
     q[:] = tmp.astype(np.int8)
+    _count_copy()
+
+
+def quantize(arr: np.ndarray) -> np.ndarray:
+    """f32/f64 -> [f32 scale | int8 lanes] as one uint8 buffer. Scale
+    is max|x|/127 (1.0 for an all-zero tensor so dequantize is exact);
+    lanes round to nearest-even. One native pass (hvd_quant8) when the
+    core speaks the dtype — scale scan, scaled round and saturate
+    without a single payload temporary, bit-identical to the numpy
+    leg."""
+    from horovod_tpu import native as _native
+    buf = np.empty(_INT8_HDR + arr.size, np.uint8)
+    if not _native.quant8(arr, buf):
+        _quantize_numpy(np.ascontiguousarray(arr), buf)
+    return buf
+
+
+def quantize_ef(arr: np.ndarray, ef: "ErrorFeedback",
+                key: tuple) -> np.ndarray:
+    """int8 quantize with FUSED error feedback: compensate
+    (arr + residual), scan, quantize and store the next-step residual
+    in one native pass (hvd_quant8 with residual buffers) instead of
+    the apply -> quantize -> update triple and its three payload
+    temporaries. Bit-identical to the classic triple — the fallback
+    IS the classic triple."""
+    from horovod_tpu import native as _native
+    res_in = ef.residual(key, arr)
+    res_out = ef.residual_buffer(key, arr)
+    buf = np.empty(_INT8_HDR + arr.size, np.uint8)
+    if _native.quant8(arr, buf, residual=res_in,
+                      residual_out=res_out):
+        ef.put(key, res_out)
+        return buf
+    comp = ef.apply(key, arr)
+    _quantize_numpy(comp, buf)
+    ef.update(key, comp, buf)
     return buf
 
 
 def dequantize(buf, src_np_dtype, count: int) -> np.ndarray:
-    """[scale|int8] buffer -> fresh src-dtype array."""
+    """[scale|int8] buffer -> fresh src-dtype array. Native single
+    pass (hvd_dequant8) when available; the numpy leg round-trips a
+    payload-sized astype temporary (counted)."""
+    from horovod_tpu import native as _native
+    src_np_dtype = np.dtype(src_np_dtype)
     raw = np.frombuffer(buf, np.uint8, count=_INT8_HDR + count)
+    out = np.empty(count, src_np_dtype)
+    if _native.dequant8(raw, out):
+        return out
     scale = float(raw[:_INT8_HDR].view(np.float32)[0])
     q = raw[_INT8_HDR:].view(np.int8)
-    out = q.astype(np.dtype(src_np_dtype))
-    out *= np.asarray(scale, out.dtype)
+    np.multiply(q.astype(src_np_dtype),
+                np.asarray(scale, src_np_dtype), out=out)
+    _count_copy()
     return out
 
 
@@ -276,6 +350,36 @@ class ErrorFeedback:
             self._residuals.popitem(last=False)
         sent = dequantize(qbuf, compensated.dtype, compensated.size)
         self._residuals[key] = compensated - sent
+        self._residuals.move_to_end(key)
+
+    # -- fused native entry (quantize_ef / hvd_quant8) -----------------
+    def residual(self, key: tuple, arr: np.ndarray):
+        """The stored residual when it can feed the native fused pass
+        directly (same lane count AND dtype — a mismatch starts a
+        fresh compensation chain, exactly like apply's size check)."""
+        r = self._residuals.get(key)
+        if r is None or r.size != arr.size or r.dtype != arr.dtype:
+            return None
+        return r
+
+    def residual_buffer(self, key: tuple, arr: np.ndarray) -> np.ndarray:
+        """Destination for the fused pass's next-step residual. The
+        stored residual itself when it matches — hvd_quant8 reads lane
+        i before overwriting it, so aliasing residual/residual_out is
+        safe and saves the allocation — else a fresh buffer."""
+        r = self._residuals.get(key)
+        if r is not None and r.size == arr.size \
+                and r.dtype == arr.dtype:
+            return r
+        return np.empty(arr.size, arr.dtype)
+
+    def put(self, key: tuple, residual: np.ndarray) -> None:
+        """Store a residual computed by the fused native pass (the
+        update() twin without the dequantize round-trip)."""
+        if key not in self._residuals \
+                and len(self._residuals) >= self._CAP:
+            self._residuals.popitem(last=False)
+        self._residuals[key] = residual
         self._residuals.move_to_end(key)
 
     def drop(self, key: tuple) -> None:

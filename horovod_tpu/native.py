@@ -196,6 +196,48 @@ def _configure(lib: ctypes.CDLL) -> None:
         ON_IDLE_FUNC,
         u8p, ctypes.POINTER(ctypes.c_double),
         ctypes.POINTER(ctypes.c_int), u8pp, i64p, u8p]
+    try:
+        # Stale-.so tolerance (see get()): a pre-reactor library lacks
+        # the batched/zerocopy/relay/codec entries; the wrappers below
+        # and the controller fast paths then report unavailable and the
+        # callers run the sequential/classic/numpy code, wire-identical.
+        lib.hvd_gather_frames_batched.restype = ctypes.c_int
+        lib.hvd_gather_frames_batched.argtypes = [
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+            u8p, ctypes.c_int,
+            ctypes.c_uint8, vpp,
+            i64p, i64p,
+            u8p, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int,
+            ON_IDLE_FUNC,
+            u8p, ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int), u8pp, i64p, u8p]
+        lib.hvd_sendv_zc.restype = ctypes.c_int
+        lib.hvd_sendv_zc.argtypes = [
+            ctypes.c_int, ctypes.c_uint8, vpp, i64p, ctypes.c_int,
+            u8p, ctypes.c_int,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+        lib.hvd_relay_frame.restype = ctypes.c_int
+        lib.hvd_relay_frame.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+            ctypes.c_uint8, ctypes.c_void_p, ctypes.c_int64,
+            u8p, ctypes.c_int,
+            u8p, ctypes.c_int,
+            ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+            i64p, u8p, u8pp]
+        lib.hvd_build_flags.restype = ctypes.c_int
+        lib.hvd_build_flags.argtypes = []
+        lib.hvd_quant8.restype = ctypes.c_int
+        lib.hvd_quant8.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_void_p, u8p]
+        lib.hvd_dequant8.restype = ctypes.c_int
+        lib.hvd_dequant8.argtypes = [
+            u8p, ctypes.c_int64, ctypes.c_int, ctypes.c_void_p]
+    except AttributeError:
+        pass
 
 
 def get() -> Optional[ctypes.CDLL]:
@@ -364,3 +406,75 @@ def sum_into(acc, src) -> bool:
         src.ctypes.data_as(ctypes.c_void_p),
         acc.size, code)
     return rc == 0
+
+
+# int8-codec dtype codes (hvd_quant8/hvd_dequant8's third argument).
+_QUANT_CODES = {"float32": 0, "float64": 1}
+
+
+def quant8(src, out, residual=None, residual_out=None) -> bool:
+    """Quantize ``src`` (f32/f64) into the int8 wire layout in ``out``
+    (uint8, 4 + src.size bytes) with the native kernel: scale scan,
+    saturating round-half-even and the error-feedback residual update
+    fused into one pass, bit-identical to the numpy reference in
+    common/wire_dtype.py. ``residual`` is added lane-wise before
+    quantizing and ``residual_out`` (may alias ``residual``) receives
+    the post-quantization error. Returns False when the native path
+    cannot serve this call (caller falls back to numpy)."""
+    lib = get()
+    if lib is None or not hasattr(lib, "hvd_quant8"):
+        return False
+    code = _QUANT_CODES.get(str(src.dtype))
+    if code is None or not src.flags["C_CONTIGUOUS"] \
+            or not out.flags["C_CONTIGUOUS"] \
+            or out.dtype.itemsize != 1 or out.nbytes != 4 + src.size:
+        return False
+    res_p = None
+    res_out_p = None
+    if residual is not None:
+        if residual.dtype != src.dtype or residual.size != src.size \
+                or not residual.flags["C_CONTIGUOUS"] \
+                or residual_out is None:
+            return False
+        res_p = ctypes.c_void_p(residual.ctypes.data)
+    if residual_out is not None:
+        if residual_out.dtype != src.dtype \
+                or residual_out.size != src.size \
+                or not residual_out.flags["C_CONTIGUOUS"]:
+            return False
+        res_out_p = ctypes.c_void_p(residual_out.ctypes.data)
+    rc = lib.hvd_quant8(
+        ctypes.c_void_p(src.ctypes.data), src.size, code,
+        res_p, res_out_p,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return rc == 0
+
+
+def dequant8(raw, out) -> bool:
+    """Expand the int8 wire layout in ``raw`` (uint8, 4 + out.size
+    bytes) into ``out`` (f32/f64) with the native kernel — the numpy
+    astype/multiply round-trip collapsed into one pass, bit-identical.
+    Returns False when the native path cannot serve this call."""
+    lib = get()
+    if lib is None or not hasattr(lib, "hvd_dequant8"):
+        return False
+    code = _QUANT_CODES.get(str(out.dtype))
+    if code is None or not raw.flags["C_CONTIGUOUS"] \
+            or not out.flags["C_CONTIGUOUS"] \
+            or raw.dtype.itemsize != 1 or raw.nbytes < 4 + out.size:
+        return False
+    rc = lib.hvd_dequant8(
+        raw.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.size, code, ctypes.c_void_p(out.ctypes.data))
+    return rc == 0
+
+
+def build_flags() -> int:
+    """Capability bitmask of the loaded core (hvd_build_flags): bit 0
+    io_uring compiled in, bit 1 the running kernel accepts it, bit 2
+    MSG_ZEROCOPY sends compiled in. 0 when the native core (or a stale
+    pre-reactor .so) does not export the symbol."""
+    lib = get()
+    if lib is None or not hasattr(lib, "hvd_build_flags"):
+        return 0
+    return int(lib.hvd_build_flags())

@@ -82,11 +82,12 @@ def compress_send_payload(arr: np.ndarray, wire: int, ef=None,
         arr.nbytes,
         _wd.compressed_nbytes(wire, arr.size, arr.dtype.itemsize))
     if wire == _wd.WIRE_INT8:
-        comp = ef.apply(key, arr) if ef is not None else arr
-        qbuf = _wd.quantize(comp)
         if ef is not None:
-            ef.update(key, comp, qbuf)
-        return qbuf
+            # Fused native pass: compensate + quantize + next-step
+            # residual in one sweep (falls back to the classic
+            # apply -> quantize -> update triple, bit-identically).
+            return _wd.quantize_ef(arr, ef, key)
+        return _wd.quantize(arr)
     if out is not None:
         _wd.cast_into(arr, out)
         return out
@@ -291,6 +292,10 @@ class SocketBackend(CollectiveBackend):
             "hvd_compression_ratio",
             "wire bytes / uncompressed bytes per compressed payload",
             RATIO_BUCKETS)
+        # The int8 codec's numpy fallback legs tick the same copy
+        # counter from inside wire_dtype (the native codec ticks
+        # nothing — that's the point).
+        _wd.attach_copy_counter(_COPY_METRIC)
 
     def fused_cycle_reducible(self, nbytes: int) -> bool:
         """Star-bound batches (below the ring threshold) already move
@@ -513,7 +518,11 @@ class SocketBackend(CollectiveBackend):
             packed = _pack_flat(
                 arrays, self._arena if (self._zero_copy and multi)
                 else None)
-        if self._zero_copy:
+        wire = response.wire_dtype
+        if wire != _wd.WIRE_NONE:
+            result = self._compressed_allgather(packed, wire,
+                                                rank_counts)
+        elif self._zero_copy:
             # Gather straight into the rank-major result: peer r's
             # block IS result[off_r : off_r + n_r], so the gathered
             # world buffer is assembled with zero intermediate copies.
@@ -535,6 +544,7 @@ class SocketBackend(CollectiveBackend):
         else:
             gathered = ctl.gather_data(packed)
             if gathered is not None:
+                _COPY_METRIC.inc()  # world-blob join (fallback tier)
                 blob = b"".join(gathered)
                 result = _np_from_bytes(ctl.broadcast_data(blob),
                                         packed.dtype)
@@ -545,6 +555,58 @@ class SocketBackend(CollectiveBackend):
             _unpack_allgather(entries, arrays, result, comp,
                               rank_counts)
         return Status.OK()
+
+    def _compressed_allgather(self, packed: np.ndarray, wire: int,
+                              rank_counts) -> np.ndarray:
+        """Allgather with the negotiated CAST wire on the world
+        exchange: every rank ships its block at wire width, the
+        gathered world blob moves and broadcasts at wire width, and
+        each rank decompresses ONCE into the full-dtype result the
+        unpack may alias. int8 never reaches here — the coordinator's
+        verdict degrades it to bf16 (wire_dtype.allgather_wire)
+        because a concatenated blob cannot carry per-rank scales."""
+        ctl = self._ctl
+        src_dtype = packed.dtype
+        np_wire = _wd.wire_np_dtype(wire)
+        total = sum(rank_counts)
+        warr = compress_send_payload(
+            packed, wire,
+            out=self._wire_arena.typed(0, np_wire, packed.size)
+            if self._zero_copy else None)
+        if self._zero_copy:
+            wres = np.empty(total, np_wire)
+            offs = [0] * ctl.size
+            for r in range(1, ctl.size):
+                offs[r] = offs[r - 1] + rank_counts[r - 1]
+            if ctl.is_coordinator:
+                # Peers land straight in their rank-major windows of
+                # the wire result; nothing is ever re-assembled.
+                outs = [None] * ctl.size
+                for r in range(1, ctl.size):
+                    outs[r] = wres[offs[r]:offs[r] + rank_counts[r]]
+                ctl.gather_data_into(warr, outs)
+                wres[:rank_counts[0]] = warr
+                ctl.broadcast_data(wres)
+            else:
+                ctl.gather_data_into(warr, None)
+                ctl.broadcast_data_into(None, wres)
+            return _wd.decompress(wres, wire, src_dtype, total)
+        gathered = ctl.gather_data(warr)
+        if gathered is not None:
+            wres = np.empty(total, np_wire)
+            pos = 0
+            for r, g in enumerate(gathered):
+                n = rank_counts[r]
+                src = g if isinstance(g, np.ndarray) \
+                    else np.frombuffer(g, np_wire, count=n)
+                wres[pos:pos + n] = src
+                pos += n
+            _COPY_METRIC.inc()  # store-and-forward re-assembly
+            ctl.broadcast_data(wres)
+            return _wd.decompress(wres, wire, src_dtype, total)
+        return _wd.decompress(
+            _np_from_bytes(ctl.broadcast_data(None), np_wire),
+            wire, src_dtype, total)
 
     # -- broadcast -------------------------------------------------------
     def execute_broadcast(self, entries, response: Response) -> Status:
@@ -570,6 +632,7 @@ class SocketBackend(CollectiveBackend):
                                     result.reshape(orig.shape))
             return Status.OK()
         if ctl.rank == entry.root_rank:
+            _COPY_METRIC.inc()  # send-side tobytes (fallback tier)
             data = ctl.broadcast_data(arr.tobytes(),
                                       root_rank=entry.root_rank)
         else:
@@ -605,6 +668,7 @@ class SocketBackend(CollectiveBackend):
                 ctl.scatter_data_into(None, result)
             entry.output = _restore(entry, result.reshape(arr.shape))
             return Status.OK()
+        _COPY_METRIC.inc()  # send-side tobytes (fallback tier)
         gathered = ctl.gather_data(arr.tobytes())
         if gathered is not None:
             mats = [np.frombuffer(g, dtype=arr.dtype).reshape(arr.shape)
@@ -615,6 +679,7 @@ class SocketBackend(CollectiveBackend):
             for d in range(size):
                 block = np.concatenate(
                     [m[d * per_rank:(d + 1) * per_rank] for m in mats])
+                _COPY_METRIC.inc()  # per-destination tobytes
                 payloads.append(block.tobytes())
             data = ctl.scatter_data(payloads)
         else:
@@ -634,13 +699,38 @@ class SocketBackend(CollectiveBackend):
             fresh = True
         size = ctl.size
         per_rank = arr.shape[0] // size
+        row = int(np.prod(arr.shape[1:], dtype=np.int64)) \
+            if arr.ndim > 1 else 1
+        per_elems = per_rank * row
+        # Routing by UNCOMPRESSED bytes, like allreduce — the wire
+        # dtype must not flip the route.
+        wire = response.wire_dtype
         ring = self._ring_for(arr.nbytes) \
             if arr.shape[0] % size == 0 else None
         if ring is not None:
-            flat = arr.reshape(-1)
-            buf = flat if (fresh and flat.flags.writeable) \
-                else flat.copy()
-            result = ring.reduce_scatter_(buf).reshape(
+            if wire != _wd.WIRE_NONE:
+                # Ring legs sum link-by-link, so int8 degrades to bf16
+                # (ring_wire) and the reduction happens IN the wire
+                # dtype — the compressed-allreduce ring discipline.
+                rw = _wd.ring_wire(wire)
+                wbuf = compress_send_payload(arr.reshape(-1), rw)
+                result = _wd.decompress(
+                    ring.reduce_scatter_(wbuf), rw, arr.dtype,
+                    per_elems).reshape((per_rank,) + arr.shape[1:])
+            else:
+                flat = arr.reshape(-1)
+                buf = flat if (fresh and flat.flags.writeable) \
+                    else flat.copy()
+                result = ring.reduce_scatter_(buf).reshape(
+                    (per_rank,) + arr.shape[1:])
+            if response.postscale_factor != 1.0:
+                result = result * np.asarray(response.postscale_factor,
+                                             arr.dtype)
+            entry.output = _restore(entry, result)
+            return Status.OK()
+        if wire != _wd.WIRE_NONE:
+            result = self._compressed_reducescatter(
+                arr, wire, per_elems).reshape(
                 (per_rank,) + arr.shape[1:])
             if response.postscale_factor != 1.0:
                 result = result * np.asarray(response.postscale_factor,
@@ -648,8 +738,6 @@ class SocketBackend(CollectiveBackend):
             entry.output = _restore(entry, result)
             return Status.OK()
         if self._zero_copy:
-            row = int(np.prod(arr.shape[1:], dtype=np.int64)) \
-                if arr.ndim > 1 else 1
             if ctl.is_coordinator:
                 outs = [None] * size
                 for r in range(1, size):
@@ -677,14 +765,111 @@ class SocketBackend(CollectiveBackend):
             entry.output = _restore(
                 entry, result.reshape((per_rank,) + arr.shape[1:]))
             return Status.OK()
+
+    def _compressed_reducescatter(self, arr: np.ndarray, wire: int,
+                                  per_elems: int) -> np.ndarray:
+        """Reducescatter star with the negotiated wire dtype on every
+        leg, returning this rank's FLAT full-dtype slice (fresh —
+        postscale/outputs may alias it). Cast wires accumulate IN the
+        wire dtype, exactly like _compressed_allreduce. int8 keeps
+        full aggressiveness here — unlike a ring link, the star's
+        coordinator can dequantize each rank's payload with ITS OWN
+        scale into a full-precision accumulator and requantize each
+        OUTPUT slice with a fresh scale, so per-rank scales never
+        mix. No error feedback: the output is a world-reduced slice,
+        not this rank's next-step gradient, so there is no residual
+        chain to compensate."""
+        ctl = self._ctl
+        size = ctl.size
+        src_dtype = arr.dtype
+        flat = arr.reshape(-1)
+        count = flat.size
+        wire_nbytes = _wd.compressed_nbytes(wire, count,
+                                            src_dtype.itemsize)
+        slice_nbytes = _wd.compressed_nbytes(wire, per_elems,
+                                             src_dtype.itemsize)
+
+        if wire == _wd.WIRE_INT8:
+            qbuf = compress_send_payload(flat, wire)
+            if ctl.is_coordinator:
+                if self._zero_copy:
+                    outs = [None] * size
+                    for r in range(1, size):
+                        outs[r] = self._gather_arena.typed(
+                            (r - 1) * wire_nbytes, np.uint8,
+                            wire_nbytes)
+                    ctl.gather_data_into(qbuf, outs)
+                    peers = outs[1:]
+                else:
+                    peers = ctl.gather_data(qbuf)[1:]
+                acc = _wd.dequantize(qbuf, src_dtype, count)
+                for p in peers:
+                    acc += _wd.dequantize(p, src_dtype, count)
+                # Every slice — the coordinator's own included — rides
+                # through the codec, so all ranks' outputs carry the
+                # same quantization treatment.
+                payloads = [
+                    _wd.quantize(acc[d * per_elems:(d + 1) * per_elems])
+                    for d in range(size)]
+                if self._zero_copy:
+                    ctl.scatter_data_into(payloads, None)
+                    rbuf = payloads[0]
+                else:
+                    rbuf = ctl.scatter_data(payloads)
+                return _wd.dequantize(rbuf, src_dtype, per_elems)
+            if self._zero_copy:
+                ctl.gather_data_into(qbuf, None)
+                rbuf = np.empty(slice_nbytes, np.uint8)
+                ctl.scatter_data_into(None, rbuf)
+            else:
+                ctl.gather_data(qbuf)
+                rbuf = ctl.scatter_data(None)
+            return _wd.dequantize(rbuf, src_dtype, per_elems)
+
+        np_wire = _wd.wire_np_dtype(wire)
+        warr = compress_send_payload(
+            flat, wire,
+            out=self._wire_arena.typed(0, np_wire, count)
+            if self._zero_copy else None)
+        if ctl.is_coordinator:
+            acc = np.array(warr, copy=True)
+            if self._zero_copy:
+                outs = [None] * size
+                for r in range(1, size):
+                    outs[r] = self._gather_arena.typed(
+                        (r - 1) * wire_nbytes, np_wire, count)
+                ctl.gather_data_into(warr, outs)
+                peers = outs[1:]
+            else:
+                peers = ctl.gather_data(warr)[1:]
+            _wd.reduce_wire(acc, peers, wire, src_dtype, count)
+            slices = [acc[d * per_elems:(d + 1) * per_elems]
+                      for d in range(size)]
+            if self._zero_copy:
+                ctl.scatter_data_into(slices, None)
+            else:
+                ctl.scatter_data(slices)
+            return _wd.decompress(slices[0], wire, src_dtype,
+                                  per_elems)
+        if self._zero_copy:
+            ctl.gather_data_into(warr, None)
+            wsl = np.empty(per_elems, np_wire)
+            ctl.scatter_data_into(None, wsl)
+        else:
+            ctl.gather_data(warr)
+            wsl = ctl.scatter_data(None)
+        return _wd.decompress(wsl, wire, src_dtype, per_elems)
+        _COPY_METRIC.inc()  # send-side tobytes (fallback tier)
         gathered = ctl.gather_data(arr.tobytes())
         if gathered is not None:
+            _COPY_METRIC.inc()  # writable accumulator materialization
             acc = np.frombuffer(bytearray(gathered[0]), dtype=arr.dtype)
             for data in gathered[1:]:
                 src = np.frombuffer(data, dtype=arr.dtype)
                 if not _native.sum_into(acc, src):
                     acc += src
             acc = acc.reshape(arr.shape)
+            _COPY_METRIC.inc(size)  # per-slice tobytes
             payloads = [acc[d * per_rank:(d + 1) * per_rank].tobytes()
                         for d in range(size)]
             data = ctl.scatter_data(payloads)

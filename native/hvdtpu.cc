@@ -14,6 +14,7 @@
 #include "hvdtpu.h"
 
 #include <cerrno>
+#include <cmath>
 #include <cstdlib>
 #include <cstring>
 #include <ctime>
@@ -22,6 +23,17 @@
 #include <sys/uio.h>
 #include <unistd.h>
 
+// <linux/errqueue.h> needs struct timespec / sockaddr complete, so it
+// must follow <ctime> and <sys/socket.h> (MSG_ZEROCOPY completions).
+#include <linux/errqueue.h>
+
+#ifdef HVD_HAVE_IO_URING
+#include <linux/io_uring.h>
+#include <sys/mman.h>
+#include <sys/syscall.h>
+#endif
+
+#include <atomic>
 #include <vector>
 
 // ---------------------------------------------------------------------
@@ -1121,6 +1133,842 @@ int hvd_steady_coord(const int* fds, int n, uint8_t req_tag,
     if (rc) return rc;
   }
   return 0;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------
+// Batched-submission reactor + MSG_ZEROCOPY sends + int8 codec + relay
+// ---------------------------------------------------------------------
+
+// Older toolchain headers may predate these; the kernel ABI values are
+// stable, so define the fallbacks and let the runtime decide.
+#ifndef SO_ZEROCOPY
+#define SO_ZEROCOPY 60
+#endif
+#ifndef MSG_ZEROCOPY
+#define MSG_ZEROCOPY 0x4000000
+#endif
+#ifndef SO_EE_ORIGIN_ZEROCOPY
+#define SO_EE_ORIGIN_ZEROCOPY 5
+#endif
+#ifndef SO_EE_CODE_ZEROCOPY_COPIED
+#define SO_EE_CODE_ZEROCOPY_COPIED 1
+#endif
+
+namespace {
+
+#ifdef HVD_HAVE_IO_URING
+
+// Minimal raw-syscall io_uring wrapper (no liburing in the image).
+// The ring is CACHED per thread (see gather_ring() below): setup is
+// io_uring_setup + three MAP_POPULATE mmaps — hundreds of
+// microseconds, which a per-call ring would charge to EVERY steady
+// cycle, more than the batching saves on small worlds. Reuse means a
+// returning call may leave one-shot POLL_ADDs (and an interval timer)
+// pending; rather than tearing the ring down to cancel them, every
+// call stamps its submissions with a generation counter in the high
+// user_data bits and later calls drop stale completions on sight — a
+// stale POLL_ADD only ever reported readiness, it never consumed
+// bytes, so dropping it is free. The ring carries READINESS only
+// (IORING_OP_POLL_ADD): the bytes are then read by the same frame
+// loop the poll(2) backend uses, so both backends are byte-identical
+// on the wire by construction.
+struct UringReactor {
+  int ring_fd = -1;
+  unsigned sq_entries = 0;
+  unsigned* sq_head = nullptr;
+  unsigned* sq_tail = nullptr;
+  unsigned* sq_mask = nullptr;
+  unsigned* sq_array = nullptr;
+  io_uring_sqe* sqes = nullptr;
+  unsigned* cq_head = nullptr;
+  unsigned* cq_tail = nullptr;
+  unsigned* cq_mask = nullptr;
+  io_uring_cqe* cqes = nullptr;
+  void* sq_ptr = nullptr;
+  size_t sq_len = 0;
+  void* cq_ptr = nullptr;  // null when IORING_FEAT_SINGLE_MMAP
+  size_t cq_len = 0;
+  void* sqe_ptr = nullptr;
+  size_t sqe_len = 0;
+
+  ~UringReactor() { shutdown(); }
+
+  void shutdown() {
+    if (sqe_ptr) munmap(sqe_ptr, sqe_len);
+    if (cq_ptr) munmap(cq_ptr, cq_len);
+    if (sq_ptr) munmap(sq_ptr, sq_len);
+    sq_ptr = cq_ptr = sqe_ptr = nullptr;
+    if (ring_fd >= 0) ::close(ring_fd);
+    ring_fd = -1;
+  }
+
+  bool init(unsigned want) {
+    unsigned entries = 4;
+    while (entries < want && entries < 4096) entries <<= 1;
+    struct io_uring_params p;
+    memset(&p, 0, sizeof(p));
+    long fd = ::syscall(SYS_io_uring_setup, entries, &p);
+    if (fd < 0) return false;
+    ring_fd = int(fd);
+    sq_len = p.sq_off.array + p.sq_entries * sizeof(unsigned);
+    cq_len = p.cq_off.cqes + p.cq_entries * sizeof(io_uring_cqe);
+    bool single = (p.features & IORING_FEAT_SINGLE_MMAP) != 0;
+    if (single && cq_len > sq_len) sq_len = cq_len;
+    void* m = mmap(nullptr, sq_len, PROT_READ | PROT_WRITE,
+                   MAP_SHARED | MAP_POPULATE, ring_fd,
+                   IORING_OFF_SQ_RING);
+    if (m == MAP_FAILED) { shutdown(); return false; }
+    sq_ptr = m;
+    uint8_t* cqbase = static_cast<uint8_t*>(sq_ptr);
+    if (!single) {
+      m = mmap(nullptr, cq_len, PROT_READ | PROT_WRITE,
+               MAP_SHARED | MAP_POPULATE, ring_fd, IORING_OFF_CQ_RING);
+      if (m == MAP_FAILED) { shutdown(); return false; }
+      cq_ptr = m;
+      cqbase = static_cast<uint8_t*>(cq_ptr);
+    }
+    sqe_len = p.sq_entries * sizeof(io_uring_sqe);
+    m = mmap(nullptr, sqe_len, PROT_READ | PROT_WRITE,
+             MAP_SHARED | MAP_POPULATE, ring_fd, IORING_OFF_SQES);
+    if (m == MAP_FAILED) { shutdown(); return false; }
+    sqe_ptr = m;
+    uint8_t* sqbase = static_cast<uint8_t*>(sq_ptr);
+    sq_head = reinterpret_cast<unsigned*>(sqbase + p.sq_off.head);
+    sq_tail = reinterpret_cast<unsigned*>(sqbase + p.sq_off.tail);
+    sq_mask = reinterpret_cast<unsigned*>(sqbase + p.sq_off.ring_mask);
+    sq_array = reinterpret_cast<unsigned*>(sqbase + p.sq_off.array);
+    sqes = static_cast<io_uring_sqe*>(sqe_ptr);
+    cq_head = reinterpret_cast<unsigned*>(cqbase + p.cq_off.head);
+    cq_tail = reinterpret_cast<unsigned*>(cqbase + p.cq_off.tail);
+    cq_mask = reinterpret_cast<unsigned*>(cqbase + p.cq_off.ring_mask);
+    cqes = reinterpret_cast<io_uring_cqe*>(cqbase + p.cq_off.cqes);
+    sq_entries = p.sq_entries;
+    return true;
+  }
+
+  io_uring_sqe* get_sqe() {
+    unsigned head = __atomic_load_n(sq_head, __ATOMIC_ACQUIRE);
+    unsigned tail = *sq_tail;  // single submitter: plain read is ours
+    if (tail - head >= sq_entries) return nullptr;
+    unsigned idx = tail & *sq_mask;
+    io_uring_sqe* sqe = &sqes[idx];
+    memset(sqe, 0, sizeof(*sqe));
+    sq_array[idx] = idx;
+    __atomic_store_n(sq_tail, tail + 1, __ATOMIC_RELEASE);
+    return sqe;
+  }
+
+  int enter(unsigned to_submit, unsigned wait_nr) {
+    for (;;) {
+      long rc = ::syscall(SYS_io_uring_enter, ring_fd, to_submit,
+                          wait_nr, wait_nr ? IORING_ENTER_GETEVENTS : 0u,
+                          nullptr, 0);
+      if (rc >= 0) return int(rc);
+      // EINTR: the kernel clamps to_submit to what is actually staged,
+      // so re-entering with the same count cannot double-consume.
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+  }
+
+  bool pop(io_uring_cqe* out) {
+    unsigned head = *cq_head;  // single consumer
+    unsigned tail = __atomic_load_n(cq_tail, __ATOMIC_ACQUIRE);
+    if (head == tail) return false;
+    *out = cqes[head & *cq_mask];
+    __atomic_store_n(cq_head, head + 1, __ATOMIC_RELEASE);
+    return true;
+  }
+};
+
+// Runtime probe, cached per process: io_uring may be compiled in yet
+// rejected by the running kernel (ENOSYS, seccomp EPERM, sysctl
+// io_uring_disabled). HOROVOD_TPU_IOURING=0 forces the poll backend —
+// the runtime-fallback knob the fault tests and bench exercise
+// without needing an io_uring-less kernel.
+bool io_uring_available() {
+  static std::atomic<int> cached{0};
+  int c = cached.load(std::memory_order_relaxed);
+  if (c != 0) return c > 0;
+  bool ok = true;
+  const char* e = getenv("HOROVOD_TPU_IOURING");
+  if (e && e[0] == '0' && e[1] == '\0') ok = false;
+  if (ok) {
+    UringReactor probe;
+    ok = probe.init(4);
+  }
+  cached.store(ok ? 1 : -1, std::memory_order_relaxed);
+  return ok;
+}
+
+// Per-thread cached ring + generation counter. Gathers run on one
+// controller thread, but thread_local keeps any future caller honest
+// (a ring is single-submitter by construction here). The destructor
+// closes the ring fd at thread exit. A call that needs more entries
+// than the cached ring holds re-initializes it — the kernel cancels
+// the old ring's pending requests when its fd closes.
+struct GatherRing {
+  UringReactor ring;
+  uint64_t gen = 0;
+  bool live = false;
+};
+
+GatherRing& gather_ring() {
+  static thread_local GatherRing gr;
+  return gr;
+}
+
+#endif  // HVD_HAVE_IO_URING
+
+struct GatherCtx {
+  const int* fds;
+  int n;
+  const uint8_t* secret;
+  int secret_len;
+  uint8_t want_tag;
+  void* const* bufs;
+  const int64_t* caps;
+  int64_t* lens;
+  const uint8_t* skip_tags;
+  int nskip;
+  Deadline dl;
+  uint8_t* done;
+  double* arrive;
+  int32_t* batch_sizes;
+  int* nbatches;
+  int* dev_idx;
+  uint8_t** dev_buf;
+  int64_t* dev_len;
+  uint8_t* dev_tag;
+  int remaining;
+};
+
+// Read frames off one readable peer until its DATA frame lands or a
+// tolerated stray is drained. A stray (PING) returns to the readiness
+// loop instead of camping on this peer — its DATA bytes may not have
+// arrived yet and a blocking read here would re-serialize the gather.
+// Returns 0 (check *got_data), 1 on deviation (dev_* filled), or
+// negative errno.
+int gather_read_one(GatherCtx& c, int i, bool* got_data) {
+  *got_data = false;
+  int fd = c.fds[i];
+  uint8_t hdr[5];
+  int rc = dl_read(fd, hdr, 5, &c.dl);
+  if (rc) return rc;
+  uint32_t plen;
+  memcpy(&plen, hdr, 4);
+  uint8_t tag = hdr[4];
+  uint8_t digest[32];
+  if (c.secret_len > 0) {
+    rc = dl_read(fd, digest, 32, &c.dl);
+    if (rc) return rc;
+  }
+  if (tag == c.want_tag && int64_t(plen) <= c.caps[i]) {
+    uint8_t* dst = static_cast<uint8_t*>(c.bufs[i]);
+    rc = dl_read(fd, dst, plen, &c.dl);
+    if (rc) return rc;
+    if (c.secret_len > 0) {
+      uint8_t expect[32];
+      hmac_sha256(c.secret, size_t(c.secret_len), &tag, dst, plen,
+                  expect);
+      if (!digest_eq(digest, expect)) return -EBADMSG;
+    }
+    c.lens[i] = int64_t(plen);
+    *got_data = true;
+    return 0;
+  }
+  uint8_t* bounce = nullptr;
+  rc = drain_frame(fd, plen, nullptr, 0, tag, c.secret, c.secret_len,
+                   digest, &c.dl, &bounce);
+  if (rc) return rc;
+  if (tag_in(tag, c.skip_tags, c.nskip)) {
+    free(bounce);
+    return 0;
+  }
+  // Deviation: out-of-band (METRICS/TRACE/ABORT), wrong tag, or a
+  // want_tag payload overflowing caps[i]. Python absorbs the frame and
+  // re-enters with done[] intact.
+  *c.dev_idx = i;
+  *c.dev_buf = bounce;
+  *c.dev_len = int64_t(plen);
+  *c.dev_tag = tag;
+  return 1;
+}
+
+int gather_on_ready(GatherCtx& c, int i, int* completed) {
+  bool got = false;
+  int rc = gather_read_one(c, i, &got);
+  if (rc < 0) { *c.dev_idx = i; return rc; }
+  if (rc == 1) return 1;
+  if (got) {
+    c.done[i] = 1;
+    c.remaining--;
+    if (c.arrive) c.arrive[i] = now_mono();
+    (*completed)++;
+  }
+  return 0;
+}
+
+void gather_note_batch(GatherCtx& c, int completed) {
+  if (completed <= 0) return;
+  c.dl.idle_ms = 0;
+  if (c.batch_sizes && c.nbatches && *c.nbatches < c.n)
+    c.batch_sizes[(*c.nbatches)++] = completed;
+}
+
+int gather_loop_poll(GatherCtx& c) {
+  std::vector<struct pollfd> pfs(size_t(c.n));
+  std::vector<int> who(size_t(c.n));
+  while (c.remaining > 0) {
+    int np = 0;
+    for (int i = 0; i < c.n; i++) {
+      if (c.done[i]) continue;
+      pfs[size_t(np)].fd = c.fds[i];
+      pfs[size_t(np)].events = POLLIN;
+      pfs[size_t(np)].revents = 0;
+      who[size_t(np)] = i;
+      np++;
+    }
+    int rc = ::poll(pfs.data(), nfds_t(np),
+                    c.dl.timeout_ms >= 0 ? c.dl.interval_ms : -1);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      *c.dev_idx = -1;
+      return -errno;
+    }
+    if (rc == 0) {  // idle slice across EVERY pending peer
+      if (c.dl.on_idle) c.dl.on_idle();
+      c.dl.idle_ms += c.dl.interval_ms;
+      if (c.dl.idle_ms >= c.dl.timeout_ms) {
+        *c.dev_idx = -1;
+        return -ETIMEDOUT;
+      }
+      continue;
+    }
+    int completed = 0;
+    for (int k = 0; k < np; k++) {
+      if (!(pfs[size_t(k)].revents & (POLLIN | POLLERR | POLLHUP)))
+        continue;
+      rc = gather_on_ready(c, who[size_t(k)], &completed);
+      if (rc) { gather_note_batch(c, completed); return rc; }
+    }
+    gather_note_batch(c, completed);
+  }
+  return 0;
+}
+
+#ifdef HVD_HAVE_IO_URING
+
+int gather_loop_uring(GatherCtx& c) {
+  GatherRing& gr = gather_ring();
+  if (gr.live && gr.ring.sq_entries < unsigned(c.n) + 2) {
+    gr.ring.shutdown();  // cancels the old ring's pending requests
+    gr.live = false;
+  }
+  if (!gr.live) {
+    if (!gr.ring.init(unsigned(c.n) + 2)) return gather_loop_poll(c);
+    gr.live = true;
+  }
+  UringReactor& ring = gr.ring;
+  // Generation stamp: high 32 bits of user_data. Completions from a
+  // PREVIOUS call's leftover POLL_ADDs/timer (timeout or deviation
+  // return left them pending) carry an older stamp and are dropped —
+  // in particular a stale timer must not tick THIS call's idle clock
+  // or clear its timer_armed state.
+  const uint64_t gen = ++gr.gen;
+  const uint64_t gen_hi = gen << 32;
+  const uint32_t timer_lo = ~uint32_t(0);
+  std::vector<uint8_t> armed(size_t(c.n), 0);
+  bool timer_armed = false;
+  struct __kernel_timespec ts;
+  while (c.remaining > 0) {
+    unsigned to_submit = 0;
+    for (int i = 0; i < c.n; i++) {
+      if (c.done[i] || armed[size_t(i)]) continue;
+      io_uring_sqe* sqe = ring.get_sqe();
+      if (!sqe) break;  // ring momentarily full: submit, re-arm later
+      sqe->opcode = IORING_OP_POLL_ADD;
+      sqe->fd = c.fds[i];
+      sqe->poll_events = POLLIN | POLLERR | POLLHUP;
+      sqe->user_data = gen_hi | uint32_t(i);
+      armed[size_t(i)] = 1;
+      to_submit++;
+    }
+    // One interval timer at a time: it both bounds the wait (idle
+    // slice accounting, on_idle fan-out) and keeps stale timers from
+    // double-counting silence. The kernel copies the timespec during
+    // submission, so the stack slot may be reused the moment enter()
+    // returns.
+    if (c.dl.timeout_ms >= 0 && !timer_armed) {
+      io_uring_sqe* sqe = ring.get_sqe();
+      if (sqe) {
+        ts.tv_sec = c.dl.interval_ms / 1000;
+        ts.tv_nsec = int64_t(c.dl.interval_ms % 1000) * 1000000;
+        sqe->opcode = IORING_OP_TIMEOUT;
+        sqe->addr = uint64_t(uintptr_t(&ts));
+        sqe->len = 1;
+        sqe->user_data = gen_hi | timer_lo;
+        timer_armed = true;
+        to_submit++;
+      }
+    }
+    int rc = ring.enter(to_submit, 1);
+    if (rc < 0) { *c.dev_idx = -1; return rc; }
+    int completed = 0;
+    bool timer_fired = false;
+    io_uring_cqe cqe;
+    while (ring.pop(&cqe)) {
+      if ((cqe.user_data >> 32) != gen) continue;  // stale: drop
+      uint32_t lo = uint32_t(cqe.user_data);
+      if (lo == timer_lo) {
+        timer_armed = false;
+        timer_fired = true;
+        continue;
+      }
+      int i = int(lo);
+      if (i < 0 || i >= c.n) continue;
+      armed[size_t(i)] = 0;  // POLL_ADD is one-shot: re-arm next round
+      if (c.done[i]) continue;
+      rc = gather_on_ready(c, i, &completed);
+      if (rc) { gather_note_batch(c, completed); return rc; }
+    }
+    if (completed) {
+      gather_note_batch(c, completed);
+    } else if (timer_fired) {
+      if (c.dl.on_idle) c.dl.on_idle();
+      c.dl.idle_ms += c.dl.interval_ms;
+      if (c.dl.idle_ms >= c.dl.timeout_ms) {
+        *c.dev_idx = -1;
+        return -ETIMEDOUT;
+      }
+    }
+  }
+  return 0;
+}
+
+#endif  // HVD_HAVE_IO_URING
+
+// Drain MSG_ZEROCOPY completion notifications from the socket error
+// queue until ``expect`` sends are acknowledged. The caller may reuse
+// or free the payload buffers the moment hvd_sendv_zc returns, so
+// returning with completions outstanding is a use-after-free handed
+// to the kernel — this wait is mandatory, bounded by timeout_ms.
+int zc_drain(int fd, int expect, int timeout_ms, int* zc_copied) {
+  int drained = 0;
+  int idle = 0;
+  const int slice = 50;
+  while (drained < expect) {
+    struct msghdr msg;
+    memset(&msg, 0, sizeof(msg));
+    alignas(struct cmsghdr) char control[256];
+    msg.msg_control = control;
+    msg.msg_controllen = sizeof(control);
+    ssize_t r = ::recvmsg(fd, &msg, MSG_ERRQUEUE | MSG_DONTWAIT);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        struct pollfd pf;
+        pf.fd = fd;
+        pf.events = 0;  // POLLERR is always reported
+        pf.revents = 0;
+        int pr = ::poll(&pf, 1, slice);
+        if (pr < 0 && errno != EINTR) return -errno;
+        if (pr == 0) {
+          idle += slice;
+          if (timeout_ms >= 0 && idle >= timeout_ms) return -ETIMEDOUT;
+        }
+        continue;
+      }
+      return -errno;
+    }
+    for (struct cmsghdr* cm = CMSG_FIRSTHDR(&msg); cm != nullptr;
+         cm = CMSG_NXTHDR(&msg, cm)) {
+      if (cm->cmsg_len < CMSG_LEN(sizeof(struct sock_extended_err)))
+        continue;
+      struct sock_extended_err ee;
+      memcpy(&ee, CMSG_DATA(cm), sizeof(ee));
+      if (ee.ee_errno != 0 || ee.ee_origin != SO_EE_ORIGIN_ZEROCOPY)
+        continue;
+      int span = int(ee.ee_data - ee.ee_info) + 1;
+      drained += span;
+      if ((ee.ee_code & SO_EE_CODE_ZEROCOPY_COPIED) && zc_copied)
+        *zc_copied += span;
+    }
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int hvd_gather_frames_batched(const int* fds, int n,
+                              const uint8_t* secret, int secret_len,
+                              uint8_t want_tag, void* const* bufs,
+                              const int64_t* caps, int64_t* lens,
+                              const uint8_t* skip_tags, int nskip,
+                              int timeout_ms, int interval_ms,
+                              void (*on_idle)(void),
+                              uint8_t* done, double* arrive,
+                              int32_t* batch_sizes, int* nbatches,
+                              int* dev_idx, uint8_t** dev_buf,
+                              int64_t* dev_len, uint8_t* dev_tag) {
+  if (!dev_idx || !dev_buf || !dev_len || !dev_tag || !done || !lens)
+    return -EINVAL;
+  *dev_idx = -1;
+  if (n <= 0) return 0;
+  GatherCtx c;
+  c.fds = fds;
+  c.n = n;
+  c.secret = secret;
+  c.secret_len = secret_len;
+  c.want_tag = want_tag;
+  c.bufs = bufs;
+  c.caps = caps;
+  c.lens = lens;
+  c.skip_tags = skip_tags;
+  c.nskip = nskip;
+  c.dl.timeout_ms = timeout_ms;
+  c.dl.interval_ms =
+      (timeout_ms >= 0 && interval_ms <= 0) ? 100 : interval_ms;
+  c.dl.on_idle = on_idle;
+  c.dl.idle_ms = 0;
+  c.done = done;
+  c.arrive = arrive;
+  c.batch_sizes = batch_sizes;
+  c.nbatches = nbatches;
+  c.dev_idx = dev_idx;
+  c.dev_buf = dev_buf;
+  c.dev_len = dev_len;
+  c.dev_tag = dev_tag;
+  c.remaining = 0;
+  for (int i = 0; i < n; i++)
+    if (!done[i]) c.remaining++;
+  if (c.remaining == 0) return 0;
+#ifdef HVD_HAVE_IO_URING
+  if (io_uring_available()) return gather_loop_uring(c);
+#endif
+  return gather_loop_poll(c);
+}
+
+int hvd_sendv_zc(int fd, uint8_t tag, const void* const* bufs,
+                 const int64_t* lens, int niov,
+                 const uint8_t* secret, int secret_len,
+                 int timeout_ms, int* zc_sends, int* zc_copied) {
+  if (zc_sends) *zc_sends = 0;
+  if (zc_copied) *zc_copied = 0;
+  // SO_ZEROCOPY is refused for socket families without zerocopy
+  // support (AF_UNIX): the refusal IS the capability probe, and the
+  // plain copying send keeps the wire bytes identical.
+  int one = 1;
+  if (setsockopt(fd, SOL_SOCKET, SO_ZEROCOPY, &one, sizeof(one)) != 0)
+    return send_frame_iov(fd, tag, bufs, lens, niov, secret, secret_len);
+  int64_t total = 0;
+  for (int i = 0; i < niov; i++) {
+    if (lens[i] < 0) return -EINVAL;
+    total += lens[i];
+  }
+  if (uint64_t(total) > 0xffffffffull) return -EMSGSIZE;
+  uint8_t hdr[5];
+  uint32_t n32 = uint32_t(total);
+  memcpy(hdr, &n32, 4);  // little-endian hosts only (x86/arm64)
+  hdr[4] = tag;
+  uint8_t digest[32];
+  std::vector<struct iovec> iov;
+  iov.reserve(size_t(niov) + 2);
+  iov.push_back({hdr, 5});
+  if (secret_len > 0) {
+    Hmac h(secret, size_t(secret_len));
+    h.update(&tag, 1);
+    for (int i = 0; i < niov; i++)
+      if (lens[i]) h.update(bufs[i], size_t(lens[i]));
+    h.final(digest);
+    iov.push_back({digest, 32});
+  }
+  for (int i = 0; i < niov; i++)
+    if (lens[i])
+      iov.push_back({const_cast<void*>(bufs[i]), size_t(lens[i])});
+  // sendv_all's loop with MSG_ZEROCOPY: each successful sendmsg pins
+  // the iovecs and owes exactly one completion notification. ENOBUFS
+  // (optmem exhausted) retries that sendmsg without the flag.
+  struct iovec* cur = iov.data();
+  int left = int(iov.size());
+  int pending = 0;
+  int rc = 0;
+  while (left > 0) {
+    struct msghdr msg;
+    memset(&msg, 0, sizeof(msg));
+    msg.msg_iov = cur;
+    msg.msg_iovlen = size_t(left);
+    ssize_t w = ::sendmsg(fd, &msg, MSG_NOSIGNAL | MSG_ZEROCOPY);
+    if (w < 0 && errno == ENOBUFS)
+      w = ::sendmsg(fd, &msg, MSG_NOSIGNAL);
+    else if (w >= 0)
+      pending++;
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      rc = -errno;
+      break;
+    }
+    size_t adv = size_t(w);
+    while (left > 0 && adv >= cur->iov_len) {
+      adv -= cur->iov_len;
+      cur++;
+      left--;
+    }
+    if (left > 0 && adv) {
+      cur->iov_base = static_cast<char*>(cur->iov_base) + adv;
+      cur->iov_len -= adv;
+    }
+  }
+  if (zc_sends) *zc_sends = pending;
+  // Drain even after a send error: any sendmsg that DID go out
+  // zero-copy still references caller memory until acknowledged.
+  int drc = zc_drain(fd, pending, timeout_ms, zc_copied);
+  return rc ? rc : drc;
+}
+
+int hvd_relay_frame(int up_fd, const int* child_fds, int nchild,
+                    uint8_t want_tag, void* buf, int64_t cap,
+                    const uint8_t* secret, int secret_len,
+                    const uint8_t* skip_tags, int nskip,
+                    int64_t chunk_bytes, int timeout_ms,
+                    int interval_ms, int64_t* out_len,
+                    uint8_t* out_tag, uint8_t** spill) {
+  if (!out_len || !out_tag || !spill) return -EINVAL;
+  Deadline dl{timeout_ms,
+              (timeout_ms >= 0 && interval_ms <= 0) ? 100 : interval_ms,
+              nullptr};
+  for (;;) {
+    uint8_t hdr[5];
+    int rc = dl_read(up_fd, hdr, 5, &dl);
+    if (rc) return rc;
+    uint32_t plen;
+    memcpy(&plen, hdr, 4);
+    uint8_t tag = hdr[4];
+    uint8_t digest[32];
+    if (secret_len > 0) {
+      rc = dl_read(up_fd, digest, 32, &dl);
+      if (rc) return rc;
+    }
+    if (tag_in(tag, skip_tags, nskip)) {  // tolerated stray: drop it
+      rc = drain_frame(up_fd, plen, nullptr, 0, tag, secret, secret_len,
+                       digest, &dl, nullptr);
+      if (rc) return rc;
+      continue;
+    }
+    if (tag != want_tag) {  // deviation: hand the whole frame back
+      uint8_t* bounce = nullptr;
+      rc = drain_frame(up_fd, plen, nullptr, 0, tag, secret, secret_len,
+                       digest, &dl, &bounce);
+      if (rc) return rc;
+      *spill = bounce;
+      *out_len = int64_t(plen);
+      *out_tag = tag;
+      return 2;
+    }
+    // The expected frame: cut-through. Header and digest go downstream
+    // before the first payload byte, then each chunk is relayed as it
+    // arrives — a child's read of chunk i overlaps our read of chunk
+    // i+1. Children re-verify the digest themselves, so a frame this
+    // relay later rejects (-EBADMSG) is rejected by every tier.
+    uint8_t* dst;
+    bool spilled = false;
+    if (int64_t(plen) <= cap) {
+      dst = static_cast<uint8_t*>(buf);
+    } else {
+      dst = static_cast<uint8_t*>(malloc(plen ? plen : 1));
+      if (!dst) return -ENOMEM;
+      spilled = true;
+    }
+    uint8_t head[37];
+    memcpy(head, hdr, 5);
+    size_t head_len = 5;
+    if (secret_len > 0) {
+      memcpy(head + 5, digest, 32);
+      head_len = 37;
+    }
+    for (int k = 0; k < nchild; k++) {
+      rc = write_all(child_fds[k], head, head_len);
+      if (rc) {
+        if (spilled) free(dst);
+        return rc;
+      }
+    }
+    Hmac h(secret, secret_len > 0 ? size_t(secret_len) : 0);
+    if (secret_len > 0) h.update(&tag, 1);
+    int64_t cb = chunk_bytes > 0 ? chunk_bytes : int64_t(plen);
+    int64_t off = 0;
+    while (off < int64_t(plen)) {
+      int64_t take = int64_t(plen) - off;
+      if (take > cb) take = cb;
+      rc = dl_read(up_fd, dst + off, size_t(take), &dl);
+      if (rc == 0 && secret_len > 0) h.update(dst + off, size_t(take));
+      for (int k = 0; rc == 0 && k < nchild; k++)
+        rc = write_all(child_fds[k], dst + off, size_t(take));
+      if (rc) {
+        if (spilled) free(dst);
+        return rc;
+      }
+      off += take;
+    }
+    if (secret_len > 0) {
+      uint8_t expect[32];
+      h.final(expect);
+      if (!digest_eq(digest, expect)) {
+        if (spilled) free(dst);
+        return -EBADMSG;
+      }
+    }
+    *out_len = int64_t(plen);
+    *out_tag = tag;
+    if (spilled) {
+      *spill = dst;
+      return 1;
+    }
+    return 0;
+  }
+}
+
+int hvd_build_flags(void) {
+  int flags = 0;
+#ifdef HVD_HAVE_IO_URING
+  flags |= 1;  // compiled with io_uring support (Makefile probe)
+  if (io_uring_available()) flags |= 2;  // running kernel accepts it
+#endif
+  flags |= 4;  // MSG_ZEROCOPY send path compiled in
+  return flags;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------
+// Native int8 codec (wire_dtype WIRE_INT8 without the numpy round-trip)
+// ---------------------------------------------------------------------
+
+namespace {
+
+// Bit-identical to the numpy reference in common/wire_dtype.py:
+//   scale   = float(max|x|) / 127 computed in f64, narrowed to f32 for
+//             the header;
+//   lanes   = clip(rint(x * T(1/scale)), -127, 127).astype(int8) with
+//             the reciprocal narrowed to the array dtype before the
+//             multiply (numpy's value-based scalar casting) and
+//             round-half-even via rint;
+//   residual= compensated - lane * T(header_scale)   (error feedback).
+// NaN lanes are platform-defined in both implementations (numpy
+// propagates NaN through max; the float->int8 cast of NaN is UB) —
+// training guards upstream, the codec does not.
+template <typename T>
+__attribute__((always_inline)) inline
+int quant8_impl(const T* src, int64_t count, const T* res_in,
+                T* res_out, uint8_t* out) {
+  if (res_in && !res_out) return -EINVAL;
+  const T* comp = src;
+  T maxabs = T(0);
+  if (res_out) {  // stage compensated lanes in the residual buffer
+    for (int64_t i = 0; i < count; i++) {
+      T v = src[i] + (res_in ? res_in[i] : T(0));
+      res_out[i] = v;
+      T a = v < T(0) ? -v : v;
+      if (a > maxabs) maxabs = a;
+    }
+    comp = res_out;
+  } else {
+    for (int64_t i = 0; i < count; i++) {
+      T a = src[i] < T(0) ? -src[i] : src[i];
+      if (a > maxabs) maxabs = a;
+    }
+  }
+  double scale = count > 0 ? double(maxabs) / 127.0 : 0.0;
+  if (scale == 0.0) scale = 1.0;
+  float hdr = float(scale);
+  memcpy(out, &hdr, 4);
+  int8_t* q = reinterpret_cast<int8_t*>(out + 4);
+  T inv = T(1.0 / scale);
+  T hs = T(hdr);
+  for (int64_t i = 0; i < count; i++) {
+    T t = std::rint(comp[i] * inv);
+    if (t > T(127)) t = T(127);
+    if (t < T(-127)) t = T(-127);
+    q[i] = int8_t(t);
+    // comp may alias res_out: read-then-write of the same lane is fine
+    if (res_out) res_out[i] = comp[i] - T(q[i]) * hs;
+  }
+  return 0;
+}
+
+template <typename T>
+__attribute__((always_inline)) inline
+void dequant8_impl(const uint8_t* src, int64_t count, T* out) {
+  float hdr;
+  memcpy(&hdr, src, 4);
+  const int8_t* q = reinterpret_cast<const int8_t*>(src + 4);
+  T s = T(hdr);
+  for (int64_t i = 0; i < count; i++) out[i] = T(q[i]) * s;
+}
+
+// Runtime ISA dispatch (GNU ifunc): the default x86-64 baseline is
+// SSE2, where std::rint cannot vectorize and the codec loses to
+// numpy's SIMD kernels; the avx2 clones vectorize rint (vroundps,
+// current-mode = round-half-even) and the int8 pack/unpack. Value
+// semantics are identical across clones — vroundps IS scalar rint
+// lane-wise, and -ffp-contract=off (Makefile) forbids the one
+// transform (FMA contraction in the residual) that could split them.
+__attribute__((target_clones("avx2", "default")))
+int quant8_f32(const float* src, int64_t count, const float* res_in,
+               float* res_out, uint8_t* out) {
+  return quant8_impl<float>(src, count, res_in, res_out, out);
+}
+
+__attribute__((target_clones("avx2", "default")))
+int quant8_f64(const double* src, int64_t count, const double* res_in,
+               double* res_out, uint8_t* out) {
+  return quant8_impl<double>(src, count, res_in, res_out, out);
+}
+
+__attribute__((target_clones("avx2", "default")))
+void dequant8_f32(const uint8_t* src, int64_t count, float* out) {
+  dequant8_impl<float>(src, count, out);
+}
+
+__attribute__((target_clones("avx2", "default")))
+void dequant8_f64(const uint8_t* src, int64_t count, double* out) {
+  dequant8_impl<double>(src, count, out);
+}
+
+}  // namespace
+
+extern "C" {
+
+int hvd_quant8(const void* src, int64_t count, int dtype,
+               const void* residual, void* residual_out, uint8_t* out) {
+  if (count < 0 || !src || !out) return -EINVAL;
+  if (dtype == 0)
+    return quant8_f32(static_cast<const float*>(src), count,
+                      static_cast<const float*>(residual),
+                      static_cast<float*>(residual_out), out);
+  if (dtype == 1)
+    return quant8_f64(static_cast<const double*>(src), count,
+                      static_cast<const double*>(residual),
+                      static_cast<double*>(residual_out), out);
+  return -EINVAL;
+}
+
+int hvd_dequant8(const uint8_t* src, int64_t count, int dtype,
+                 void* out) {
+  if (count < 0 || !src || !out) return -EINVAL;
+  if (dtype == 0) {
+    dequant8_f32(src, count, static_cast<float*>(out));
+    return 0;
+  }
+  if (dtype == 1) {
+    dequant8_f64(src, count, static_cast<double*>(out));
+    return 0;
+  }
+  return -EINVAL;
 }
 
 }  // extern "C"

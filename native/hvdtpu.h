@@ -72,6 +72,84 @@ int hvd_recv_into(int fd, const uint8_t* secret, int secret_len,
                   int timeout_ms, int interval_ms,
                   uint8_t** spill);
 
+// ---- batched-submission reactor (kernel-side wire speed) -------------
+// One batched gather replacing the coordinator's N sequential
+// hvd_recv_into calls: every pending peer's DATA frame is awaited in
+// a single readiness loop (io_uring when the Makefile probe compiled
+// it in AND the running kernel accepts io_uring_setup; a poll(2)
+// batch otherwise — the bytes read and written are identical either
+// way, only how readiness is learned differs). Frames whose tag
+// appears in skip_tags (PING) are drained, authenticated and
+// discarded in C without bouncing to Python. ``done`` (n bytes,
+// in/out) marks peers already absorbed, so the caller re-enters with
+// progress intact after handling a deviation. Deviations (METRICS /
+// TRACE / ABORT / wrong tag / payload overflowing caps[i]) return 1
+// with the whole authenticated frame in *dev_buf (malloc'd, caller
+// frees with hvd_free) and the peer index in *dev_idx. Transport
+// errors return negative errno with *dev_idx naming the failing peer
+// (-1 for a world-wide condition such as -ETIMEDOUT after timeout_ms
+// of total silence across every peer). on_idle (nullable) fires once
+// per idle poll slice (the coordinator's PING fan-out); ``arrive``
+// (nullable, n doubles) receives per-peer completion stamps on
+// CLOCK_MONOTONIC for straggler attribution. batch_sizes/nbatches
+// (nullable pair, capacity n): the number of frames completed by each
+// wakeup that completed at least one — the reactor's batching
+// histogram.
+int hvd_gather_frames_batched(const int* fds, int n,
+                              const uint8_t* secret, int secret_len,
+                              uint8_t want_tag, void* const* bufs,
+                              const int64_t* caps, int64_t* lens,
+                              const uint8_t* skip_tags, int nskip,
+                              int timeout_ms, int interval_ms,
+                              void (*on_idle)(void),
+                              uint8_t* done, double* arrive,
+                              int32_t* batch_sizes, int* nbatches,
+                              int* dev_idx, uint8_t** dev_buf,
+                              int64_t* dev_len, uint8_t* dev_tag);
+
+// hvd_sendv with MSG_ZEROCOPY: same frame bytes on the wire, but
+// payload iovecs are pinned by the kernel instead of copied into the
+// socket buffer, and the completion notifications are drained from
+// the error queue BEFORE returning (the caller may mutate or free the
+// buffers the moment this returns, so lingering references are not
+// allowed). *zc_sends counts sendmsg calls that went out zero-copy;
+// *zc_copied counts completions where the kernel fell back to a copy
+// (loopback always does — the counters surface the degradation).
+// Falls back internally to the plain copying send when the socket
+// family or kernel lacks SO_ZEROCOPY, or per-call on ENOBUFS.
+int hvd_sendv_zc(int fd, uint8_t tag, const void* const* bufs,
+                 const int64_t* lens, int niov,
+                 const uint8_t* secret, int secret_len,
+                 int timeout_ms, int* zc_sends, int* zc_copied);
+
+// Chunked cut-through relay (the hierarchical root/leaf legs and the
+// ServiceGate snapshot fanout): read one frame from up_fd and forward
+// it to every child fd chunk-by-chunk as it arrives — the header and
+// digest go downstream before the first payload byte, so a child's
+// read of chunk i overlaps the relay's read of chunk i+1 (the
+// hvd_steady_worker_chunked discipline applied to the relay), instead
+// of the classic store-and-forward that buffered the whole payload
+// first. Children re-verify the digest themselves; the relay also
+// authenticates incrementally and returns -EBADMSG after the last
+// chunk on mismatch. Frames whose tag is in skip_tags are drained and
+// discarded (not relayed). Returns 0 with the payload in buf; 1 when
+// it overflowed cap (complete in *spill, malloc'd, already relayed);
+// 2 for a non-skip deviation (PING/ABORT/wrong tag — NOT relayed,
+// whole frame in *spill with out_len/out_tag set, caller decides).
+int hvd_relay_frame(int up_fd, const int* child_fds, int nchild,
+                    uint8_t want_tag, void* buf, int64_t cap,
+                    const uint8_t* secret, int secret_len,
+                    const uint8_t* skip_tags, int nskip,
+                    int64_t chunk_bytes, int timeout_ms,
+                    int interval_ms, int64_t* out_len,
+                    uint8_t* out_tag, uint8_t** spill);
+
+// Build/runtime capability flags: bit 0 = compiled with io_uring
+// support (Makefile probe), bit 1 = the running kernel accepted
+// io_uring_setup (runtime probe, cached), bit 2 = MSG_ZEROCOPY send
+// path compiled in. Surfaced through hvd_build_info.
+int hvd_build_flags(void);
+
 // ---- native steady replay (the fused speculative cycle in C) ---------
 // One steady-state training step without re-entering Python per frame:
 // both halves speak the exact CACHED_SPEC wire layout of
@@ -181,6 +259,26 @@ int hvd_sum_into(void* acc, const void* src, int64_t count, int dtype);
 // uses the numpy fallback. src and dst must not overlap.
 int hvd_cast(const void* src, void* dst, int64_t count, int src_dtype,
              int dst_dtype);
+
+// ---- native int8 codec (wire_dtype WIRE_INT8 without numpy) ----------
+// Quantize count f32/f64 lanes (dtype 0=f32 1=f64) into the int8 wire
+// layout [f32 scale | count x int8]: scale = max|x| / 127 narrowed to
+// f32, lanes = clip(rint(x / scale), -127, 127) — bit-identical to
+// the numpy reference in common/wire_dtype.py (round-half-even via
+// rint, scalar narrowed to the array dtype before the multiply, clamp
+// before the int8 cast). Error feedback fuses into the same pass:
+// residual (nullable) is added lane-wise before scanning, and
+// residual_out (nullable; required when residual is set, may alias
+// residual) receives compensated - dequantized. out must hold
+// 4 + count bytes.
+int hvd_quant8(const void* src, int64_t count, int dtype,
+               const void* residual, void* residual_out, uint8_t* out);
+
+// Inverse: expand [f32 scale | count x int8] into count f32/f64 lanes
+// (out[i] = lane * scale, the scale widened/kept per dtype exactly as
+// the numpy reference does).
+int hvd_dequant8(const uint8_t* src, int64_t count, int dtype,
+                 void* out);
 
 // ---- self-test helpers ----------------------------------------------
 // HMAC-SHA256 of (tag|payload) into out[32] — lets Python verify the

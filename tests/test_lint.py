@@ -571,11 +571,39 @@ def test_native_codec_real_tree_mirror():
     # every entry point this PR leans on is visible to the analyzer
     for fn in ("hvd_sendv", "hvd_recv_into", "hvd_steady_worker",
                "hvd_steady_worker_chunked", "hvd_steady_coord",
-               "hvd_sum_into", "hvd_cast"):
+               "hvd_sum_into", "hvd_cast",
+               # the kernel-side wire-speed additions
+               "hvd_gather_frames_batched", "hvd_sendv_zc",
+               "hvd_relay_frame", "hvd_quant8", "hvd_dequant8",
+               "hvd_build_flags"):
         assert fn in decls, fn
     fs = lint_paths([os.path.join(REPO, "horovod_tpu")],
                     ["native-codec"])
     assert fs == [], "\n".join(f.render() for f in fs)
+
+
+BAD_REACTOR_DRIVER = """
+    import ctypes
+
+    def gather_batched(lib, fds, n):
+        dev = ctypes.POINTER(ctypes.c_uint8)()
+        return lib.hvd_gather_frames_batched(fds, n, ctypes.byref(dev))
+
+    def relay(lib, up_fd, kids):
+        spill = ctypes.POINTER(ctypes.c_uint8)()
+        return lib.hvd_relay_frame(up_fd, kids, ctypes.byref(spill))
+"""
+
+
+def test_native_codec_reactor_entry_points_allocating(tmp_path):
+    """The reactor entry points spill malloc'd frames back to Python
+    (batched-gather deviations, relay oversize/deviation payloads) —
+    a driver that consumes them without hvd_free is the same
+    per-cycle leak as a gather_frames driver."""
+    fs = _lint_native(tmp_path, BAD_REACTOR_DRIVER)
+    msgs = "\n".join(f.message for f in fs)
+    assert "gather_batched calls hvd_gather_frames_batched" in msgs
+    assert "relay calls hvd_relay_frame" in msgs
 
 
 def test_wire_truncated_frames_raise_connectionerror():
@@ -1484,6 +1512,31 @@ def test_native_lifetime_arena_cache_fires(tmp_path):
 
 def test_native_lifetime_generation_keyed_cache_clean(tmp_path):
     assert _lint_snippet(tmp_path, GOOD_ARENA_CACHE,
+                         "native-lifetime") == []
+
+
+GOOD_REACTOR_IDLE_CACHE = """
+    import ctypes
+
+    ON_IDLE = ctypes.CFUNCTYPE(None)
+
+    class Fanout:
+        def __init__(self):
+            self._on_idle_c = None
+
+        def gather(self, lib, f):
+            if self._on_idle_c is None:
+                self._on_idle_c = ON_IDLE(f)
+            lib.hvd_gather_frames_batched(self._on_idle_c)
+"""
+
+
+def test_native_lifetime_reactor_idle_cache_clean(tmp_path):
+    """The batched reactor's lazily-built, self-owned ON_IDLE thunk
+    (the _NativeFanout.gather_into shape): cached on the instance, so
+    the callback object outlives the native call that fires it — the
+    analyzer must accept it, only temporaries fire."""
+    assert _lint_snippet(tmp_path, GOOD_REACTOR_IDLE_CACHE,
                          "native-lifetime") == []
 
 

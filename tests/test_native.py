@@ -391,3 +391,374 @@ def test_sum_into_bfloat16_matches_numpy_rne():
     out = np.asarray(acc, np.float32)
     assert np.isposinf(out[0]) and np.isneginf(out[1])
     assert np.isnan(out[2]) and out[3] == 0.0
+
+
+# ---- PR 16: batched reactor, zero-copy sends, int8 codec, relay ------
+
+def _wd():
+    from horovod_tpu.common import wire_dtype as wd
+    return wd
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_quant8_bit_identical_to_numpy(dtype):
+    """hvd_quant8 (plain mode) must produce the exact bytes of the
+    numpy reference leg — same scale narrowing, round-half-even,
+    saturation — so mixed native/numpy worlds stay convergent."""
+    wd = _wd()
+    rng = np.random.RandomState(21)
+    for arr in (rng.randn(1337).astype(dtype) * 40,
+                np.zeros(64, dtype),                    # scale-0 path
+                np.array([1e-30, -1e-30, 127.0, -127.0, 0.5], dtype),
+                rng.randn(1).astype(dtype)):
+        ref = np.empty(4 + arr.size, np.uint8)
+        wd._quantize_numpy(arr.copy(), ref)
+        nat = np.empty(4 + arr.size, np.uint8)
+        assert native.quant8(arr, nat), "native quant8 unavailable"
+        assert nat.tobytes() == ref.tobytes()
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_dequant8_bit_identical_to_numpy(dtype):
+    wd = _wd()
+    rng = np.random.RandomState(22)
+    arr = rng.randn(999).astype(dtype) * 7
+    buf = wd.quantize(arr)
+    # numpy reference expansion
+    scale = float(buf[:4].view(np.float32)[0])
+    q = buf[4:].view(np.int8)
+    ref = q.astype(dtype) * np.asarray(scale, dtype)
+    out = np.empty(arr.size, dtype)
+    assert native.dequant8(buf, out), "native dequant8 unavailable"
+    assert out.tobytes() == ref.tobytes()
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_quant8_fused_ef_matches_classic_triple(dtype):
+    """The fused residual mode must equal the classic
+    apply -> quantize -> update triple bit-for-bit: same wire bytes
+    AND same next-step residual, including when residual_out aliases
+    residual."""
+    wd = _wd()
+    rng = np.random.RandomState(23)
+    arr = rng.randn(513).astype(dtype)
+    res = (rng.randn(513) * 0.01).astype(dtype)
+
+    # classic triple (pure numpy)
+    comp = arr + res
+    ref_buf = np.empty(4 + arr.size, np.uint8)
+    wd._quantize_numpy(comp, ref_buf)
+    scale = float(ref_buf[:4].view(np.float32)[0])
+    sent = ref_buf[4:].view(np.int8).astype(dtype) \
+        * np.asarray(scale, dtype)
+    ref_res = comp - sent
+
+    # fused, separate residual_out
+    nat_buf = np.empty(4 + arr.size, np.uint8)
+    res_out = np.empty(arr.size, dtype)
+    assert native.quant8(arr, nat_buf, residual=res,
+                         residual_out=res_out)
+    assert nat_buf.tobytes() == ref_buf.tobytes()
+    assert res_out.tobytes() == ref_res.tobytes()
+
+    # fused, residual_out ALIASES residual (the store's hot shape)
+    alias = res.copy()
+    nat_buf2 = np.empty(4 + arr.size, np.uint8)
+    assert native.quant8(arr, nat_buf2, residual=alias,
+                         residual_out=alias)
+    assert nat_buf2.tobytes() == ref_buf.tobytes()
+    assert alias.tobytes() == ref_res.tobytes()
+
+
+def test_quant8_residual_without_out_rejected():
+    """residual without residual_out would silently drop the error
+    chain — the wrapper must refuse and route to the fallback."""
+    arr = np.ones(8, np.float32)
+    buf = np.empty(12, np.uint8)
+    assert not native.quant8(arr, buf, residual=np.zeros(8, np.float32))
+
+
+def test_quantize_ef_roundtrip_chain_native_vs_numpy():
+    """Two steady steps through wire_dtype.quantize_ef must yield the
+    same bytes whether the native codec serves them or not (the
+    convergence-parity contract, in-process edition)."""
+    wd = _wd()
+    rng = np.random.RandomState(24)
+    steps = [rng.randn(257).astype(np.float32) for _ in range(3)]
+    key = ("t",)
+    ef_nat, ef_np = wd.ErrorFeedback(), wd.ErrorFeedback()
+    for arr in steps:
+        nat = wd.quantize_ef(arr, ef_nat, key)
+        # classic triple, forced
+        comp = ef_np.apply(key, arr)
+        ref = np.empty(4 + arr.size, np.uint8)
+        wd._quantize_numpy(comp, ref)
+        ef_np.update(key, comp, ref)
+        assert nat.tobytes() == ref.tobytes()
+
+
+def test_build_flags_shape():
+    """bit1 (runtime io_uring) implies bit0 (compiled); the trace
+    build_info string renders the same bits."""
+    f = native.build_flags()
+    assert f >= 0
+    if f & 2:
+        assert f & 1, "runtime probe set without compiled support"
+    from horovod_tpu.common.trace import build_info
+    names = build_info()["flags"]
+    assert (("io_uring" in names.split("+")) == bool(f & 1))
+    assert (("io_uring_rt" in names) == bool(f & 2))
+    assert (("zerocopy" in names) == bool(f & 4))
+
+
+def _batched_call(fds, secret, want_tag, caps, timeout_ms=5000,
+                  done=None, skip=(1,)):
+    """One hvd_gather_frames_batched invocation with fresh out-params;
+    returns (rc, bufs, lens, done, arrive, batches, dev)."""
+    n = len(fds)
+    sec = (ctypes.c_uint8 * max(1, len(secret)))(*secret)
+    bufs = [np.zeros(c, np.uint8) for c in caps]
+    bufp = (ctypes.c_void_p * n)(*[b.ctypes.data for b in bufs])
+    capv = (ctypes.c_int64 * n)(*caps)
+    lens = (ctypes.c_int64 * n)()
+    skipv = (ctypes.c_uint8 * max(1, len(skip)))(*skip)
+    if done is None:
+        done = (ctypes.c_uint8 * n)()
+    arrive = (ctypes.c_double * n)()
+    batch = (ctypes.c_int32 * n)()
+    nbatch = ctypes.c_int(0)
+    dev_idx = ctypes.c_int(-2)
+    dev_buf = ctypes.POINTER(ctypes.c_uint8)()
+    dev_len = ctypes.c_int64(0)
+    dev_tag = ctypes.c_uint8(0)
+    fdv = (ctypes.c_int * n)(*fds)
+    rc = lib.hvd_gather_frames_batched(
+        fdv, n, sec, len(secret), want_tag, bufp, capv, lens,
+        skipv, len(skip), timeout_ms, 100, native.NULL_ON_IDLE,
+        done, arrive, batch, ctypes.byref(nbatch),
+        ctypes.byref(dev_idx), ctypes.byref(dev_buf),
+        ctypes.byref(dev_len), ctypes.byref(dev_tag))
+    return (rc, bufs, list(lens), done, list(arrive),
+            list(batch[:nbatch.value]),
+            (dev_idx.value, dev_buf, dev_len.value, dev_tag.value))
+
+
+@pytest.mark.parametrize("secret", [b"", b"reactor-secret"])
+def test_gather_batched_interop_and_stamps(secret):
+    """The batched reactor must absorb frames from plain Python
+    Channels (wire identical), skip PINGs in C, stamp arrivals on
+    CLOCK_MONOTONIC, and report its batching histogram."""
+    import time as _time
+    pairs = [socket.socketpair() for _ in range(3)]
+    chans = [Channel(b, secret) for _, b in pairs]
+    payloads = [os.urandom(100 + 1000 * i) for i in range(3)]
+    threads = [threading.Thread(target=c.send, args=(p, 7))
+               for c, p in zip(chans, payloads)]
+    # rank 1 also fires a PING first — must be drained in C
+    ping = threading.Thread(target=chans[1].send, args=(b"", 1))
+    ping.start(); ping.join()
+    for t in threads:
+        t.start()
+    rc, bufs, lens, done, arrive, batches, _ = _batched_call(
+        [a.fileno() for a, _ in pairs], secret, 7,
+        [len(p) + 64 for p in payloads])
+    for t in threads:
+        t.join()
+    assert rc == 0
+    assert list(done) == [1, 1, 1]
+    now = _time.monotonic()
+    for i, p in enumerate(payloads):
+        assert lens[i] == len(p)
+        assert bufs[i][:lens[i]].tobytes() == p
+        assert 0 < arrive[i] <= now + 1.0
+    assert batches and sum(batches) == 3  # histogram covers every frame
+    for a, b in pairs:
+        a.close(); b.close()
+
+
+def test_gather_batched_deviation_and_reentry(secret=b"s"):
+    """A non-skip foreign tag must surface as a deviation (rc 1, frame
+    spilled, peer named) and a re-entry with the done map must finish
+    the remaining peers without re-reading absorbed ones."""
+    pairs = [socket.socketpair() for _ in range(2)]
+    chans = [Channel(b, secret) for _, b in pairs]
+    t0 = threading.Thread(target=chans[0].send, args=(b"data-0", 7))
+    tdev = threading.Thread(target=chans[1].send,
+                            args=(b"metrics-blob", 9))
+    t0.start(); tdev.start()
+    fds = [a.fileno() for a, _ in pairs]
+    rc, bufs, lens, done, _, _, dev = _batched_call(
+        fds, secret, 7, [4096, 4096])
+    t0.join(); tdev.join()
+    assert rc == 1
+    dev_idx, dev_buf, dev_len, dev_tag = dev
+    assert dev_idx == 1 and dev_tag == 9
+    assert ctypes.string_at(dev_buf, dev_len) == b"metrics-blob"
+    lib.hvd_free(dev_buf)
+    # the deviating peer now sends its real frame; re-enter with done
+    t1 = threading.Thread(target=chans[1].send, args=(b"data-1", 7))
+    t1.start()
+    rc2, bufs2, lens2, done2, _, _, _ = _batched_call(
+        fds, secret, 7, [4096, 4096], done=done)
+    t1.join()
+    assert rc2 == 0 and list(done2) == [1, 1]
+    assert bufs2[1][:lens2[1]].tobytes() == b"data-1"
+    # peer 0 was NOT re-read: its buffer stayed untouched on re-entry
+    assert lens2[0] == 0 or bufs2[0][:lens2[0]].tobytes() == b"data-0"
+    for a, b in pairs:
+        a.close(); b.close()
+
+
+def test_gather_batched_timeout_names_world():
+    a, b = socket.socketpair()
+    rc, _, _, _, _, _, dev = _batched_call(
+        [a.fileno()], b"", 7, [64], timeout_ms=150)
+    assert rc < 0 and dev[0] == -1  # world-wide silence
+    a.close(); b.close()
+
+
+@pytest.mark.parametrize("secret", [b"", b"zc-secret"])
+def test_sendv_zc_interop_with_python_channel(secret):
+    """hvd_sendv_zc must put byte-identical frames on the wire (the
+    Python Channel parses them) whether or not the kernel honors
+    SO_ZEROCOPY on this socket family."""
+    a, b = socket.socketpair()
+    chan = Channel(b, secret)
+    parts = [b"head", os.urandom(200_000), b"tail"]
+    arrs = [np.frombuffer(p, np.uint8) for p in parts]
+    bufp = (ctypes.c_void_p * 3)(*[x.ctypes.data for x in arrs])
+    lens = (ctypes.c_int64 * 3)(*[x.nbytes for x in arrs])
+    sec = (ctypes.c_uint8 * max(1, len(secret)))(*secret)
+    zc_sends = ctypes.c_int(0)
+    zc_copied = ctypes.c_int(0)
+    got = {}
+
+    def _recv():
+        got["frame"] = chan.recv()
+    t = threading.Thread(target=_recv)
+    t.start()
+    rc = lib.hvd_sendv_zc(a.fileno(), 7, bufp, lens, 3, sec,
+                          len(secret), 5000, ctypes.byref(zc_sends),
+                          ctypes.byref(zc_copied))
+    t.join(timeout=10)
+    assert rc == 0
+    assert got["frame"] == (7, b"".join(parts))
+    # AF_UNIX rejects SO_ZEROCOPY → plain-send fallback: counters may
+    # be zero; they must never go negative or report copies > sends.
+    assert zc_sends.value >= 0
+    assert 0 <= zc_copied.value <= max(zc_sends.value, zc_copied.value)
+    a.close(); b.close()
+
+
+def _relay_call(up_fd, child_fds, secret, want_tag, cap=1 << 16,
+                chunk=4096, timeout_ms=5000, skip=()):
+    fdv = (ctypes.c_int * max(1, len(child_fds)))(
+        *(child_fds or [-1]))
+    buf = np.zeros(cap, np.uint8)
+    sec = (ctypes.c_uint8 * max(1, len(secret)))(*secret)
+    skipv = (ctypes.c_uint8 * max(1, len(skip)))(*(skip or [0]))
+    out_len = ctypes.c_int64(0)
+    out_tag = ctypes.c_uint8(0)
+    spill = ctypes.POINTER(ctypes.c_uint8)()
+    rc = lib.hvd_relay_frame(
+        up_fd, fdv, len(child_fds), want_tag,
+        ctypes.c_void_p(buf.ctypes.data), cap, sec, len(secret),
+        skipv if skip else None, len(skip), chunk, timeout_ms, 100,
+        ctypes.byref(out_len), ctypes.byref(out_tag),
+        ctypes.byref(spill))
+    return rc, buf, out_len.value, out_tag.value, spill
+
+
+@pytest.mark.parametrize("secret", [b"", b"relay-secret"])
+def test_relay_frame_cut_through_interop(secret):
+    """One frame in at the top must come out byte-identical at every
+    child (chunked through a 4 KiB window, so multiple chunks), AND
+    land in the relay's own buffer."""
+    up_a, up_b = socket.socketpair()
+    kids = [socket.socketpair() for _ in range(2)]
+    sender = Channel(up_b, secret)
+    payload = os.urandom(50_000)  # ~13 chunks at 4 KiB
+    t = threading.Thread(target=sender.send, args=(payload, 11))
+    t.start()
+    got = {}
+
+    def _kid(i, sock):
+        got[i] = Channel(sock, secret).recv()
+    kts = [threading.Thread(target=_kid, args=(i, b))
+           for i, (_, b) in enumerate(kids)]
+    for kt in kts:
+        kt.start()
+    rc, buf, out_len, out_tag, _ = _relay_call(
+        up_a.fileno(), [a.fileno() for a, _ in kids], secret, 11)
+    t.join()
+    for kt in kts:
+        kt.join(timeout=10)
+    assert rc == 0 and out_tag == 11 and out_len == len(payload)
+    assert buf[:out_len].tobytes() == payload
+    assert got[0] == (11, payload) and got[1] == (11, payload)
+    for a, b in kids:
+        a.close(); b.close()
+    up_a.close(); up_b.close()
+
+
+def test_relay_frame_spill_and_deviation():
+    """cap overflow: rc 1, children still got the whole frame, payload
+    complete in *spill. Foreign tag: rc 2, NOT relayed."""
+    secret = b"x"
+    up_a, up_b = socket.socketpair()
+    kid_a, kid_b = socket.socketpair()
+    sender = Channel(up_b, secret)
+    big = os.urandom(9000)
+    t = threading.Thread(target=sender.send, args=(big, 11))
+    t.start()
+    got = {}
+    kt = threading.Thread(
+        target=lambda: got.update(f=Channel(kid_b, secret).recv()))
+    kt.start()
+    rc, _, out_len, _, spill = _relay_call(
+        up_a.fileno(), [kid_a.fileno()], secret, 11, cap=1024,
+        chunk=512)
+    t.join(); kt.join(timeout=10)
+    assert rc == 1 and out_len == len(big)
+    assert ctypes.string_at(spill, out_len) == big
+    lib.hvd_free(spill)
+    assert got["f"] == (11, big)
+
+    # deviation: an ABORT-class tag must NOT be forwarded downstream
+    t = threading.Thread(target=sender.send, args=(b"abort!", 4))
+    t.start()
+    rc, _, out_len, out_tag, spill = _relay_call(
+        up_a.fileno(), [kid_a.fileno()], secret, 11)
+    t.join()
+    assert rc == 2 and out_tag == 4
+    assert ctypes.string_at(spill, out_len) == b"abort!"
+    lib.hvd_free(spill)
+    kid_b.setblocking(False)
+    with pytest.raises(BlockingIOError):
+        kid_b.recv(1)  # nothing went downstream
+    for s in (up_a, up_b, kid_a, kid_b):
+        s.close()
+
+
+def test_relay_frame_skip_tags_drained():
+    """PING-class tags in skip_tags are absorbed (not relayed, not
+    returned) and the relay keeps waiting for the wanted frame."""
+    secret = b"y"
+    up_a, up_b = socket.socketpair()
+    kid_a, kid_b = socket.socketpair()
+    sender = Channel(up_b, secret)
+    threading.Thread(target=sender.send, args=(b"", 1)).start()
+    t = threading.Thread(target=sender.send, args=(b"real", 11))
+    t.start()
+    got = {}
+    kt = threading.Thread(
+        target=lambda: got.update(f=Channel(kid_b, secret).recv()))
+    kt.start()
+    rc, buf, out_len, out_tag, _ = _relay_call(
+        up_a.fileno(), [kid_a.fileno()], secret, 11, skip=(1,))
+    t.join(); kt.join(timeout=10)
+    assert rc == 0 and out_tag == 11
+    assert buf[:out_len].tobytes() == b"real"
+    assert got["f"] == (11, b"real")  # only the real frame relayed
+    for s in (up_a, up_b, kid_a, kid_b):
+        s.close()

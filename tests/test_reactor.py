@@ -1,0 +1,127 @@
+"""PR 16 kernel-side wire speed: the batched-submission reactor, the
+native int8 codec, and the chunked cut-through relay — multi-process
+acceptance legs.
+
+The native unit tests (test_native.py) pin the C entry points against
+their numpy/Python-Channel references in-process; this module proves
+the RUNTIME contracts on real worlds:
+
+* fail-fast survives the reactor: SIGKILL and link-sever while the
+  coordinator sits in a batched gather still raise WorldAbortedError
+  naming the dead peer within the heartbeat deadline;
+* `HOROVOD_TPU_REACTOR` is recv discipline only: all-on, all-off and
+  heterogeneous (one rank opted out) worlds are BIT-EXACT with each
+  other across every collective family, including a multi-host
+  hierarchy where the cut-through relay carries the root legs;
+* the native int8 codec is BIT-IDENTICAL to the numpy reference:
+  an int8+error-feedback training-shaped world re-run under
+  HOROVOD_NATIVE=0 reproduces the same output bytes.
+"""
+
+import signal
+
+import numpy as np
+
+from tests.test_multiprocess import run_scenario
+
+_HB_ENV = {
+    "HOROVOD_HEARTBEAT_INTERVAL": "0.3",
+    "HOROVOD_HEARTBEAT_TIMEOUT": "3",
+}
+_SIGKILL_RC = -signal.SIGKILL
+# Socket star with the ring disabled: every gather rides the
+# coordinator's reactor path, the surface under test.
+_SOCKET_ENV = {"HOROVOD_TPU_SHM": "0", "HOROVOD_TPU_RING_THRESHOLD": "-1"}
+
+
+def test_abort_sigkill_mid_batched_gather():
+    """SIGKILL rank 1 of 3 mid-collective with the batched reactor
+    carrying the coordinator's gathers: both survivors raise
+    WorldAbortedError naming rank 1 within the detection deadline —
+    the batched submission honors the same deadlines as the
+    sequential loop it replaced."""
+    run_scenario(
+        "abort_sigkill_batched_gather", 3, timeout=60.0,
+        extra_env={**_HB_ENV, **_SOCKET_ENV,
+                   "HOROVOD_TPU_REACTOR": "1",
+                   "HOROVOD_FAULT_SPEC": "rank=1:kill:op=3"},
+        expect_rc={1: _SIGKILL_RC})
+
+
+def test_abort_sever_mid_batched_gather():
+    """Abrupt link severance (process alive) mid-batched-gather: the
+    EOF surfaces among the batch completions and the coordinator
+    blames the severed peer, never itself."""
+    run_scenario(
+        "abort_sever_batched_gather", 3, timeout=60.0,
+        extra_env={**_HB_ENV, **_SOCKET_ENV,
+                   "HOROVOD_TPU_REACTOR": "1",
+                   "HOROVOD_FAULT_SPEC": "rank=1:sever:cycle=20"})
+
+
+def _reactor_world(tmp_path, tag, per_rank_env=None, extra=None,
+                   np_ranks=3):
+    out = str(tmp_path / f"reactor_{tag}.npy")
+    env = {**_SOCKET_ENV, "HVD_REACTOR_OUT": out}
+    if extra:
+        env.update(extra)
+    run_scenario("reactor_exact", np_ranks, timeout=90.0,
+                 extra_env=env, per_rank_env=per_rank_env)
+    return np.load(out)
+
+
+def test_reactor_off_world_bit_exact(tmp_path):
+    """HOROVOD_TPU_REACTOR=0 everywhere completes the full collective
+    sweep with bytes identical to the reactor world — the runtime
+    fallback is not a degraded mode, it is the same protocol."""
+    on = _reactor_world(tmp_path, "on",
+                        extra={"HOROVOD_TPU_REACTOR": "1",
+                               "HOROVOD_TPU_METRICS": "1",
+                               "HVD_EXPECT_REACTOR": "1"})
+    off = _reactor_world(tmp_path, "off",
+                         extra={"HOROVOD_TPU_REACTOR": "0"})
+    np.testing.assert_array_equal(on, off)
+
+
+def test_reactor_hetero_world_bit_exact(tmp_path):
+    """ONE rank opted out (HOROVOD_TPU_REACTOR=0 on rank 1) in an
+    otherwise-reactor world: the knob is rank-local recv discipline,
+    so the mixed world must interoperate frame-for-frame and produce
+    the same bytes as the uniform world."""
+    uniform = _reactor_world(tmp_path, "uniform")
+    mixed = _reactor_world(
+        tmp_path, "mixed",
+        per_rank_env=lambda rank: (
+            {"HOROVOD_TPU_REACTOR": "0"} if rank == 1 else {}))
+    np.testing.assert_array_equal(uniform, mixed)
+
+
+def test_reactor_hier_multihost_bit_exact(tmp_path):
+    """Two fake hosts x two ranks so the hierarchical control plane
+    (and with it the chunked cut-through relay on the root legs)
+    carries the sweep: reactor-on and reactor-off (store-and-forward
+    relay fallback) worlds must be bit-exact."""
+    hosts = lambda rank: {"HOROVOD_HOSTNAME": f"fakehost{rank // 2}"}
+    on = _reactor_world(tmp_path, "hier_on", per_rank_env=hosts,
+                        extra={"HOROVOD_TPU_REACTOR": "1"},
+                        np_ranks=4)
+    off = _reactor_world(tmp_path, "hier_off", per_rank_env=hosts,
+                         extra={"HOROVOD_TPU_REACTOR": "0"},
+                         np_ranks=4)
+    np.testing.assert_array_equal(on, off)
+
+
+def test_int8_codec_native_vs_numpy_bitexact(tmp_path):
+    """The convergence-parity contract, bit-for-bit: an int8+EF
+    steady world re-run with HOROVOD_NATIVE=0 (numpy codec, same wire
+    format) must reproduce the same output bytes — hvd_quant8 /
+    hvd_dequant8 change the cost of the codec, never its values."""
+    base = {**_SOCKET_ENV, "HOROVOD_COMPRESSION": "int8"}
+    nat = str(tmp_path / "i8_native.npy")
+    run_scenario("int8_codec_parity", 3, timeout=90.0,
+                 extra_env={**base, "HVD_REACTOR_OUT": nat})
+    ref = str(tmp_path / "i8_numpy.npy")
+    run_scenario("int8_codec_parity", 3, timeout=90.0,
+                 extra_env={**base, "HVD_REACTOR_OUT": ref,
+                            "HOROVOD_NATIVE": "0"})
+    np.testing.assert_array_equal(np.load(nat), np.load(ref))

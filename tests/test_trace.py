@@ -161,7 +161,8 @@ class TestFlightRecorder:
         header, events = lines[0], lines[1:]
         assert header["flight"] == 1 and header["rank"] == 3
         assert header["origin"] == 1 and header["cause"] == "test abort"
-        assert set(header["build"]) == {"version", "native", "knobs"}
+        assert set(header["build"]) == {"version", "native", "knobs",
+                                        "flags"}
         assert [e["ev"] for e in events] == ["cycle", "abort"]
         assert events[1]["arg"] == 1
         assert "rank 1" in events[1]["note"]

@@ -48,8 +48,12 @@ from tools.hvdlint.core import Finding, Project, SourceFile, dotted_name
 NAME = "native-codec"
 
 # hvd_* entry points whose out-params hand malloc'd buffers to Python.
+# The reactor additions: the batched gather spills deviation frames
+# (dev_buf) and the chunked relay spills oversize/deviation payloads
+# (*spill) — both malloc'd in C, freed by the Python caller.
 ALLOCATING = {"hvd_gather_frames", "hvd_recv_into",
-              "hvd_steady_worker", "hvd_steady_coord"}
+              "hvd_steady_worker", "hvd_steady_coord",
+              "hvd_gather_frames_batched", "hvd_relay_frame"}
 
 _DECL_RE = re.compile(
     r"^\s*(?:int|void|int64_t|uint8_t)\s+(hvd_\w+)\s*\(([^;{]*)\)\s*;",
