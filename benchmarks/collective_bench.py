@@ -371,6 +371,109 @@ def _elastic_bench_section(np_: int) -> dict:
     return r
 
 
+SELFOP_SYNC_KEYS = 1024        # model-shaped state: many tensors...
+SELFOP_SYNC_KEY_ELEMS = 16384  # ...of 64 KiB f32 each = 64 MiB total
+SELFOP_SYNC_ITERS = 3
+
+
+def worker_selfop_sync(rank: int, size: int) -> None:
+    """Rejoin-sync section: time ``State.sync()`` over a 1024-tensor,
+    64 MiB model-shaped state — exactly what a rejoiner or a
+    post-resize world pays before its first step. Run in pairs by the
+    driver: the chunked tree-pipelined fast path (HOROVOD_SELFOP_SYNC=1,
+    common/selfop.py) vs the legacy one-shot-per-key negotiated
+    broadcast (=0). The fast leg also reports the
+    hvd_data_copies_total delta across its syncs — the zero-copy
+    claim: no sync byte ever pays a Python bytes-object copy."""
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.common import config as hconfig
+    from horovod_tpu.common import elastic
+
+    hvd.init()
+    vals = {}
+    for i in range(SELFOP_SYNC_KEYS):
+        if rank == 0:
+            vals[f"p{i:03d}"] = np.full(SELFOP_SYNC_KEY_ELEMS,
+                                        float(i + 1), np.float32)
+        else:
+            vals[f"p{i:03d}"] = np.zeros(SELFOP_SYNC_KEY_ELEMS,
+                                         np.float32)
+    state = elastic.State(batch=0, **vals)
+
+    def copies():
+        return hvd.metrics()["local"].get(
+            "hvd_data_copies_total", {}).get("v", 0)
+
+    hvd.barrier(name="ss.warm")
+    c0 = copies()
+    times = []
+    for _ in range(SELFOP_SYNC_ITERS):
+        hvd.barrier(name="ss.bar")
+        t0 = time.perf_counter()
+        state.sync()
+        times.append(time.perf_counter() - t0)
+    c1 = copies()
+    # every member now holds rank 0's values bit-for-bit
+    for i in range(SELFOP_SYNC_KEYS):
+        v = state._values[f"p{i:03d}"]
+        assert float(v[0]) == float(i + 1) and float(v[-1]) == \
+            float(i + 1), (i, v[0], v[-1])
+    _, med, _ = _quantiles(times)
+    if rank == 0:
+        ctx = elastic.context()
+        fast_on = hconfig.env_bool("HOROVOD_SELFOP_SYNC", True)
+        print("RESULT " + json.dumps({
+            "world": size,
+            "state_mib": round(SELFOP_SYNC_KEYS * SELFOP_SYNC_KEY_ELEMS
+                               * 4 / 2**20, 1),
+            "keys": SELFOP_SYNC_KEYS,
+            "sync_ms": round(med * 1e3, 1),
+            "fast_path": bool(fast_on),
+            "fast_syncs": ctx.syncs if ctx is not None else 0,
+            "data_copies_delta": int(c1 - c0),
+        }), flush=True)
+    hvd.shutdown()
+
+
+def _selfop_bench_section(np_: int) -> dict:
+    """`--selfop`: the rejoin-sync A/B — chunked tree-pipelined
+    fast path vs the legacy per-key negotiated broadcast, same
+    64 MiB state, socket plane (the multi-host shape where rejoin
+    cost actually matters)."""
+    base = {
+        "HOROVOD_ELASTIC": "1",
+        "HOROVOD_ELASTIC_WINDOW": "10",
+        "HOROVOD_TPU_SHM": "0",
+        "HOROVOD_TPU_METRICS": "1",
+        # The legacy leg is 1024 back-to-back broadcasts — enough
+        # telemetry for the supervision policy to demote whichever
+        # rank habitually arrives last. Benching, not training:
+        # park the demotion trigger out of reach.
+        "HOROVOD_SELFOP_DEMOTE_WINDOW": "1000000000",
+    }
+    fast = _run_world(
+        "selfop_sync", np_, timeout=300.0,
+        extra_env=dict(base, HOROVOD_SELFOP_SYNC="1"))
+    legacy = _run_world(
+        "selfop_sync", np_, timeout=600.0,
+        extra_env=dict(base, HOROVOD_SELFOP_SYNC="0"))
+    assert fast["fast_syncs"] >= SELFOP_SYNC_ITERS, fast
+    assert legacy["fast_syncs"] == 0, legacy
+    speedup = round(legacy["sync_ms"] / max(fast["sync_ms"], 1e-9), 2)
+    return {
+        "world": np_,
+        "state_mib": fast["state_mib"],
+        "keys": fast["keys"],
+        "fast_sync_ms": fast["sync_ms"],
+        "legacy_sync_ms": legacy["sync_ms"],
+        "speedup": speedup,
+        "meets_3x": speedup >= 3.0,
+        "fast_data_copies_delta": fast["data_copies_delta"],
+        "zero_copy_clean": fast["data_copies_delta"] == 0,
+    }
+
+
 CACHE_BENCH_TENSORS = 64       # 4 KiB grads per steady-state step
 CACHE_BENCH_STEPS = 100
 CACHE_BENCH_GAP_S = 0.005      # simulated per-step compute (backward)
@@ -2403,7 +2506,8 @@ def main() -> None:
                              "elastic", "compression",
                              "compression_autotune", "overlap",
                              "trace_toggle", "multitenant",
-                             "kernel_gather", "kernel_relay"])
+                             "kernel_gather", "kernel_relay",
+                             "selfop_sync"])
     ap.add_argument("--rank", type=int)
     ap.add_argument("--size", type=int)
     ap.add_argument("--skip-variants", action="store_true",
@@ -2453,6 +2557,13 @@ def main() -> None:
                          "simultaneous-pair protocols; plus the "
                          "in-process native int8 codec timing) and "
                          "merge it into RESULTS_cpu.json")
+    ap.add_argument("--selfop", action="store_true",
+                    help="run just the self-operation rejoin-sync A/B "
+                         "(chunked tree-pipelined State.sync vs the "
+                         "legacy per-key negotiated broadcast over "
+                         "the same 64 MiB model-shaped state, socket "
+                         "plane; zero-copy delta recorded) and merge "
+                         "it into RESULTS_cpu.json")
     ap.add_argument("--compression", action="store_true",
                     help="run just the wire-compression/two-level "
                          "grid ((algorithm x dtype x bucket) medians "
@@ -2478,6 +2589,7 @@ def main() -> None:
          "multitenant": worker_multitenant,
          "kernel_gather": worker_kernel_gather,
          "kernel_relay": worker_kernel_relay,
+         "selfop_sync": worker_selfop_sync,
          "overhead": worker_overhead}[args.worker](
              args.rank, args.size)
         return
@@ -2505,6 +2617,30 @@ def main() -> None:
             json.dump(merged, fh, indent=2)
             fh.write("\n")
         print(f"merged elastic_recovery into {results_path}")
+        return
+
+    if args.selfop:
+        np_so = min(np_, 4)
+        mib = SELFOP_SYNC_KEYS * SELFOP_SYNC_KEY_ELEMS * 4 // 2**20
+        print(f"== self-operation rejoin sync A/B (np={np_so}, "
+              f"{SELFOP_SYNC_KEYS}-key {mib} MiB state, socket "
+              f"plane) ==", flush=True)
+        so = _selfop_bench_section(np_so)
+        print(f"  fast {so['fast_sync_ms']} ms   legacy "
+              f"{so['legacy_sync_ms']} ms   speedup {so['speedup']}x "
+              f"(>=3x pass={so['meets_3x']})   data-copies delta "
+              f"{so['fast_data_copies_delta']} "
+              f"(clean={so['zero_copy_clean']})", flush=True)
+        try:
+            with open(results_path) as fh:
+                merged = json.load(fh)
+        except (OSError, ValueError):
+            merged = {}
+        merged["selfop"] = so
+        with open(results_path, "w") as fh:
+            json.dump(merged, fh, indent=2)
+            fh.write("\n")
+        print(f"merged selfop into {results_path}")
         return
 
     if args.multitenant:

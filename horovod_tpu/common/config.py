@@ -346,6 +346,18 @@ class Config:
     elastic_join_addr: str = ""
     elastic_join_port: int = 0
 
+    # Self-operation (docs/fault_tolerance.md, common/selfop.py):
+    # telemetry-driven supervision, preemption drains, the data-plane
+    # rejoin sync and async in-cycle checkpoints. Like lockdep and the
+    # flight recorder, the HOROVOD_SELFOP* / HOROVOD_PREEMPT* knobs
+    # are deliberately NOT Config fields: the supervision policy,
+    # signal handler and checkpoint writer are process-lifetime
+    # singletons that must survive elastic re-inits, so selfop.py
+    # reads them through the env_* helpers at use sites. The launcher
+    # restart budget (HOROVOD_TPU_ELASTIC_RESTARTS) likewise lives in
+    # run/launch.py — it configures the supervising parent, never a
+    # rank.
+
     # Multi-tenant collective service (docs/multitenancy.md,
     # common/tenancy.py). A TENANT sub-world (hvd.create_tenant) gets
     # a nonzero world_id stamped on every control frame and a name

@@ -700,6 +700,63 @@ def _as_buffer(payload):
 _RELAY_CHUNK_BYTES = 256 * 1024
 
 
+def relay_frame_into(up_ch: "network.Channel",
+                     child_chs: List["network.Channel"],
+                     expect_tag: int, out,
+                     timeout_ms: int = -1,
+                     interval_ms: int = -1) -> int:
+    """Receive one exact-fit frame from ``up_ch`` into ``out`` while
+    cut-through forwarding it to every channel in ``child_chs``
+    (hvd_relay_frame, the same native leg the hierarchical control
+    plane rides). Falls back to recv_into + sendv store-and-forward
+    when the native core is absent. Standalone variant of
+    ``_relay_up_to_children`` for ephemeral trees (the elastic rejoin
+    sync in common/selfop.py) that have Channels but no controller.
+    Returns the frame's byte length."""
+    mv = memoryview(network.as_byte_view(out))
+    from horovod_tpu import native as _native
+    lib = _native.get()
+    if child_chs and lib is not None and hasattr(lib, "hvd_relay_frame"):
+        import ctypes as ct
+        win = (ct.c_uint8 * len(mv)).from_buffer(mv) if len(mv) \
+            else (ct.c_uint8 * 1)()
+        child_fds = (ct.c_int * len(child_chs))(
+            *[ch.sock.fileno() for ch in child_chs])
+        secret = up_ch.secret or b""
+        sbuf = (ct.c_uint8 * max(1, len(secret))).from_buffer_copy(
+            secret or b"\x00")
+        skip = (ct.c_uint8 * 1)(0xFF)  # no stray tags on a private tree
+        out_len = ct.c_int64(0)
+        out_tag = ct.c_uint8(0)
+        spill = ct.POINTER(ct.c_uint8)()
+        rc = lib.hvd_relay_frame(
+            up_ch.sock.fileno(), child_fds, len(child_chs), expect_tag,
+            ct.addressof(win), len(mv), sbuf, len(secret),
+            skip, 0, _RELAY_CHUNK_BYTES, timeout_ms, interval_ms,
+            ct.byref(out_len), ct.byref(out_tag), ct.byref(spill))
+        if spill:
+            lib.hvd_free(spill)
+        if rc == 0:
+            return out_len.value
+        if rc == 1:
+            raise ConnectionError(
+                f"frame of {out_len.value} bytes from {up_ch.peer} "
+                f"overflows {len(mv)}-byte relay buffer")
+        if rc == 2:
+            raise ConnectionError(
+                f"expected tag {expect_tag} from {up_ch.peer}, got "
+                f"{out_tag.value}")
+        raise ConnectionError(
+            f"relay from {up_ch.peer} failed: errno {-rc}")
+    tag, n = up_ch.recv_into(mv)
+    if tag != expect_tag:
+        raise ConnectionError(
+            f"expected tag {expect_tag} from {up_ch.peer}, got {tag}")
+    for ch in child_chs:
+        ch.sendv((mv[:n],), expect_tag)
+    return n
+
+
 class Topology:
     """World/local/cross identity of this process
     (reference: global_state.h:95-118)."""

@@ -151,6 +151,11 @@ class ElasticContext:
         self.last_resize_cause = ""
         self.last_rendezvous_s = 0.0
         self._unobserved_rdzv: List[float] = []
+        # rejoin fast-sync observability (common/selfop.py)
+        self.syncs = 0
+        self.sync_bytes_total = 0
+        self.last_sync_s = 0.0
+        self._unobserved_sync: List[Tuple[float, int]] = []
 
     # -- membership ------------------------------------------------------
     @world_coherent
@@ -187,6 +192,17 @@ class ElasticContext:
 
     def take_rendezvous_observations(self) -> List[float]:
         out, self._unobserved_rdzv = self._unobserved_rdzv, []
+        return out
+
+    def note_sync(self, dt_s: float, nbytes: int) -> None:
+        """One completed fast rejoin sync (duration, payload bytes)."""
+        self.syncs += 1
+        self.sync_bytes_total += nbytes
+        self.last_sync_s = dt_s
+        self._unobserved_sync.append((dt_s, nbytes))
+
+    def take_sync_observations(self) -> List[Tuple[float, int]]:
+        out, self._unobserved_sync = self._unobserved_sync, []
         return out
 
     # -- join polling (background loop, coordinator + redirectors) -------
@@ -337,12 +353,13 @@ class _Assignment:
 
     __slots__ = ("generation", "rank", "size", "controller_addr",
                  "controller_port", "listener", "cause", "lost",
-                 "coord_elastic_port")
+                 "coord_elastic_port", "demote_rank", "pace_us")
 
     def __init__(self, generation: int, rank: int, size: int,
                  controller_addr: str, controller_port: int,
                  listener=None, cause: str = "", lost=None,
-                 coord_elastic_port: int = 0):
+                 coord_elastic_port: int = 0, demote_rank: int = -1,
+                 pace_us: int = 0):
         self.generation = generation
         self.rank = rank
         self.size = size
@@ -355,6 +372,27 @@ class _Assignment:
         # re-init fails can re-enter recovery against it even before
         # the full endpoint map arrives via the init handshake.
         self.coord_elastic_port = coord_elastic_port
+        # Supervision verdict riding the resize (common/selfop.py):
+        # the NEW rank of a demoted habitual straggler, and the pacing
+        # every other member applies per cycle (-1/0 = none).
+        self.demote_rank = demote_rank
+        self.pace_us = pace_us
+
+
+def _install_selfop_verdict(generation: int, cause: str,
+                            demote_rank: int, pace_us: int) -> None:
+    """Install the supervision verdict carried by a resize on THIS
+    member. Inputs come exclusively from the coordinator's verdict
+    broadcast (or its own pending decision it just broadcast), so the
+    install is world-coherent by construction. A resize with no
+    decision installs the empty verdict — pacing never leaks across
+    unrelated generations."""
+    from horovod_tpu.common import selfop
+    if demote_rank >= 0:
+        selfop.verdict().install("demote", demote_rank, generation,
+                                 cause, pace_us)
+    else:
+        selfop.verdict().install("", -1, generation, "", 0)
 
 
 def _coordinate_barrier(ctx: ElasticContext, cause: str,
@@ -413,6 +451,21 @@ def _coordinate_barrier(ctx: ElasticContext, cause: str,
     lost = [f"gen:{ctx.membership.generation} rank {r} "
             f"({table[r][0]})"
             for r in sorted(set(table) - set(survivors))]
+    # A pending supervision demotion (common/selfop.py) reorders the
+    # habitual straggler to the survivor tail, where the ring/tree
+    # topologies place the leaf/tail role. The coordinator must keep
+    # slot 0 — the election invariant — so it is never the target.
+    demote_old, demote_new, pace_us = -1, -1, 0
+    from horovod_tpu.common import selfop
+    pol = selfop.policy()
+    if pol is not None:
+        pending = pol.take_pending_demote()
+        if pending is not None and pending[0] in members \
+                and pending[0] != ctx.rank and len(survivors) > 1:
+            demote_old, pace_us = pending
+            survivors = [r for r in survivors if r != demote_old] \
+                + [demote_old]
+            demote_new = len(survivors) - 1
     new_size = len(survivors) + len(joiners)
     gen2 = ctx.membership.generation + 1
     if new_size < ctx.min_world:
@@ -448,7 +501,8 @@ def _coordinate_barrier(ctx: ElasticContext, cause: str,
             ch.send(wire.serialize_elastic_verdict(
                 VERDICT_OK, gen2, nr, new_size, my_host, port, cause,
                 lost=lost, joined=len(joiners),
-                coord_elastic_port=ctx.port), RDZV_TAG)
+                coord_elastic_port=ctx.port, demote_rank=demote_new,
+                pace_us=pace_us), RDZV_TAG)
         except (ConnectionError, OSError):
             # died between manifest and verdict: it will come back (or
             # not) through the join path; the new world forms without
@@ -460,6 +514,7 @@ def _coordinate_barrier(ctx: ElasticContext, cause: str,
     ctx.last_resize_cause = cause
     ctx.last_rendezvous_s = time.monotonic() - t0
     ctx.apply_membership(gen2, 0, new_size, table2, lost=lost)
+    _install_selfop_verdict(gen2, cause, demote_new, pace_us)
     hlog.warning(
         f"elastic re-rendezvous complete: generation {gen2}, "
         f"{len(survivors)} survivor(s) + {len(joiners)} rejoin(s) "
@@ -468,7 +523,8 @@ def _coordinate_barrier(ctx: ElasticContext, cause: str,
         f"cause: {cause}", rank=ctx.rank)
     return _Assignment(gen2, 0, new_size, my_host, port,
                        listener=listener, cause=cause,
-                       coord_elastic_port=ctx.port)
+                       coord_elastic_port=ctx.port,
+                       demote_rank=demote_new, pace_us=pace_us)
 
 
 def _follow_barrier(ctx: ElasticContext, candidate: int,
@@ -522,7 +578,9 @@ def _follow_barrier(ctx: ElasticContext, candidate: int,
         return (v["addr"], v["port"])
     return _Assignment(v["gen"], v["rank"], v["size"], v["addr"],
                        v["port"], cause=v["cause"], lost=v["lost"],
-                       coord_elastic_port=v["coord_elastic_port"])
+                       coord_elastic_port=v["coord_elastic_port"],
+                       demote_rank=v["demote_rank"],
+                       pace_us=v["pace_us"])
 
 
 def rendezvous(origin_rank: int, cause: str) -> _Assignment:
@@ -578,6 +636,8 @@ def rendezvous(origin_rank: int, cause: str) -> _Assignment:
             ctx.apply_membership(res.generation, res.rank, res.size,
                                  _table_placeholder(res, ctx),
                                  lost=res.lost)
+            _install_selfop_verdict(res.generation, res.cause,
+                                    res.demote_rank, res.pace_us)
             hlog.warning(
                 f"elastic re-rendezvous complete: generation "
                 f"{res.generation}, new rank {res.rank} of "
@@ -635,6 +695,8 @@ def join_world(cfg: Config, secret: bytes) -> _Assignment:
             ctx.apply_membership(res.generation, res.rank, res.size,
                                  _table_placeholder(res, ctx),
                                  lost=res.lost)
+            _install_selfop_verdict(res.generation, res.cause,
+                                    res.demote_rank, res.pace_us)
             return res
         if isinstance(res, tuple):
             target = res  # redirect to the live coordinator
@@ -662,6 +724,10 @@ class State:
     def __init__(self, **values):
         object.__setattr__(self, "_values", dict(values))
         object.__setattr__(self, "_committed", copy.deepcopy(values))
+        # Monotonic commit counter: the async checkpoint writer
+        # (common/selfop.py) keys shard files on it so every rank's
+        # shard of one training step shares a sequence number.
+        object.__setattr__(self, "_commit_seq", 0)
 
     def __getattr__(self, name):
         try:
@@ -676,6 +742,9 @@ class State:
         object.__setattr__(self, "_committed",
                            copy.deepcopy(object.__getattribute__(
                                self, "_values")))
+        object.__setattr__(self, "_commit_seq",
+                           object.__getattribute__(
+                               self, "_commit_seq") + 1)
 
     def restore(self) -> None:
         object.__setattr__(self, "_values",
@@ -686,11 +755,27 @@ class State:
         """Broadcast every value from rank 0 (deterministic key order
         on every member) and commit the result. New members pass
         same-shaped placeholders constructed by their own user code —
-        the broadcast overwrites them."""
+        the broadcast overwrites them.
+
+        Large states ride the chunked, tree-pipelined, zero-copy fast
+        path (common/selfop.py); it declines world-consistently (the
+        root broadcasts an empty manifest) below its size floor or
+        when disabled, falling back to the legacy per-key broadcast."""
+        from horovod_tpu.common import selfop
+        if selfop.sync_state(self):
+            return
+        self._sync_broadcast()
+        self.commit()
+
+    def _sync_broadcast(self, keys=None) -> None:
+        """The legacy per-key broadcast leg. ``keys=None`` covers the
+        whole state; the fast path passes just the keys its manifest
+        could not describe (non-contiguous arrays, arbitrary
+        objects). Does NOT commit — the caller owns that."""
         from horovod_tpu import ops
         vals = object.__getattribute__(self, "_values")
         gen = generation()
-        for key in sorted(vals):
+        for key in (sorted(vals) if keys is None else keys):
             v = vals[key]
             out = ops.broadcast(np.asarray(v), root_rank=0,
                                 name=f"elastic.sync.g{gen}.{key}")
@@ -700,7 +785,6 @@ class State:
                 vals[key] = type(v)(out.item())
             else:
                 vals[key] = out
-        self.commit()
 
 
 def _recover(err: WorldAbortedError) -> None:
@@ -752,7 +836,16 @@ def run(func):
     error propagates unchanged — today's fail-fast behavior."""
 
     def wrapper(state: State, *args, **kwargs):
+        from horovod_tpu.common import selfop
         ctx = _ctx
+        # Async checkpointing (common/selfop.py): the runtime's idle
+        # windows persist this state's committed shards; a supervised
+        # restart after a below-min-world death resumes from them.
+        selfop.register_state(state)
+        if object.__getattribute__(state, "_commit_seq") == 0:
+            ckpt_dir = selfop.checkpoint_dir()
+            if ckpt_dir:
+                selfop.restore_state(state, ckpt_dir)
         if ctx is not None and ctx.joined_as_rejoiner \
                 and not ctx._join_synced:
             # A joiner's first act is the SAME State broadcast the
@@ -766,6 +859,11 @@ def run(func):
             except WorldAbortedError as e:
                 if _ctx is None:
                     raise
+                # A preempted member drains to here with its last
+                # commit intact; it retires cleanly (exit 0 — the
+                # launcher never respawns a clean exit) instead of
+                # rejoining the world that is resizing around it.
+                selfop.retire_if_preempted()
                 err = e
                 # Recovery may itself be interrupted — another member
                 # dying during state.sync() or between the verdict and
@@ -776,6 +874,7 @@ def run(func):
                 # propagates; a truly lost world always reaches one,
                 # because every retry re-runs the bounded rendezvous.
                 while True:
+                    selfop.retire_if_preempted()
                     try:
                         _recover(err)
                         state.restore()

@@ -46,6 +46,12 @@ Actions:
   per cycle/op), turning a one-shot hiccup into a sustained
   straggler — the lever the world-trace tests use to pin last-arriver
   attribution on a specific rank.
+- ``preempt`` — SIGTERM this process with ``seconds=S`` of grace
+  (default HOROVOD_PREEMPT_GRACE) before a hard SIGKILL, the cloud
+  spot/preemptible-VM shape. The supervision layer
+  (common/selfop.py) catches the SIGTERM, drains the current step
+  and retires the rank cleanly inside the grace window — the
+  regression lever for the proactive drain-and-resize path.
 
 The module is zero-cost when idle: the runtime's per-cycle/per-op
 ticks return after a single ``_PLAN`` check.
@@ -61,7 +67,7 @@ from typing import List, Optional
 from horovod_tpu.common import config as hconfig
 from horovod_tpu.common import logging as hlog
 
-_ACTIONS = ("kill", "exit", "hang", "sever", "delay")
+_ACTIONS = ("kill", "exit", "hang", "sever", "delay", "preempt")
 
 
 class Fault:
@@ -231,6 +237,25 @@ def _apply(fault: Fault, runtime, rank: Optional[int] = None) -> None:
         time.sleep(fault.ms / 1000.0)
     elif fault.action == "sever" and runtime is not None:
         runtime.controller.sever_connection(fault.target)
+    elif fault.action == "preempt":
+        # A real preemption notice: SIGTERM now, SIGKILL after the
+        # grace window. The timer backstop fires even if nothing
+        # handles the SIGTERM — exactly the cloud contract.
+        import threading
+        from horovod_tpu.common import selfop
+        grace = fault.seconds if fault.seconds != 60.0 else \
+            hconfig.env_float("HOROVOD_PREEMPT_GRACE", 30.0)
+        t = threading.Timer(grace, os.kill,
+                            args=(os.getpid(), signal.SIGKILL))
+        t.daemon = True
+        t.start()
+        if selfop.install_signal_handler():
+            os.kill(os.getpid(), signal.SIGTERM)
+        else:
+            # The tick runs on the background loop, not the main
+            # thread — the handler may be uninstallable. Arm the
+            # drain flag directly; the semantics are identical.
+            selfop.notice_preemption()
 
 
 def _tick(runtime, cycle: Optional[int], op: Optional[int]) -> None:

@@ -33,6 +33,7 @@ from horovod_tpu.common import threadcheck
 from horovod_tpu.common import logging as hlog
 from horovod_tpu.common import metrics as hmetrics
 from horovod_tpu.common import overlap as hoverlap
+from horovod_tpu.common import selfop
 from horovod_tpu.common import steady as hsteady
 from horovod_tpu.common import trace as htrace
 from horovod_tpu.common import wire
@@ -490,6 +491,25 @@ class Runtime:
             "hvd_elastic_rendezvous_seconds",
             "wall time from entering elastic recovery to holding a "
             "new world assignment")
+        # -- self-operation (HOROVOD_SELFOP, common/selfop.py) -------
+        # Policy is process-lifetime (decision counters and demotion
+        # memory span generations); the runtime wires its telemetry
+        # and wake event into it each re-init.
+        self._selfop_policy = selfop.ensure_policy(controller.rank)
+        self._selfop_last_tick = 0.0
+        selfop.install_signal_handler(self._wake.set)
+        self._selfop_decision_metrics: Dict[str, object] = {}
+        self._m_sync_s = reg.histogram(
+            "hvd_rejoin_sync_seconds",
+            "wall time of each fast rejoin state sync "
+            "(common/selfop.py chunked tree broadcast)")
+        self._m_sync_bytes = reg.counter(
+            "hvd_rejoin_sync_bytes_total",
+            "payload bytes this rank moved through fast rejoin syncs")
+        self._m_ckpt_age = reg.gauge(
+            "hvd_checkpoint_age_seconds",
+            "age of this rank's newest committed async checkpoint "
+            "shard (-1 before the first write)")
         # The fused speculative cycle bypasses OperationManager, so the
         # runtime owns its share of the allreduce op/byte totals (the
         # registry memoizes by name — these are the SAME counters the
@@ -1645,6 +1665,34 @@ class Runtime:
         t0 = time.monotonic()
         self._cycle_count += 1
         faults.tick_cycle(self, self._cycle_count)
+        # Demote-verdict pacing: every member EXCEPT the demoted
+        # straggler defers a hair (mirroring the delay-fault injection
+        # point), so gather arrivals cluster instead of the world
+        # blocking inside the collective on one late rank.
+        pace = selfop.cycle_pace_s(self.controller.rank)
+        if pace > 0.0:
+            time.sleep(pace)
+        if self._elastic is not None \
+                and (t0 - self._selfop_last_tick >= 1.0
+                     or selfop.preempted()):
+            # Supervision tick: preemption notices on every rank,
+            # straggler-demotion analysis on the coordinator. A
+            # verdict fans the SAME benign world abort the elastic
+            # join sweep uses — the decision is enacted by the next
+            # rendezvous barrier. An already-armed preemption event
+            # skips the throttle: the grace clock is running, every
+            # cycle spent not draining is budget lost.
+            self._selfop_last_tick = t0
+            decision = self._selfop_policy.tick(self)
+            if decision is not None:
+                cause, origin = decision
+                cause = (f"selfop-{cause}: supervision policy "
+                         f"drain-and-resize")
+                err = WorldAbortedError(
+                    world_abort_message(origin, cause),
+                    origin_rank=origin, cause=cause)
+                err.resolved = True  # deliberate: drain, then resize
+                raise err
         if self._elastic is not None \
                 and t0 - self._elastic_last_poll >= 0.25:
             # Elastic join sweep: the coordinator parks any join
@@ -1911,6 +1959,14 @@ class Runtime:
             ramp = (cycle_time_ms / 1000.0
                     * (self._idle_cycles - self._IDLE_GRACE))
             sleep_s = max(sleep_s, min(backoff_s, ramp))
+        # Async checkpoint shards ride the idle/hold windows the pacing
+        # machinery already bounds: the submit is a pool handoff, the
+        # serialization runs on the checkpoint writer thread while this
+        # loop sleeps (common/selfop.py; no-op without
+        # HOROVOD_SELFOP_CKPT_DIR).
+        selfop.maybe_checkpoint(self.controller.rank,
+                                self.controller.size,
+                                idle=idle_hold or sleep_s > 0)
         if sleep_s > 0:
             # Wake early on shutdown OR new local work (enqueue sets
             # _wake) so backoff never adds submit latency.
@@ -2368,6 +2424,22 @@ class Runtime:
                 self._elastic.rejoins_admitted)
             for v in self._elastic.take_rendezvous_observations():
                 self._m_rdzv_s.observe(v)
+            self._m_sync_bytes.set_total(
+                self._elastic.sync_bytes_total)
+            for dt_s, _ in self._elastic.take_sync_observations():
+                self._m_sync_s.observe(dt_s)
+        # Supervision decisions mirror lazily per kind — the series
+        # appears the first time the policy makes that decision.
+        for kind, n in selfop.decision_counts().items():
+            m = self._selfop_decision_metrics.get(kind)
+            if m is None:
+                m = self.metrics.counter(
+                    f'hvd_supervisor_decisions_total{{kind="{kind}"}}',
+                    "supervision-policy decisions this process made "
+                    "(common/selfop.py)")
+                self._selfop_decision_metrics[kind] = m
+            m.set_total(n)
+        self._m_ckpt_age.set(selfop.checkpoint_age_s())
         self._m_cycles.set_total(self._cycle_count)
         self._m_cached_cycles.set_total(self._cached_cycles)
         self._m_spec_cycles.set_total(self._spec_cycles)
@@ -2461,6 +2533,9 @@ class Runtime:
                 f"/{_wd.WIRE_NAMES.get(w, w)}")
         if self._elastic is not None:
             parts.append(self._elastic.world_line())
+        selfop_line = self._selfop_policy.status_line()
+        if selfop_line:
+            parts.append(selfop_line)
         ages = self.controller.peer_heartbeat_ages()
         if ages:
             # Ages are last-frame-to-now durations measured on THIS

@@ -61,13 +61,13 @@ from horovod_tpu.common import threadcheck
 from horovod_tpu.common import logging as hlog
 from horovod_tpu.common.wire import (
     EV_ABORT, EV_CYCLE, EV_ELASTIC, EV_FAULT, EV_MARK, EV_NAMES,
-    EV_STALL, EV_TEARDOWN, SPAN_MARK, SPAN_SLICE,
+    EV_SELFOP, EV_STALL, EV_TEARDOWN, SPAN_MARK, SPAN_SLICE,
     combine_trace_frames, parse_trace_frame, serialize_trace_frame,
 )
 
 __all__ = [
     "EV_CYCLE", "EV_ABORT", "EV_ELASTIC", "EV_STALL", "EV_FAULT",
-    "EV_TEARDOWN", "EV_MARK", "ClockSync", "TraceCollector",
+    "EV_TEARDOWN", "EV_MARK", "EV_SELFOP", "ClockSync", "TraceCollector",
     "NOOP_TRACE", "FlightRecorder", "NOOP_RECORDER", "flight",
     "clock", "StragglerTracker", "WorldTraceWriter",
     "install_sigusr2", "serialize_trace_frame", "parse_trace_frame",
@@ -594,6 +594,18 @@ class StragglerTracker:
                     gauge.set(lag)
         _, counter = self._peer_metrics(last_rank)
         counter.inc()
+
+    def window_stats(self) -> Dict[str, object]:
+        """Snapshot of the attribution window for the supervision
+        policy (common/selfop.py): gather count, window occupancy,
+        per-rank last-arriver counts and worst lags."""
+        with self._lock:
+            return {
+                "window": len(self._window),
+                "gathers": self._gathers,
+                "last_counts": dict(self._last_counts),
+                "max_lag": dict(self._max_lag),
+            }
 
     def report_line(self) -> str:
         """'rank 3 last-arriver in 84% of the last 1000 gathers

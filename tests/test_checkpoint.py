@@ -36,7 +36,8 @@ def test_async_save_snapshot_ordering_and_prune(tmp_path, hvd_world):
     save_checkpoint(d, {"w": np.full(4, 3.0, np.float32)}, step=3,
                     keep=2)
     wait_pending_saves()
-    assert sorted(os.listdir(d)) == ["step_2", "step_3"]
+    assert sorted(n for n in os.listdir(d)
+                  if not n.endswith(".digest")) == ["step_2", "step_3"]
     assert latest_checkpoint(d).endswith("step_3")
 
 
@@ -125,6 +126,57 @@ def test_failed_save_leaves_no_partial_step(tmp_path, hvd_world,
     r = restore_checkpoint(d, target={"w": np.zeros(2, np.float32)},
                            broadcast=False)
     np.testing.assert_allclose(np.asarray(r["w"]), 1.0)
+
+
+def test_digest_sidecar_written_and_verifies(tmp_path, hvd_world):
+    """Every visible step_<n> carries a digest sidecar; verification
+    passes on intact checkpoints and on pre-digest ones (no sidecar)."""
+    from horovod_tpu.utils import checkpoint as ck
+    d = str(tmp_path / "ckd")
+    p = save_checkpoint(d, {"w": np.ones(3, np.float32)}, step=1)
+    assert os.path.exists(p + ".digest")
+    assert ck.verify_checkpoint(p)
+    os.remove(p + ".digest")          # a pre-digest checkpoint
+    assert ck.verify_checkpoint(p)    # stays restorable
+
+
+def test_kill_mid_write_torn_checkpoint_is_skipped(tmp_path, hvd_world):
+    """A checkpoint whose bytes changed after its digest was recorded
+    (torn write, bit rot, a kill mid-rename) is skipped by latest and
+    refused by a direct restore."""
+    import pytest
+    from horovod_tpu.utils import checkpoint as ck
+    d = str(tmp_path / "ckk")
+    save_checkpoint(d, {"w": np.full(2, 1.0, np.float32)}, step=1)
+    p2 = save_checkpoint(d, {"w": np.full(2, 2.0, np.float32)}, step=2)
+
+    # corrupt step_2's content behind its digest — what a kill between
+    # the backend write and a later torn overwrite leaves behind
+    victim = p2 if os.path.isfile(p2) else \
+        os.path.join(p2, sorted(os.listdir(p2))[0])
+    if os.path.isdir(victim):
+        victim = os.path.join(victim, sorted(os.listdir(victim))[0])
+    with open(victim, "r+b") as f:
+        f.write(b"\x00\xff\x00\xff")
+
+    assert not ck.verify_checkpoint(p2)
+    assert latest_checkpoint(d).endswith("step_1")  # falls back
+    r = restore_checkpoint(d, target={"w": np.zeros(2, np.float32)},
+                           broadcast=False)
+    np.testing.assert_allclose(np.asarray(r["w"]), 1.0)
+    with pytest.raises(ValueError, match="digest"):
+        restore_checkpoint(p2, target={"w": np.zeros(2, np.float32)},
+                           broadcast=False)
+
+
+def test_prune_removes_digest_sidecars(tmp_path, hvd_world):
+    d = str(tmp_path / "ckp")
+    for step in (1, 2, 3):
+        save_checkpoint(d, {"w": np.ones(1, np.float32)}, step=step,
+                        keep=2)
+    names = sorted(os.listdir(d))
+    assert "step_1" not in names and "step_1.digest" not in names
+    assert "step_2.digest" in names and "step_3.digest" in names
 
 
 def test_flax_fallback_backend_roundtrip(tmp_path, hvd_world,

@@ -65,6 +65,7 @@ def test_keras_mnist():
     assert "val" in out.lower() or "loss" in out.lower()
 
 
+@pytest.mark.slow
 def test_jax_synthetic_benchmark():
     out = _run("jax_synthetic_benchmark.py", "--batch-size", "2",
                "--num-warmup-batches", "1", "--num-batches-per-iter", "1",
@@ -108,6 +109,7 @@ def test_torch_imagenet_resnet50(tmp_path):
     assert "epoch 2/2" in out and "epoch 1/2" not in out
 
 
+@pytest.mark.slow
 def test_keras_imagenet_resnet50(tmp_path):
     """ImageNet-scale keras example: warmup + staged-decay callbacks,
     metric averaging, fusion-threshold sweep knob."""
@@ -126,6 +128,7 @@ def test_keras_mnist_advanced():
     assert "lr trajectory" in out and "val_loss" in out
 
 
+@pytest.mark.slow
 def test_keras_spark_training():
     """End-to-end Spark workflow in fake-pyspark demo mode: driver
     dataset -> spark.run training -> driver-side scoring."""

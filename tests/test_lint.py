@@ -856,6 +856,55 @@ def test_world_coherence_real_tenant_descriptor_is_anchored():
                and "world-replicated" in f.message for f in fs), fs
 
 
+# A rank-local mutation of the supervision verdict — the divergence
+# class self-operation must never allow: one rank adopting a demotion
+# (and therefore pacing its cycles) that its peers never saw, instead
+# of installing the descriptor carried by the resize verdict broadcast.
+BAD_SELFOP_COHERENCE = """
+    class SupervisionVerdict:
+        def __init__(self):
+            self.kind = ""  # hvdlint: world-replicated
+            self.pace_us = 0  # hvdlint: world-replicated
+
+        def install(self, kind, pace_us):
+            self.kind = kind
+            self.pace_us = pace_us
+
+    class Policy:
+        def __init__(self):
+            self._verdict = SupervisionVerdict()
+
+        def local_hunch(self, lag_s):
+            # rank-LOCAL source: this rank's own lag estimate, not the
+            # coordinator's broadcast decision
+            self._verdict.install("demote", int(lag_s * 1e6))
+"""
+
+
+def test_world_coherence_fires_on_local_selfop_verdict(tmp_path):
+    fs = _lint_snippet(tmp_path, BAD_SELFOP_COHERENCE,
+                       "world-coherence")
+    msgs = "\n".join(f.message for f in fs)
+    assert "world-replicated" in msgs \
+        and "SupervisionVerdict.install" in msgs, fs
+
+
+def test_world_coherence_real_selfop_verdict_is_anchored():
+    """The REAL SupervisionVerdict.install must carry the
+    @world_coherent anchor — stripping it fails the tree, proving the
+    demotion/pacing descriptor only ever moves on inputs every member
+    received in the same resize verdict."""
+    from tools.hvdlint import world_coherence
+    p = Project([os.path.join(REPO, "horovod_tpu")])
+    qn = "horovod_tpu.common.selfop.SupervisionVerdict.install"
+    assert qn in p.index.functions, sorted(
+        k for k in p.index.functions if "selfop" in k)[:20]
+    p.index.functions[qn].decorators = set()
+    fs = world_coherence.run(p)
+    assert any("SupervisionVerdict" in f.message
+               and "world-replicated" in f.message for f in fs), fs
+
+
 def test_world_coherent_decorator_is_identity():
     from horovod_tpu.common.invariants import world_coherent
 

@@ -333,6 +333,7 @@ def test_tf_broadcast_hook():
     run_scenario("tf_broadcast_hook", 2, timeout=180.0)
 
 
+@pytest.mark.slow
 def test_tf_gather_bcast_grad():
     """Differentiable allgather (variable dim-0) and broadcast
     (root-only gradient), 3 ranks."""
@@ -953,6 +954,155 @@ def test_elastic_disabled_keeps_fail_fast():
         extra_env={**_HB_ENV,
                    "HOROVOD_FAULT_SPEC": "rank=1:kill:op=3"},
         expect_rc={1: _SIGKILL_RC})
+
+
+# -- self-operation (HOROVOD_SELFOP=1, common/selfop.py): the --------
+# supervision policy acting AHEAD of failure — preemption drain,
+# telemetry-driven demotion, and the launcher restart from async
+# checkpoints — docs/fault_tolerance.md "Self-operation".
+
+
+def test_selfop_preempt_drains_before_the_kill():
+    """A ``preempt`` fault SIGTERMs rank 3 with a 45s grace window:
+    the supervision tick drains it out of the world (clean exit 0 —
+    no SIGKILL, no blacklist-worthy death) and the survivors resize
+    to ws=3 with zero lost steps, every post-resize collective
+    bit-exact vs a fresh shrunk world, the resize attributed to
+    the policy."""
+    run_scenario(
+        "selfop_preempt", 4, timeout=120.0,
+        extra_env=dict(
+            _ELASTIC_ENV,
+            HOROVOD_FAULT_SPEC="rank=3:preempt:cycle=40:seconds=45",
+            HOROVOD_PREEMPT_GRACE="45",
+            HOROVOD_TPU_METRICS="1"))
+    # no expect_rc: the preempted rank MUST exit 0 (clean retirement)
+
+
+def test_selfop_demote_habitual_straggler():
+    """A persistent delay fault makes launch rank 1 the last arriver
+    in ~every gather; after the churn cooldown the coordinator demotes
+    it to the ring tail via a same-size resize. Every member installs
+    the identical world-replicated verdict, non-demoted ranks pace
+    their cycle top, and the demoted rank's last-arriver share drops
+    below the trigger threshold — the skew improves."""
+    run_scenario(
+        "selfop_demote", 4, timeout=150.0,
+        extra_env=dict(
+            _ELASTIC_ENV,
+            HOROVOD_FAULT_SPEC=(
+                "rank=1:delay:cycle=5:ms=20:count=1000000"),
+            HOROVOD_SELFOP_DEMOTE_WINDOW="40",
+            # the policy consumes the live telemetry plane: the
+            # straggler attribution window only arms with it
+            HOROVOD_TPU_METRICS="1"))
+
+
+def test_selfop_below_min_world_restart_from_checkpoints():
+    """SIGKILL two of three ranks at the same step — below the min
+    world, nothing to shrink to. The launcher's restart budget
+    (HOROVOD_TPU_ELASTIC_RESTARTS / --restarts) starts a FRESH world
+    which resumes from the async sharded checkpoints at EXACTLY the
+    last committed batch (zero staleness here: the kill lands in an
+    idle window after the shards were cut), and the final params are
+    bit-identical to a never-killed world's."""
+    from horovod_tpu.run.launch import HostBlacklist, run_local_elastic
+
+    with tempfile.TemporaryDirectory() as tmp:
+        script = os.path.join(tmp, "train.py")
+        with open(script, "w") as f:
+            f.write(_SELFOP_RESTART_SCRIPT.format(
+                repo=REPO, tmp=tmp, total=30, k=12))
+        env = {
+            "PYTHONPATH": REPO,
+            "JAX_PLATFORMS": "cpu",
+            "HOROVOD_CYCLE_TIME": "1",
+            "HOROVOD_HEARTBEAT_INTERVAL": "0.3",
+            "HOROVOD_HEARTBEAT_TIMEOUT": "3",
+            "HOROVOD_ELASTIC_WINDOW": "6",
+            "HOROVOD_SELFOP_CKPT_DIR": os.path.join(tmp, "ckpt"),
+            "HOROVOD_SELFOP_CKPT_INTERVAL": "1",
+        }
+        rc = run_local_elastic(
+            3, [sys.executable, script], env=env, min_np=2,
+            blacklist=HostBlacklist(base_s=30.0, retries=0),
+            restarts=1)
+        assert rc == 0, rc
+        for r in (1, 2):
+            assert os.path.exists(
+                os.path.join(tmp, f"killed.{r}.marker")), \
+                "the injected deaths never happened"
+        for r in range(3):
+            assert os.path.exists(os.path.join(tmp, f"done{r}.ok")), \
+                f"rank {r} never finished in the restarted world"
+
+
+_SELFOP_RESTART_SCRIPT = """\
+import faulthandler
+import os
+import sys
+import time
+
+faulthandler.dump_traceback_later(90, exit=True)
+sys.path.insert(0, "{repo}")
+import numpy as np
+import horovod_tpu as hvd
+from horovod_tpu.common import elastic
+
+TOTAL = {total}
+K = {k}
+TMP = "{tmp}"
+launch_rank = os.environ.get("HOROVOD_RANK", "")
+my_marker = os.path.join(TMP, "killed.%s.marker" % launch_rank)
+restarted = os.path.exists(os.path.join(TMP, "killed.1.marker"))
+
+hvd.init()
+state = elastic.State(params=np.zeros(16, np.float32), batch=0)
+
+
+def grad(b, r):
+    return np.full(16, float((r + 1) * (b % 7 + 1)), np.float32)
+
+
+def expected(b, ws):
+    return np.full(16, float(sum(range(1, ws + 1)) * (b % 7 + 1)),
+                   np.float32)
+
+
+@elastic.run
+def train(state):
+    if restarted:
+        # the restarted world resumes from the async shards cut in
+        # the idle window at batch K — nothing newer was committed
+        # before the deaths, so the restore is exact, not just fresh
+        assert state.batch == K, state.batch
+    while state.batch < TOTAL:
+        g = hvd.allreduce(grad(state.batch, hvd.rank()),
+                          average=False, name="eg")
+        np.testing.assert_array_equal(g, expected(state.batch,
+                                                  hvd.size()))
+        state.params = state.params + g
+        state.batch += 1
+        state.commit()
+        if state.batch == K:
+            # idle across >= 3 checkpoint buckets so every rank
+            # persists its shard of the SAME commit seq, then two
+            # ranks die at once: ws=1 < min world -> world lost
+            time.sleep(3.2)
+            if launch_rank in ("1", "2") \\
+                    and not os.path.exists(my_marker):
+                open(my_marker, "w").close()
+                os.kill(os.getpid(), 9)
+
+
+train(state)
+want = np.zeros(16, np.float32)
+for b in range(TOTAL):
+    want = want + expected(b, hvd.size())
+np.testing.assert_array_equal(state.params, want)
+open(os.path.join(TMP, "done%s.ok" % hvd.rank()), "w").close()
+hvd.shutdown()
+"""
 
 
 def test_rank_subset_init():

@@ -1,0 +1,456 @@
+"""Unit tests for self-operation (common/selfop.py): wire codec, the
+host-grouped sync tree, the supervision policy's decision guards, the
+cut-through relay helper, preemption notices, and the async sharded
+checkpoints — everything that doesn't need a real multi-process world
+(tests/test_multiprocess.py covers those)."""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common import controller as hcontroller
+from horovod_tpu.common import elastic, faults, network, selfop, wire
+from horovod_tpu.common.config import Config
+
+
+@pytest.fixture(autouse=True)
+def _clean_selfop_state():
+    yield
+    selfop.reset()
+    elastic.reset()
+    faults.clear()
+
+
+def _cfg(**kw) -> Config:
+    c = Config()
+    c.elastic_enabled = True
+    for k, v in kw.items():
+        setattr(c, k, v)
+    return c
+
+
+# -- wire codec --------------------------------------------------------------
+
+def test_selfop_sync_manifest_roundtrip():
+    arrays = [("w", "<f4", (3, 4)), ("b", "<f8", ())]
+    scalars = [("step", 1, "7"), ("done", 0, "False")]
+    payload = wire.serialize_selfop_sync(
+        "host-a", 0, 5, 1 << 20, "bf16", arrays, scalars, ["opaque"])
+    info = wire.parse_selfop_sync(payload)
+    assert info["gen"] == 5 and info["chunk"] == 1 << 20
+    assert info["compression"] == "bf16"
+    assert info["arrays"] == arrays
+    assert info["scalars"] == scalars
+    assert info["legacy"] == ["opaque"]
+
+
+@pytest.mark.parametrize("cut", [1, 6, 11, 20])
+def test_truncated_sync_manifest_fails_as_transport_error(cut):
+    payload = wire.serialize_selfop_sync(
+        "h", 0, 1, 4096, "none", [("w", "<f4", (2,))], [], [])
+    with pytest.raises(ConnectionError):
+        wire.parse_selfop_sync(payload[:cut])
+
+
+def test_verdict_codec_carries_demotion():
+    payload = wire.serialize_elastic_verdict(
+        elastic.VERDICT_OK, 3, 0, 4, "h", 1, "straggler",
+        demote_rank=3, pace_us=1500)
+    v = wire.parse_elastic_verdict(payload)
+    assert v["demote_rank"] == 3 and v["pace_us"] == 1500
+    # absence is encoded, not implied
+    payload = wire.serialize_elastic_verdict(
+        elastic.VERDICT_OK, 3, 0, 4, "h", 1, "c")
+    v = wire.parse_elastic_verdict(payload)
+    assert v["demote_rank"] == -1 and v["pace_us"] == 0
+
+
+# -- state partitioning and the sync tree ------------------------------------
+
+def test_partition_state_groups_by_wire_describability():
+    values = {
+        "w": np.ones((2, 3), np.float32),
+        "step": 7,
+        "lr": 0.1,
+        "flag": True,
+        "opaque": {"nested": 1},
+        "strided": np.ones((4, 4), np.float32)[:, ::2],
+    }
+    arrays, scalars, legacy = selfop._partition_state(values)
+    assert [k for k, _, _ in arrays] == ["w"]
+    assert sorted(k for k, _, _ in scalars) == ["flag", "lr", "step"]
+    assert sorted(legacy) == ["opaque", "strided"]
+    # scalar codes round-trip through the ctor table
+    for key, stype, rep in scalars:
+        rebuilt = wire._SYNC_SCALAR_CTORS[stype](rep)
+        assert rebuilt == values[key] and type(rebuilt) is type(values[key])
+
+
+def test_host_tree_groups_by_host():
+    table = {0: ("a", 1), 1: ("a", 2), 2: ("b", 3), 3: ("b", 4),
+             4: ("c", 5)}
+    assert selfop._host_tree(0, 5, table) == (-1, [1, 2, 4])
+    assert selfop._host_tree(1, 5, table) == (0, [])
+    assert selfop._host_tree(2, 5, table) == (0, [3])   # host-root of b
+    assert selfop._host_tree(3, 5, table) == (2, [])
+    assert selfop._host_tree(4, 5, table) == (0, [])    # lone host c
+
+
+def test_host_tree_falls_back_to_star_without_host_info():
+    assert selfop._host_tree(0, 4, {}) == (-1, [1, 2, 3])
+    assert selfop._host_tree(2, 4, {}) == (0, [])
+
+
+def test_compress_roundtrip_bf16_and_fp16():
+    src = np.arange(16, dtype=np.float32) * 0.5
+    raw = src.view(np.uint8)
+    for comp in ("bf16", "fp16"):
+        payload = selfop._compress_chunk(raw, comp)
+        assert payload.nbytes == raw.nbytes // 2
+        back = selfop._decompress_chunk(
+            payload.view(np.uint8), comp).view(np.float32)
+        np.testing.assert_allclose(back, src, rtol=1e-2)
+    # exact values representable in both halves round-trip bit-exactly
+    np.testing.assert_array_equal(
+        selfop._decompress_chunk(
+            selfop._compress_chunk(raw, "bf16").view(np.uint8),
+            "bf16").view(np.float32), src)
+
+
+# -- cut-through relay helper ------------------------------------------------
+
+def _channel_pair(secret=b"s3cr3t"):
+    a, b = socket.socketpair()
+    return (network.Channel(a, secret, peer="a"),
+            network.Channel(b, secret, peer="b"))
+
+
+def test_relay_frame_into_forwards_while_receiving():
+    root_tx, mid_rx = _channel_pair()
+    mid_tx, leaf_rx = _channel_pair()
+    payload = np.arange(4096, dtype=np.uint8)
+    out = np.zeros(4096, dtype=np.uint8)
+
+    t = threading.Thread(target=root_tx.sendv,
+                         args=((payload,), selfop.SYNC_TAG))
+    t.start()
+    n = hcontroller.relay_frame_into(mid_rx, [mid_tx],
+                                     selfop.SYNC_TAG, out)
+    t.join()
+    assert n == 4096
+    np.testing.assert_array_equal(out, payload)
+    got = np.zeros(4096, dtype=np.uint8)
+    tag, m = leaf_rx.recv_into(memoryview(got))
+    assert tag == selfop.SYNC_TAG and m == 4096
+    np.testing.assert_array_equal(got, payload)
+    for ch in (root_tx, mid_rx, mid_tx, leaf_rx):
+        ch.close()
+
+
+def test_relay_frame_into_rejects_wrong_tag(monkeypatch):
+    # Force the Python fallback so the tag check is exercised even on
+    # builds without the native relay.
+    from horovod_tpu import native as _native
+    monkeypatch.setattr(_native, "get", lambda: None)
+    tx, rx = _channel_pair()
+    out = np.zeros(16, dtype=np.uint8)
+    t = threading.Thread(target=tx.send, args=(b"x" * 16, 9))
+    t.start()
+    with pytest.raises(ConnectionError, match="tag"):
+        hcontroller.relay_frame_into(rx, [], selfop.SYNC_TAG, out)
+    t.join()
+    tx.close()
+    rx.close()
+
+
+# -- preemption notice -------------------------------------------------------
+
+def test_notice_preemption_sets_flag_and_reset_clears(monkeypatch):
+    monkeypatch.setenv("HOROVOD_PREEMPT_GRACE", "600")  # never fires here
+    assert not selfop.preempted()
+    selfop.notice_preemption()
+    assert selfop.preempted()
+    assert selfop._grace_timer is not None
+    selfop.reset()
+    assert not selfop.preempted()
+    assert selfop._grace_timer is None
+
+
+def test_notice_file_scopes_to_launch_rank(tmp_path, monkeypatch):
+    notice = tmp_path / "preempt"
+    monkeypatch.setenv("HOROVOD_PREEMPT_NOTICE", str(notice))
+    assert not selfop._notice_file_hit(1)   # no file yet
+    notice.write_text("0, 2")
+    assert selfop._notice_file_hit(0)
+    assert selfop._notice_file_hit(2)
+    assert not selfop._notice_file_hit(1)
+    notice.write_text("")                    # empty = whole host
+    assert selfop._notice_file_hit(1)
+
+
+def test_policy_preempt_decision_on_any_rank(monkeypatch):
+    monkeypatch.setenv("HOROVOD_PREEMPT_GRACE", "600")
+    pol = selfop.SupervisionPolicy(rank=3)
+    assert pol.tick() is None
+    selfop.notice_preemption()
+    assert pol.tick() == ("preempt", 3)
+    assert pol.decisions["preempt_drain"] >= 1
+
+
+def test_preempt_fault_spec_parses():
+    (f,) = faults.parse_spec("rank=2:preempt:cycle=40:seconds=5")
+    assert f.action == "preempt" and f.rank == 2
+    assert f.at_cycle == 40 and f.seconds == 5.0
+    with pytest.raises(ValueError):
+        faults.parse_spec("rank=1:preempt:cycle=1:count=3")  # not delay
+
+
+# -- supervision policy: demotion guards -------------------------------------
+
+class _FakeTracker:
+    def __init__(self, window, counts, lags):
+        self._stats = {"window": window, "gathers": window,
+                       "last_counts": counts, "max_lag": lags}
+
+    def window_stats(self):
+        return dict(self._stats)
+
+
+class _FakeController:
+    def __init__(self, ages):
+        self._ages = ages
+
+    def peer_heartbeat_ages(self):
+        return dict(self._ages)
+
+
+class _FakeRuntime:
+    def __init__(self, tracker, ages=None):
+        self._straggler = tracker
+        self.controller = _FakeController(ages or {})
+        self.config = Config()
+
+
+def _armed_policy():
+    """A rank-0 policy with the generation-churn cooldown already
+    served (a fresh context starts a 5 s quiet period)."""
+    elastic.ensure_context(_cfg(), b"")
+    pol = selfop.SupervisionPolicy(rank=0)
+    pol._last_gen = 0
+    pol._last_gen_change = time.monotonic() - 60.0
+    return pol
+
+
+def test_demote_fires_on_habitual_straggler():
+    pol = _armed_policy()
+    rt = _FakeRuntime(_FakeTracker(300, {2: 250, 1: 10}, {2: 0.02}),
+                      ages={2: 0.5})
+    assert pol.tick(rt) == ("demote", -1)
+    worst, pace_us = pol.take_pending_demote()
+    assert worst == 2
+    assert pace_us == 20000  # min(20ms lag, 50ms cap) in microseconds
+    # one demotion per rank per process: never re-fires
+    assert pol.tick(rt) is None
+    assert pol.take_pending_demote() is None
+
+
+def test_demote_guards_hold():
+    pol = _armed_policy()
+    # below the attribution window
+    rt = _FakeRuntime(_FakeTracker(50, {2: 49}, {2: 0.02}))
+    assert pol.tick(rt) is None
+    # below the share threshold
+    rt = _FakeRuntime(_FakeTracker(300, {2: 100, 1: 90}, {2: 0.02}))
+    assert pol.tick(rt) is None
+    # never demote the coordinator
+    rt = _FakeRuntime(_FakeTracker(300, {0: 290}, {0: 0.02}))
+    assert pol.tick(rt) is None
+    # a silent peer is a liveness problem, not a straggler
+    rt = _FakeRuntime(_FakeTracker(300, {2: 290}, {2: 0.02}),
+                      ages={2: 29.0})
+    assert pol.tick(rt) is None
+    assert pol.take_pending_demote() is None
+
+
+def test_demote_respects_generation_churn_cooldown():
+    elastic.ensure_context(_cfg(), b"")
+    pol = selfop.SupervisionPolicy(rank=0)  # fresh: cooldown running
+    rt = _FakeRuntime(_FakeTracker(300, {2: 290}, {2: 0.02}))
+    assert pol.tick(rt) is None
+
+
+def test_cycle_pace_spares_the_demoted_rank():
+    selfop.verdict().install("demote", 2, 4, "straggler", 20000)
+    assert selfop.cycle_pace_s(0) == pytest.approx(0.02)
+    assert selfop.cycle_pace_s(1) == pytest.approx(0.02)
+    assert selfop.cycle_pace_s(2) == 0.0
+    # an empty verdict (non-demote resize) clears pacing everywhere
+    selfop.verdict().install("", -1, 5, "", 0)
+    assert selfop.cycle_pace_s(0) == 0.0
+
+
+def test_verdict_is_marked_world_coherent():
+    assert getattr(selfop.SupervisionVerdict.install,
+                   "__world_coherent__", False)
+    v = selfop.SupervisionVerdict()
+    assert v.line() == ""
+    v.install("demote", 1, 2, "why", 100)
+    assert "demote" in v.line() and "target=1" in v.line()
+
+
+# -- async sharded checkpoints -----------------------------------------------
+
+def _committed_state(**values):
+    s = elastic.State(**values)
+    s.commit()
+    return s
+
+
+def test_shard_write_restore_roundtrip(tmp_path):
+    d = str(tmp_path / "ck")
+    s = _committed_state(w=np.arange(8, dtype=np.float32),
+                         b=np.ones(3), step=11, lr=0.5)
+    committed = s._committed
+    for rank in range(2):
+        selfop._write_shard(committed, seq=1, rank=rank, world=2,
+                            directory=d)
+    fresh = elastic.State(w=np.zeros(8, np.float32), b=np.zeros(3),
+                          step=0, lr=0.0)
+    assert selfop.restore_state(fresh, d) == 1
+    np.testing.assert_array_equal(fresh.w, np.arange(8.0))
+    np.testing.assert_array_equal(fresh.b, np.ones(3))
+    assert fresh.step == 11 and fresh.lr == 0.5
+    assert object.__getattribute__(fresh, "_commit_seq") == 1
+
+
+def test_restore_skips_incomplete_and_torn_sets(tmp_path):
+    d = str(tmp_path / "ck")
+    s = _committed_state(w=np.arange(4, dtype=np.float32), step=1)
+    for rank in range(2):
+        selfop._write_shard(s._committed, 1, rank, 2, d)
+    s2 = _committed_state(w=np.full(4, 9.0, np.float32), step=2)
+    for rank in range(2):
+        selfop._write_shard(s2._committed, 2, rank, 2, d)
+
+    # seq 3: only rank 0's shard exists (kill mid-sequence)
+    selfop._write_shard(s2._committed, 3, 0, 2, d)
+    # seq 2 rank 1: npz corrupted after the digest was recorded
+    npz, _ = selfop._shard_paths(d, 2, 1, 2)
+    with open(npz, "r+b") as fh:
+        fh.seek(0)
+        fh.write(b"\xde\xad\xbe\xef")
+
+    fresh = elastic.State(w=np.zeros(4, np.float32), step=0)
+    assert selfop.restore_state(fresh, d) == 1  # falls back to seq 1
+    np.testing.assert_array_equal(fresh.w, np.arange(4.0))
+    assert fresh.step == 1
+
+
+def test_restore_returns_none_on_empty_or_garbage_dir(tmp_path):
+    fresh = elastic.State(w=np.zeros(2, np.float32))
+    assert selfop.restore_state(fresh, str(tmp_path / "nope")) is None
+    d = tmp_path / "junk"
+    d.mkdir()
+    (d / "shard_s1_r0_of_1.json").write_text("{not json")
+    assert selfop.restore_state(fresh, str(d)) is None
+
+
+def test_shard_prune_keeps_newest_per_rank(tmp_path, monkeypatch):
+    monkeypatch.setenv("HOROVOD_SELFOP_CKPT_KEEP", "2")
+    d = str(tmp_path / "ck")
+    s = _committed_state(w=np.ones(2, np.float32))
+    for seq in (1, 2, 3, 4):
+        selfop._write_shard(s._committed, seq, 0, 1, d)
+    seqs = sorted(int(selfop._SHARD_RE.match(n).group(1))
+                  for n in os.listdir(d) if n.endswith(".json"))
+    assert seqs == [3, 4]
+
+
+def test_maybe_checkpoint_writes_on_idle_and_skips_unchanged(
+        tmp_path, monkeypatch):
+    d = str(tmp_path / "ck")
+    monkeypatch.setenv("HOROVOD_SELFOP_CKPT_DIR", d)
+    monkeypatch.setenv("HOROVOD_SELFOP_CKPT_INTERVAL", "1")
+    from horovod_tpu.utils import checkpoint as uckpt
+
+    s = _committed_state(w=np.arange(4, dtype=np.float32))
+    selfop.register_state(s)
+    selfop.maybe_checkpoint(rank=0, size=1, idle=True)
+    uckpt.wait_pending_saves()
+    assert any(n.endswith(".json") for n in os.listdir(d))
+    assert selfop.checkpoint_age_s() >= 0.0
+    # same commit seq: a later due bucket writes nothing new
+    selfop._ckpt_last_bucket -= 1
+    before = sorted(os.listdir(d))
+    selfop.maybe_checkpoint(rank=0, size=1, idle=True)
+    uckpt.wait_pending_saves()
+    assert sorted(os.listdir(d)) == before
+
+
+def test_checkpoint_age_unknown_before_first_write():
+    assert selfop.checkpoint_age_s() == -1.0
+
+
+# -- launcher world restarts -------------------------------------------------
+
+class _FakeProc:
+    def __init__(self, rc_after=None):
+        self.rc_after = rc_after
+        self.terminated = False
+
+    def poll(self):
+        if self.terminated:
+            return 0
+        if self.rc_after and time.monotonic() >= self.rc_after[0]:
+            return self.rc_after[1]
+        return None
+
+    def terminate(self):
+        self.terminated = True
+        self.rc_after = (0.0, 0)
+
+    def wait(self, timeout=None):
+        return self.poll() or 0
+
+    def kill(self):
+        self.terminate()
+
+
+def test_run_local_elastic_restarts_fresh_world(monkeypatch):
+    from horovod_tpu.run.launch import HostBlacklist, run_local_elastic
+    monkeypatch.setenv("HOROVOD_FAULT_SPEC", "rank=0:kill:cycle=1")
+    worlds = []
+
+    def spawn_fn(slot, env, joiner):
+        if not joiner and slot == 0:
+            worlds.append(dict(env))  # one entry per world attempt
+        if len(worlds) <= 1:
+            # first world: everyone dies hard, below the floor
+            return _FakeProc(rc_after=(time.monotonic() + 0.05, -9))
+        return _FakeProc(rc_after=(time.monotonic() + 0.3, 0))
+
+    rc = run_local_elastic(
+        2, ["train.py"], spawn_fn=spawn_fn, min_np=2, restarts=1,
+        blacklist=HostBlacklist(base_s=30.0, retries=0), poll_s=0.02)
+    assert rc == 0
+    assert len(worlds) == 2
+    # the first world inherited the fault spec; the restarted one must not
+    assert worlds[0].get("HOROVOD_FAULT_SPEC")
+    assert "HOROVOD_FAULT_SPEC" not in worlds[1]
+
+
+def test_run_local_elastic_restart_budget_exhausts():
+    from horovod_tpu.run.launch import HostBlacklist, run_local_elastic
+
+    def spawn_fn(slot, env, joiner):
+        return _FakeProc(rc_after=(time.monotonic() + 0.05, 3))
+
+    rc = run_local_elastic(
+        2, ["train.py"], spawn_fn=spawn_fn, min_np=2, restarts=1,
+        blacklist=HostBlacklist(base_s=30.0, retries=0), poll_s=0.02)
+    assert rc == 3  # two worlds tried, both lost, budget spent
