@@ -260,6 +260,31 @@ class Runtime:
         t = getattr(controller, "topology", None)
         self._multi_host = (t is not None
                             and t.local_size < t.size)
+        # -- ICI-native data plane (HOROVOD_TPU_ICI, ops/xla_ops.py) ---
+        # The fused-psum steady cycle: ALG_ICI-stamped buckets pack/
+        # prescale/cast through ONE pre-compiled XLA executable over
+        # the local device mesh, and the resulting wire buffer rides
+        # the existing compressed socket/ring plane cross-slice. The
+        # capability is world-AND-agreed HERE — a fixed init position
+        # every rank reaches exactly once, right after the controller
+        # handshake — so a single mesh-less rank degrades the verdict
+        # to the socket plane everywhere, together. (HOROVOD_TPU_ICI
+        # itself must be set world-wide, like HOROVOD_TWO_LEVEL.)
+        self._ici_plane = None
+        self._ici_cycles = 0
+        if config.ici_enabled and controller.size > 1:
+            from horovod_tpu.ops.xla_ops import IciPlane
+            plane = IciPlane(config.ici_devices)
+            local_ok = plane.probe()
+            if controller.agree(local_ok):
+                self._ici_plane = plane
+            elif controller.rank == 0:
+                hlog.warning(
+                    "HOROVOD_TPU_ICI=1 degraded to the socket plane: "
+                    "at least one rank has no local multi-device mesh "
+                    "(needs >= 2 devices; set XLA_FLAGS="
+                    "--xla_force_host_platform_device_count=N for a "
+                    "CPU-mesh run)")
         # Algorithm/dtype policy consulted when stamping fused
         # responses (coordinator only): the autotuner when armed
         # (ParameterManager.plan — per-size-bucket tuned table), the
@@ -269,7 +294,8 @@ class Runtime:
             parameter_manager.configure_wire(
                 self._wire_propose, self._multi_host, controller.size,
                 shm_enabled=config.shm_enabled,
-                ring_allowed=config.ring_threshold_bytes >= 0)
+                ring_allowed=config.ring_threshold_bytes >= 0,
+                ici_allowed=self._ici_plane is not None)
             # Overlap bucket count joins the discrete grid (measured
             # between the wire sweep and the BO phase) only when the
             # overlap tier can actually engage on this rank.
@@ -278,7 +304,9 @@ class Runtime:
         else:
             self._wire_policy = _wd.StaticWirePolicy(
                 config.two_level, config.two_level_threshold_bytes,
-                self._multi_host, shm_enabled=config.shm_enabled)
+                self._multi_host, shm_enabled=config.shm_enabled,
+                ici_allowed=self._ici_plane is not None,
+                ici_threshold_bytes=config.ici_threshold_bytes)
             if config.two_level and controller.rank == 0 \
                     and not (self._multi_host and config.shm_enabled):
                 hlog.warning(
@@ -499,6 +527,7 @@ class Runtime:
         self._selfop_last_tick = 0.0
         selfop.install_signal_handler(self._wake.set)
         self._selfop_decision_metrics: Dict[str, object] = {}
+        self._scaling_eff_metrics: Dict[int, object] = {}
         self._m_sync_s = reg.histogram(
             "hvd_rejoin_sync_seconds",
             "wall time of each fast rejoin state sync "
@@ -522,6 +551,8 @@ class Runtime:
         controller.attach_metrics(reg)
         op_manager.attach_metrics(
             reg, lambda: self._world_fusion_threshold)
+        if self._ici_plane is not None:
+            self._ici_plane.attach_metrics(reg)
         # Rank-0 world aggregation + read surfaces: control-tree
         # METRICS frames fold here, exposed over Prometheus HTTP
         # (HOROVOD_TPU_METRICS_PORT), a JSONL snapshot log
@@ -1208,9 +1239,13 @@ class Runtime:
         for resp in plan:
             if resp.response_type != ResponseType.ALLREDUCE:
                 return None
-            if resp.algorithm not in (_wd.ALG_DEFAULT, _wd.ALG_STAR):
+            if resp.algorithm not in (_wd.ALG_DEFAULT, _wd.ALG_STAR,
+                                      _wd.ALG_ICI):
                 # Ring/two-level batches own their data plane; the
-                # speculative round must not steal them.
+                # speculative round must not steal them. ALG_ICI is
+                # admitted on purpose: its intra-slice leg packs on
+                # the mesh BEFORE this very cycle, and its cross-slice
+                # leg IS the speculative star.
                 return None
             if resp.wire_dtype == _wd.WIRE_INT8:
                 # int8 payloads carry per-rank scales the inline
@@ -1236,11 +1271,20 @@ class Runtime:
             splan = self._steady_plan_for(hit_mask, seg_arrays,
                                           seg_wires)
             if splan is not None:
-                # Coordinator accumulators double as the broadcast
-                # result its outputs will alias — fresh, never arena.
-                bufs = splan.pack(
-                    seg_arrays, prescales,
-                    use_arena=not self.controller.is_coordinator)
+                bufs = None
+                if self._ici_plane is not None and any(
+                        resp.algorithm == _wd.ALG_ICI
+                        for resp, _, _ in inflight):
+                    bufs = self._ici_pack(splan, hit_mask, seg_arrays,
+                                          seg_wires, prescales,
+                                          inflight)
+                if bufs is None:
+                    # Coordinator accumulators double as the broadcast
+                    # result its outputs will alias — fresh, never
+                    # arena.
+                    bufs = splan.pack(
+                        seg_arrays, prescales,
+                        use_arena=not self.controller.is_coordinator)
                 if any(seg_wires):
                     from horovod_tpu.ops.socket_ops import (
                         record_compression,
@@ -1254,9 +1298,29 @@ class Runtime:
                 self._spec_bids += 1
                 return splan
         segments = []
-        for (resp, _, arrays) in inflight:
-            fused, _ = _pack_fused(arrays, resp)  # applies prescale
+        ici_segs = 0
+        for j, (resp, _, arrays) in enumerate(inflight):
             w = resp.wire_dtype
+            buf = None
+            if self._ici_plane is not None \
+                    and resp.algorithm == _wd.ALG_ICI:
+                buf = self._ici_pack_segment(
+                    cache.epoch, hit_mask, j, arrays,
+                    resp.prescale_factor, w)
+            if buf is not None:
+                ici_segs += 1
+                if w:
+                    from horovod_tpu.ops.socket_ops import (
+                        record_compression,
+                    )
+                    record_compression(
+                        sum(a.nbytes for a in arrays), buf.nbytes)
+                    segments.append((_wd.wire_datatype(w), buf))
+                else:
+                    segments.append(
+                        (numpy_dtype_to_datatype(buf.dtype), buf))
+                continue
+            fused, _ = _pack_fused(arrays, resp)  # applies prescale
             if w:
                 from horovod_tpu.ops.socket_ops import (
                     compress_send_payload,
@@ -1266,12 +1330,61 @@ class Runtime:
             else:
                 segments.append((numpy_dtype_to_datatype(fused.dtype),
                                  fused))
+        if ici_segs:
+            self._ici_cycles += 1
         self._spec_inflight = inflight
         self._spec_bids += 1
         return self._stamp(wire.serialize_cycle_request(
             CacheCycleRequest(
                 epoch=cache.epoch, nslots=cache.nslots,
                 hit_mask=hit_mask, spec_payload=segments)))
+
+    def _ici_pack_segment(self, epoch: int, hit_mask: int, j: int,
+                          arrays, prescale: float, wire_code: int):
+        """One spec-frame segment through the ICI plane's pre-compiled
+        fused-psum executable (concat + prescale + wire cast on the
+        device mesh); None when the plane cannot carry it — the caller
+        falls back to the host pack for bit-identical bytes."""
+        import numpy as np
+
+        plane = self._ici_plane
+        plane.note_cache_epoch(epoch)
+        flats = [a.reshape(-1) if a.flags["C_CONTIGUOUS"]
+                 else np.ascontiguousarray(a).reshape(-1)
+                 for a in arrays]
+        flat = flats[0] if len(flats) == 1 else np.concatenate(flats)
+        try:
+            return plane.fused_pack((epoch, hit_mask, j), flat,
+                                    prescale, wire_code)
+        except Exception as e:
+            # A mid-flight device failure must degrade, not abort: the
+            # host pack produces byte-identical wire payloads.
+            hlog.warning(f"ICI fused pack failed; falling back to the "
+                         f"host pack for this cycle: {e!r}")
+            return None
+
+    def _ici_pack(self, splan, hit_mask: int, seg_arrays, seg_wires,
+                  prescales, inflight):
+        """Pack a whole native steady frame on the ICI plane: every
+        segment must both be stamped ALG_ICI and survive the mesh leg,
+        and the plan must adopt the buffers byte-compatibly; any
+        deviation returns None and SteadyPlan.pack carries the cycle
+        on the host, bit-identically."""
+        epoch = self._cache.epoch
+        bufs = []
+        for j, (arrays, pre) in enumerate(zip(seg_arrays, prescales)):
+            resp = inflight[j][0]
+            if resp.algorithm != _wd.ALG_ICI:
+                return None  # mixed-verdict frame: keep packs uniform
+            buf = self._ici_pack_segment(epoch, hit_mask, j, arrays,
+                                         pre, seg_wires[j])
+            if buf is None:
+                return None
+            bufs.append(buf)
+        adopted = splan.adopt_packed(bufs)
+        if adopted is not None:
+            self._ici_cycles += 1
+        return adopted
 
     def _steady_plan_for(self, hit_mask: int, seg_arrays, seg_wires):
         """Memoized SteadyPlan for (mask, threshold) at the current
@@ -2117,6 +2230,13 @@ class Runtime:
                 f"cannot continue safely")
         if meta.spec_payload is not None:
             return self._complete_spec_cycle(meta, bit_requests)
+        # Epoch-coupled compiled state in the backends (the XLA mesh
+        # executable cache) evicts at this broadcast-driven position —
+        # one int compare per cycle; a bump lands one cycle after
+        # _populate_cache moves the epoch, which is fine because the
+        # executables are KEYED correctly (verdict + shapes) and the
+        # eviction is hygiene.
+        self.op_manager.note_cache_epoch(cache.epoch)
         inner = meta.response_list
         if meta.invalid_mask:
             cache.evict_slots(meta.invalid_mask)
@@ -2440,6 +2560,19 @@ class Runtime:
                 self._selfop_decision_metrics[kind] = m
             m.set_total(n)
         self._m_ckpt_age.set(selfop.checkpoint_age_s())
+        # Scaling efficiencies mirror lazily per world size, same
+        # doctrine: the series appears once something measured one
+        # (the MULTICHIP harness, or an operator calibration pass).
+        for n, eff in hmetrics.scaling_efficiencies().items():
+            g = self._scaling_eff_metrics.get(n)
+            if g is None:
+                g = self.metrics.gauge(
+                    f'hvd_scaling_efficiency{{world_size="{n}"}}',
+                    "measured throughput fraction of ideal linear "
+                    "scaling at this world size (fed by "
+                    "__graft_entry__.run_multichip)")
+                self._scaling_eff_metrics[n] = g
+            g.set(eff)
         self._m_cycles.set_total(self._cycle_count)
         self._m_cached_cycles.set_total(self._cached_cycles)
         self._m_spec_cycles.set_total(self._spec_cycles)
@@ -2528,9 +2661,15 @@ class Runtime:
             parts.append(line)
         if self._last_wire_verdict is not None:
             alg, w = self._last_wire_verdict
-            parts.append(
-                f"wire plan {_wd.ALG_NAMES.get(alg, alg)}"
-                f"/{_wd.WIRE_NAMES.get(w, w)}")
+            line = (f"wire plan {_wd.ALG_NAMES.get(alg, alg)}"
+                    f"/{_wd.WIRE_NAMES.get(w, w)}")
+            if self._ici_plane is not None:
+                # Whether the mesh leg is actually carrying cycles —
+                # an ici verdict with 0 mesh cycles means every pack
+                # fell back to the host path (see troubleshooting.md).
+                line += (f" (ici mesh {self._ici_plane.ndev} devices, "
+                         f"{self._ici_cycles} cycles)")
+            parts.append(line)
         if self._elastic is not None:
             parts.append(self._elastic.world_line())
         selfop_line = self._selfop_policy.status_line()
@@ -2573,6 +2712,9 @@ class Runtime:
                 "spec_cycles": self._spec_cycles,
                 "spec_bids": self._spec_bids,
                 "native_steady_cycles": self._native_steady_cycles,
+                "ici_cycles": self._ici_cycles,
+                "ici_compiles": (self._ici_plane.compiles
+                                 if self._ici_plane is not None else 0),
                 "overlap_cycles": self._overlap_cycles,
                 "overlap_inflight": (self._overlap.outstanding
                                      if self._overlap is not None

@@ -212,6 +212,32 @@ class SteadyPlan:
             bufs.append(dst)
         return bufs
 
+    def adopt_packed(self, bufs: List[np.ndarray]):
+        """Adopt per-segment send buffers packed OUTSIDE this plan —
+        the ICI plane's fused-psum executable emits the bucket already
+        concatenated, prescaled and cast to the wire dtype (ops/
+        xla_ops.py IciPlane.fused_pack). Validates that each buffer is
+        byte-compatible with the segment the wire header declares and
+        returns the list ready for the steady cycle; None on any
+        mismatch so the caller re-packs on the host path instead of
+        shipping a malformed frame. Foreign buffers deliberately do
+        NOT alias the arena views: run_worker_cycle rebuilds its send
+        pointers for them and skips the deferred chunked cast (the
+        payload is already in wire form)."""
+        if len(bufs) != self.nseg:
+            return None
+        out = []
+        for j, b in enumerate(bufs):
+            if b is None or not isinstance(b, np.ndarray):
+                return None
+            if b.dtype != self.seg_np_dtypes[j] \
+                    or b.nbytes != self.seg_nbytes[j]:
+                return None
+            if not b.flags["C_CONTIGUOUS"]:
+                b = np.ascontiguousarray(b)
+            out.append(b)
+        return out
+
     def materialize_wire(self) -> None:
         """Deferred-cast fallback: fill the wire views from staging —
         exactly the bytes the chunked native send would have produced
