@@ -38,6 +38,8 @@ from __future__ import annotations
 import json
 import os
 
+from horovod_tpu.compat import jaxshim
+
 BASELINE_IMG_PER_SEC_PER_DEVICE = 103.55
 
 # Peak dense bf16 FLOPs per chip by TPU generation (public specs).
@@ -133,8 +135,8 @@ def _bench_transformer(n_dev: int) -> dict:
 
     from jax.sharding import PartitionSpec as P
     rep = P()
-    step = jax.shard_map(step, mesh=mesh, in_specs=(rep, rep, P("data")),
-                         out_specs=(rep, rep, rep), check_vma=False)
+    step = jaxshim.shard_map(step, mesh=mesh, in_specs=(rep, rep, P("data")),
+                         out_specs=(rep, rep, rep))
     train = jax.jit(step, donate_argnums=(0, 1)).lower(
         params, opt_state, tokens).compile()
     hw_flops = None
@@ -250,10 +252,10 @@ def main() -> None:
     # cross-replica batchnorm — the same program a multi-chip run jits.
     from jax.sharding import PartitionSpec as P
     rep = P()
-    step_body = jax.shard_map(
+    step_body = jaxshim.shard_map(
         step_body, mesh=mesh,
         in_specs=(rep, rep, rep, P("data"), P("data")),
-        out_specs=(rep, rep, rep, rep), check_vma=False)
+        out_specs=(rep, rep, rep, rep))
 
     # Donated buffers: params/batch_stats/opt_state update in place —
     # no spare HBM copy of the weights per step. Compile ONCE via the

@@ -22,6 +22,8 @@ from horovod_tpu import spmd
 from horovod_tpu.models.transformer import TransformerConfig, TransformerLM
 from horovod_tpu.parallel import Trainer, TrainerConfig
 
+from horovod_tpu.compat import jaxshim
+
 
 def zero1_demo():
     n = len(jax.devices())
@@ -43,12 +45,11 @@ def zero1_demo():
         u, s = tx.update(g, s, p)
         return optax.apply_updates(p, u), s, loss
 
-    step = jax.jit(jax.shard_map(
+    step = jax.jit(jaxshim.shard_map(
         step, mesh=mesh, in_specs=(P(), specs, P("data"), P("data")),
-        out_specs=(P(), specs, P()), check_vma=False))
-    state = jax.jit(jax.shard_map(
-        tx.init, mesh=mesh, in_specs=(P(),), out_specs=specs,
-        check_vma=False))(params)
+        out_specs=(P(), specs, P())))
+    state = jax.jit(jaxshim.shard_map(
+        tx.init, mesh=mesh, in_specs=(P(),), out_specs=specs))(params)
 
     for i in range(30):
         params, state, loss = step(params, state, X, y)

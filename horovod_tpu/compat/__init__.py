@@ -1,0 +1,7 @@
+"""Version-compat shims. ``jaxshim`` is the single sanctioned module
+for JAX mesh/sharding construction — see docs/static_analysis.md
+(jax_compat analyzer) for the policy."""
+
+from horovod_tpu.compat import jaxshim
+
+__all__ = ["jaxshim"]

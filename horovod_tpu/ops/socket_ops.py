@@ -352,21 +352,24 @@ class SocketBackend(CollectiveBackend):
         names = [e.tensor_name for e in entries]
         multi = len(entries) > 1  # single-tensor pack is a view
         nbytes = sum(a.nbytes for a in arrays)
-        # Arena packing only for star-bound batches: the ring mutates
-        # its buffer in place AND returns it as the result, so a
-        # ring-bound pack must stay a per-op buffer outputs may alias.
-        use_arena = self._zero_copy and self.fused_cycle_reducible(
-            nbytes)
+        # Route BEFORE packing: large payloads ride the ring (every
+        # rank computes the same negotiated size against the same
+        # threshold AND the same coordinator-stamped algorithm, so the
+        # path choice is world-consistent). Routing uses UNCOMPRESSED
+        # bytes on purpose — the wire dtype must not flip the route.
+        ring = self._ring_for(nbytes, response.algorithm)
+        # Arena packing only for batches that actually stay off the
+        # ring: the uncompressed ring mutates its buffer in place AND
+        # returns it as the result, so a ring-bound pack must stay a
+        # per-op buffer outputs may alias — a size heuristic alone is
+        # not enough, because a stamped ALG_RING (the autotuner
+        # exploring) forces small batches onto the ring too, and an
+        # arena-aliased output is then silently overwritten by the
+        # next op's pack.
+        use_arena = self._zero_copy and ring is None
         with self.activity(names, ACT_MEMCPY_IN_FUSION_BUFFER, multi):
             fused, fresh = _pack_fused(
                 arrays, response, self._arena if use_arena else None)
-
-        # Large payloads ride the ring (every rank computes the same
-        # negotiated size against the same threshold AND the same
-        # coordinator-stamped algorithm, so the path choice is
-        # world-consistent). Routing uses UNCOMPRESSED bytes on
-        # purpose — the wire dtype must not flip the route.
-        ring = self._ring_for(fused.nbytes, response.algorithm)
         (self._m_ring_ops if ring is not None
          else self._m_star_ops).inc()
         wire = response.wire_dtype

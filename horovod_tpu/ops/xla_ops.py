@@ -44,17 +44,11 @@ _ICI_AXIS = "ici"
 def _shard_map(body, mesh, in_specs, out_specs):
     """Version-portable shard_map with the replication checker off
     (collectives guarantee their own output sharding; the static
-    checker cannot see that). jax >= 0.5 hoists shard_map to the top
-    level with ``check_vma``; older releases keep it in experimental
-    with ``check_rep``."""
-    import jax
-    fn = getattr(jax, "shard_map", None)
-    if fn is not None:
-        return fn(body, mesh=mesh, in_specs=in_specs,
-                  out_specs=out_specs, check_vma=False)
-    from jax.experimental.shard_map import shard_map as xfn
-    return xfn(body, mesh=mesh, in_specs=in_specs,
-               out_specs=out_specs, check_rep=False)
+    checker cannot see that). The version gate lives in the sanctioned
+    compat shim."""
+    from horovod_tpu.compat import jaxshim
+    return jaxshim.shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs)
 
 
 def ragged_psum_wins(sizes, slice_numels, world_size: int) -> bool:
@@ -148,7 +142,7 @@ class XlaMeshBackend(CollectiveBackend):
                     f"{jax.process_index()}; disabling the XLA mesh "
                     "backend (collectives fall back to the socket path).")
                 return False
-            from jax.sharding import Mesh
+            from horovod_tpu.compat import jaxshim
             # One representative device per process, ordered by the
             # horovod rank == jax process index contract established by
             # the launcher (run/launch.py exports both).
@@ -157,7 +151,7 @@ class XlaMeshBackend(CollectiveBackend):
                 by_proc.setdefault(d.process_index, []).append(d)
             reps = [sorted(by_proc[p], key=lambda d: d.id)[0]
                     for p in sorted(by_proc)]
-            self._mesh = Mesh(np.array(reps), (_AXIS,))
+            self._mesh = jaxshim.make_raw_mesh(np.array(reps), (_AXIS,))
             self._my_device = reps[jax.process_index()]
             self._maybe_build_hierarchical_mesh(reps)
             return True
@@ -178,7 +172,7 @@ class XlaMeshBackend(CollectiveBackend):
         order, which the contiguous per-host rank layout guarantees.
         Other rank-ordered ops (alltoall, broadcast roots) stay on the
         flat mesh where slot r is unambiguously rank r."""
-        from jax.sharding import Mesh
+        from horovod_tpu.compat import jaxshim
         cfg = self._config
         topo = self._ctl.topology
         if cfg is None or topo is None or not (
@@ -196,7 +190,7 @@ class XlaMeshBackend(CollectiveBackend):
                          "contiguously per host")
             return
         grid = np.array(reps).reshape(topo.cross_size, topo.local_size)
-        self._mesh2d = Mesh(grid, ("cross", "local"))
+        self._mesh2d = jaxshim.make_raw_mesh(grid, ("cross", "local"))
 
     def _ensure_mesh(self) -> bool:
         if self._available is not None:
@@ -232,12 +226,14 @@ class XlaMeshBackend(CollectiveBackend):
         """Wrap this process's flat buffer as one shard of a global array
         over the proc axis (or the factored (cross, local) axes)."""
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu.compat import jaxshim
         size = self._size_fn()
         local = jax.device_put(flat, self._my_device)
         return jax.make_array_from_single_device_arrays(
             (size * flat.shape[0],) + flat.shape[1:],
-            NamedSharding(mesh or self._mesh, P(axes)), [local])
+            jaxshim.named_sharding(mesh or self._mesh, P(axes)), [local])
 
     def _compiled(self, key, builder):
         with self._lock:
@@ -277,18 +273,17 @@ class XlaMeshBackend(CollectiveBackend):
                       mesh=None, axes=_AXIS, response=None):
         """jit(shard_map(body)) over the proc mesh, one shard per rank."""
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.sharding import PartitionSpec as P
         mesh = mesh or self._mesh
         key = (kind, flat.shape, str(flat.dtype), extra, axes,
                self._verdict_sig(response))
 
         def build():
-            # check_vma off: the replication checker can't statically
+            # Replication checker off (_shard_map): it can't statically
             # infer all_gather/psum results are replicated; semantics
             # are guaranteed by the collective itself.
-            m = jax.shard_map(body, mesh=mesh,
-                              in_specs=P(axes), out_specs=out_specs,
-                              check_vma=False)
+            m = _shard_map(body, mesh=mesh,
+                           in_specs=P(axes), out_specs=out_specs)
             return jax.jit(m)
 
         fn = self._compiled(key, build)
@@ -641,6 +636,8 @@ class XlaMeshBackend(CollectiveBackend):
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
 
+        from horovod_tpu.compat import jaxshim
+
         (entry,) = entries
         x = entry.tensor
         size = self._size_fn()
@@ -649,7 +646,7 @@ class XlaMeshBackend(CollectiveBackend):
         def body(t):
             if pre != 1.0:
                 t = t * jnp.asarray(pre, t.dtype)
-            y = jax.lax.psum_scatter(
+            y = jaxshim.psum_scatter(
                 t.reshape((size, t.shape[0] // size) + t.shape[1:]),
                 _AXIS, scatter_dimension=0, tiled=False)
             if post != 1.0:
@@ -731,7 +728,8 @@ class IciPlane:
         to the socket plane everywhere, together."""
         try:
             import jax
-            from jax.sharding import Mesh
+
+            from horovod_tpu.compat import jaxshim
             devs = sorted(jax.local_devices(), key=lambda d: d.id)
             if self._max_devices:
                 devs = devs[:self._max_devices]
@@ -742,7 +740,8 @@ class IciPlane:
                     "--xla_force_host_platform_device_count=N for a "
                     "CPU-mesh CI run)")
                 return False
-            self._mesh = Mesh(np.array(devs), (_ICI_AXIS,))
+            self._mesh = jaxshim.make_raw_mesh(np.array(devs),
+                                               (_ICI_AXIS,))
             self._ndev = len(devs)
             return True
         except Exception as e:
@@ -815,7 +814,9 @@ class IciPlane:
             return None
         import jax
         import jax.numpy as jnp
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu.compat import jaxshim
 
         if flat.dtype == np.float64 and not jax.config.jax_enable_x64:
             # device_put would silently canonicalize f64 down to f32
@@ -857,7 +858,7 @@ class IciPlane:
         else:
             padded = flat
         garr = jax.device_put(
-            padded, NamedSharding(self._mesh, P(_ICI_AXIS)))
+            padded, jaxshim.named_sharding(self._mesh, P(_ICI_AXIS)))
         out = fn(garr)
         host = np.asarray(jax.device_get(out.addressable_data(0)))
         res = host[:n]
@@ -890,7 +891,9 @@ class IciPlane:
             return None
         import jax
         import jax.numpy as jnp
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu.compat import jaxshim
 
         if partials.dtype == np.float64 \
                 and not jax.config.jax_enable_x64:
@@ -914,7 +917,7 @@ class IciPlane:
 
         fn = self._compiled(key, build)
         garr = jax.device_put(
-            partials, NamedSharding(self._mesh, P(_ICI_AXIS)))
+            partials, jaxshim.named_sharding(self._mesh, P(_ICI_AXIS)))
         out = fn(garr)
         host = np.asarray(jax.device_get(out.addressable_data(0)))
         if not host.flags.writeable:

@@ -111,10 +111,11 @@ def make_pipeline_apply(mesh, block_fn: Callable, *,
     batch_spec = P(data_axis) if data_axis else P()
 
     def run(stacked_params, x):
-        f = jax.shard_map(
+        from horovod_tpu.compat import jaxshim
+        f = jaxshim.shard_map(
             apply, mesh=mesh,
             in_specs=(shard_specs(stacked_params), batch_spec),
-            out_specs=batch_spec, check_vma=False)
+            out_specs=batch_spec)
         return f(stacked_params, x)
 
     return jax.jit(run)

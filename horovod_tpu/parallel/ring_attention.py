@@ -24,6 +24,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from horovod_tpu.compat import jaxshim
+
 
 def _block_attend(q, k, v, q_pos, k_pos, o, m, l, causal):
     """One blockwise online-softmax update.
@@ -58,7 +60,7 @@ def _ring_einsum(q, k, v, causal: bool, axis: str):
     """Reference ring implementation: jax-level blockwise online
     softmax. Exact; also the differentiation target for the flash
     path's custom VJP."""
-    p = jax.lax.axis_size(axis)
+    p = jaxshim.axis_size(axis)
     idx = jax.lax.axis_index(axis)
     b, s_local, h, d = q.shape
 
@@ -98,9 +100,13 @@ def _ring_flash_fwd_impl(q, k, v, causal: bool, axis: str, block: int):
     stats are the backward's residuals."""
     from horovod_tpu.parallel.flash_attention import flash_attention_stats
 
-    p = jax.lax.axis_size(axis)
-    idx = jax.lax.axis_index(axis)
+    p = jaxshim.axis_size(axis)
     b, s_local, h, d = q.shape
+    # Only the causal mask reads the positions. Without it the offsets
+    # are dead code, and a dead axis_index inside the fori_loop body is
+    # hoisted out of the shard_map manual region, where the 0.4.x SPMD
+    # partitioner rejects the orphaned partition-id instruction.
+    idx = jax.lax.axis_index(axis) if causal else jnp.int32(0)
     q_off = idx * s_local
 
     o_num = jnp.zeros((b, s_local, h, d), jnp.float32)
@@ -158,9 +164,11 @@ def _ring_flash_bwd(causal, axis, block, residuals, g):
     )
 
     q, k, v, o, m, l = residuals
-    p = jax.lax.axis_size(axis)
-    idx = jax.lax.axis_index(axis)
+    p = jaxshim.axis_size(axis)
     b, s_local, h, d = q.shape
+    # See _ring_flash_fwd_impl: keep axis_index out of the trace when
+    # the causal mask (its only consumer) is off.
+    idx = jax.lax.axis_index(axis) if causal else jnp.int32(0)
     q_off = idx * s_local
     perm = [(i, (i - 1) % p) for i in range(p)]
     interpret = jax.default_backend() not in ("tpu", "axon")
@@ -250,8 +258,8 @@ def _cached_sharded_attention(mesh, spec, inner):
     cache = {}
 
     def _build(causal: bool):
-        @partial(jax.shard_map, mesh=mesh, in_specs=(spec,) * 3,
-                 out_specs=spec, check_vma=False)
+        @partial(jaxshim.shard_map, mesh=mesh, in_specs=(spec,) * 3,
+                 out_specs=spec)
         def _sharded(q, k, v):
             return inner(q, k, v, causal)
         return _sharded

@@ -49,7 +49,7 @@ def infer_sharding(params, rules: ShardingRules, mesh):
     indivisible placements with an actionable error (e.g. an expert
     axis larger than num_experts) instead of a deep device_put
     failure."""
-    from jax.sharding import NamedSharding
+    from horovod_tpu.compat import jaxshim
 
     def one(path, leaf):
         p = _path_str(path)
@@ -68,7 +68,7 @@ def infer_sharding(params, rules: ShardingRules, mesh):
                         f"{axes} (total size {n}); pick an axis whose "
                         f"size divides the dimension (for MoE: an "
                         f"expert axis dividing num_experts).")
-        return NamedSharding(mesh, spec)
+        return jaxshim.named_sharding(mesh, spec)
 
     return jax.tree_util.tree_map_with_path(one, params)
 
@@ -140,7 +140,9 @@ def fsdp_sharding(params, mesh, axis: str = "data",
     than ``min_size`` elements (biases, layernorm scales) stay put:
     gathering them costs more than replicating.
     """
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.compat import jaxshim
 
     n = mesh.shape[axis]
 
@@ -163,9 +165,9 @@ def fsdp_sharding(params, mesh, axis: str = "data",
             return base_sh
         best = max(candidates, key=lambda d: shape[d])
         spec[best] = axis
-        return NamedSharding(mesh, P(*spec))
+        return jaxshim.named_sharding(mesh, P(*spec))
 
     if base is None:
         base = jax.tree_util.tree_map(
-            lambda _: NamedSharding(mesh, P()), params)
+            lambda _: jaxshim.named_sharding(mesh, P()), params)
     return jax.tree_util.tree_map(one, params, base)

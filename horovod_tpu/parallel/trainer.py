@@ -55,7 +55,9 @@ class Trainer:
                  rules: Optional[ShardingRules] = None,
                  loss_fn: Optional[Callable] = None,
                  batch_spec=None):
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu.compat import jaxshim
         self.module = module
         self.mesh = mesh
         self.tx = tx
@@ -78,7 +80,7 @@ class Trainer:
                 batch_spec = P(config.data_axis, config.seq_axis)
             else:
                 batch_spec = P(config.data_axis)
-        self.batch_sharding = NamedSharding(mesh, batch_spec)
+        self.batch_sharding = jaxshim.named_sharding(mesh, batch_spec)
         self._step = None
         self._param_shardings = None
 
@@ -153,6 +155,8 @@ def _opt_state_shardings(tx, params, param_shardings, mesh):
     (step counters, scalars) is replicated."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from horovod_tpu.compat import jaxshim
+
     flat, _ = jax.tree_util.tree_flatten_with_path(params)
     flat_sh = jax.tree_util.tree_leaves(
         param_shardings, is_leaf=lambda x: isinstance(x, NamedSharding))
@@ -164,7 +168,7 @@ def _opt_state_shardings(tx, params, param_shardings, mesh):
         key=lambda t: len(t[0]), reverse=True)
 
     abs_state = jax.eval_shape(tx.init, params)
-    replicated = NamedSharding(mesh, P())
+    replicated = jaxshim.named_sharding(mesh, P())
 
     def one(path, leaf):
         ks = jax.tree_util.keystr(path)

@@ -26,7 +26,8 @@ def ulysses_attention(q, k, v, *, axis: str = "seq",
     """Inside shard_map: q, k, v [B, S/p, H, D] sequence-sharded over
     ``axis`` → full-sequence attention on H/p heads → [B, S/p, H, D].
     The head count must divide the axis size."""
-    p = lax.axis_size(axis)
+    from horovod_tpu.compat import jaxshim
+    p = jaxshim.axis_size(axis)
     heads = q.shape[2]
     if heads % p != 0:
         raise ValueError(

@@ -26,8 +26,6 @@ from __future__ import annotations
 import math
 from typing import Dict, Optional, Tuple, Union
 
-import numpy as np
-
 Average = 0
 Sum = 1
 Min = 2
@@ -56,36 +54,10 @@ def create_mesh(axes: Optional[Dict[str, int]] = None,
     LOCAL/CROSS communicator split
     (reference: horovod/common/operations.cc:729-764).
     """
-    import jax
-    from jax.sharding import Mesh
-    from jax.experimental import mesh_utils
-
-    if devices is None:
-        devices = jax.devices()
-    n = len(devices)
-    if not axes:
-        axes = {"data": n}
-    names = tuple(axes.keys())
-    sizes = list(axes.values())
-    if sizes.count(-1) > 1:
-        raise ValueError("at most one mesh axis may have size -1")
-    if -1 in sizes:
-        known = math.prod(s for s in sizes if s != -1)
-        if known == 0 or n % known:
-            raise ValueError(
-                f"cannot infer -1 axis: {n} devices not divisible by {known}")
-        sizes[sizes.index(-1)] = n // known
-    if math.prod(sizes) != n:
-        raise ValueError(
-            f"mesh {dict(zip(names, sizes))} needs {math.prod(sizes)} "
-            f"devices but {n} are visible")
-    try:
-        dev_array = mesh_utils.create_device_mesh(
-            tuple(sizes), devices=devices,
-            allow_split_physical_axes=allow_split_physical_axes)
-    except Exception:
-        dev_array = np.asarray(devices).reshape(sizes)
-    return Mesh(dev_array, names)
+    from horovod_tpu.compat import jaxshim
+    return jaxshim.make_mesh(
+        axes, devices=devices,
+        allow_split_physical_axes=allow_split_physical_axes)
 
 
 def create_hybrid_mesh(ici_axes: Dict[str, int],
@@ -95,34 +67,30 @@ def create_hybrid_mesh(ici_axes: Dict[str, int],
     TPU-native form of the reference's is_homogeneous + LOCAL/CROSS
     communicator machinery (reference: horovod/common/operations.cc:
     729-764, mpi_context.h GetMPICommunicator)."""
-    from jax.sharding import Mesh
-    from jax.experimental import mesh_utils
-
-    names = tuple(dcn_axes.keys()) + tuple(ici_axes.keys())
-    dev_array = mesh_utils.create_hybrid_device_mesh(
-        tuple(ici_axes.values()),
-        dcn_mesh_shape=tuple(dcn_axes.values()))
-    return Mesh(dev_array, names)
+    from horovod_tpu.compat import jaxshim
+    return jaxshim.make_hybrid_mesh(ici_axes, dcn_axes)
 
 
 def mesh_rank(axis: AxisName = "data"):
     """In-jit rank along ``axis`` (reference: horovod_rank,
     horovod/common/operations.cc:1377-1383 — but per-axis)."""
     import jax
+
+    from horovod_tpu.compat import jaxshim
     if isinstance(axis, (tuple, list)):
         import jax.numpy as jnp
         r = jnp.int32(0)
         for a in axis:
-            r = r * jax.lax.axis_size(a) + jax.lax.axis_index(a)
+            r = r * jaxshim.axis_size(a) + jax.lax.axis_index(a)
         return r
     return jax.lax.axis_index(axis)
 
 
 def mesh_size(axis: AxisName = "data") -> int:
-    import jax
+    from horovod_tpu.compat import jaxshim
     if isinstance(axis, (tuple, list)):
-        return math.prod(jax.lax.axis_size(a) for a in axis)
-    return jax.lax.axis_size(axis)
+        return math.prod(jaxshim.axis_size(a) for a in axis)
+    return jaxshim.axis_size(axis)
 
 
 # ---------------------------------------------------------------------------
@@ -186,11 +154,11 @@ def reducescatter(x, op: int = Average, axis: AxisName = "data"):
     """Reduce then keep this replica's dim-0 shard
     (reference: the reduce-scatter stage of NCCLHierarchicalAllreduce,
     horovod/common/ops/nccl_operations.cc:222-236)."""
-    import jax
+    from horovod_tpu.compat import jaxshim
     if op not in (Average, Sum):
         raise ValueError("reducescatter supports Average/Sum only "
                          f"(got op={op}); XLA's reduce-scatter is a sum")
-    y = jax.lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+    y = jaxshim.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
     if op == Average:
         y = y / mesh_size(axis)
     return y
@@ -237,12 +205,12 @@ def broadcast_variables(tree, root_rank: int = 0, axis: AxisName = "data"):
 def batch_sharding(mesh, axis: AxisName = "data"):
     """NamedSharding that splits dim 0 across ``axis`` (the global-batch
     layout for data parallelism)."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    return NamedSharding(mesh, P(axis))
+    from horovod_tpu.compat import jaxshim
+    return jaxshim.named_sharding(mesh, jaxshim.partition_spec(axis))
 
 def replicated_sharding(mesh):
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    return NamedSharding(mesh, P())
+    from horovod_tpu.compat import jaxshim
+    return jaxshim.named_sharding(mesh, jaxshim.partition_spec())
 
 
 def shard_batch(mesh, batch, axis: AxisName = "data"):

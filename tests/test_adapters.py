@@ -10,6 +10,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from horovod_tpu.compat import jaxshim
+
 
 # ---------------------------------------------------------------------------
 # flax
@@ -40,10 +42,9 @@ def test_flax_distributed_train_state_syncs_grads(hvd_world):
         grads = jax.grad(loss_fn)(s.params)
         return s.apply_gradients(grads=grads)
 
-    smap = jax.jit(jax.shard_map(
+    smap = jax.jit(jaxshim.shard_map(
         step, mesh=mesh,
-        in_specs=(P(), P("data"), P("data")), out_specs=P(),
-        check_vma=False))
+        in_specs=(P(), P("data"), P("data")), out_specs=P()))
 
     rng = np.random.RandomState(0)
     batch = jnp.asarray(rng.randn(16, 28, 28, 1), jnp.float32)

@@ -1,0 +1,168 @@
+"""compat/jaxshim — the one sanctioned JAX version boundary.
+
+The wrappers re-read ``jax.__version__`` per call (never cached at
+import) precisely so these tests can mock a FUTURE release and prove
+the gate flips to the new spelling before that release exists: the
+whole point of the shim is that the next jax migration is a
+one-module diff, and that claim is only testable against versions we
+don't have installed.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from horovod_tpu.compat import jaxshim
+
+pytestmark = pytest.mark.fast
+
+
+# -- version parsing --------------------------------------------------------
+
+@pytest.mark.parametrize("raw,want", [
+    ("0.4.37", (0, 4, 37)),
+    ("0.5.0", (0, 5, 0)),
+    ("0.7.0.dev20260101+abc123", (0, 7, 0)),
+    ("0.6", (0, 6)),
+    ("1.0.0rc1", (1, 0, 0)),
+    ("garbage", (0,)),
+])
+def test_parse_version(raw, want):
+    assert jaxshim._parse_version(raw) == want
+
+
+def test_jax_version_reads_live_not_cached(monkeypatch):
+    monkeypatch.setattr(jax, "__version__", "0.9.9")
+    assert jaxshim.jax_version() == (0, 9, 9)
+    monkeypatch.setattr(jax, "__version__", "0.4.37")
+    assert jaxshim.jax_version() == (0, 4, 37)
+
+
+# -- the shard_map version gate --------------------------------------------
+
+def test_shard_map_future_jax_takes_top_level_check_vma(monkeypatch):
+    """On a mocked future release the gate must call the top-level
+    ``jax.shard_map`` with the ``check_vma`` spelling — without that
+    release being installed."""
+    seen = {}
+
+    def fake_shard_map(body, mesh=None, in_specs=None, out_specs=None,
+                       **kw):
+        seen.update(kw, mesh=mesh, in_specs=in_specs,
+                    out_specs=out_specs, body=body)
+        return "future-mapped"
+
+    monkeypatch.setattr(jax, "__version__", "0.9.0")
+    monkeypatch.setattr(jax, "shard_map", fake_shard_map,
+                        raising=False)
+    out = jaxshim.shard_map(lambda x: x, mesh="M", in_specs="I",
+                            out_specs="O")
+    assert out == "future-mapped"
+    assert seen["mesh"] == "M" and seen["in_specs"] == "I" \
+        and seen["out_specs"] == "O"
+    assert seen["check_vma"] is False and "check_rep" not in seen
+
+
+def test_shard_map_floor_jax_takes_experimental_check_rep(monkeypatch):
+    """At the supported floor the gate must stay on
+    ``jax.experimental.shard_map`` with ``check_rep``."""
+    from jax.experimental import shard_map as esm
+    seen = {}
+
+    def fake(body, mesh=None, in_specs=None, out_specs=None, **kw):
+        seen.update(kw)
+        return "floor-mapped"
+
+    monkeypatch.setattr(jax, "__version__", "0.4.37")
+    monkeypatch.setattr(esm, "shard_map", fake)
+    assert jaxshim.shard_map(lambda x: x, mesh="M", in_specs="I",
+                             out_specs="O") == "floor-mapped"
+    assert seen["check_rep"] is False and "check_vma" not in seen
+
+
+def test_shard_map_future_without_top_level_falls_back(monkeypatch):
+    """The feature probe is the net under the version gate: a release
+    that *claims* >= 0.5 but ships no top-level shard_map (the 0.4.35
+    deprecation-alias incident) must still resolve the experimental
+    spelling instead of raising."""
+    from jax.experimental import shard_map as esm
+    seen = {}
+
+    def fake(body, mesh=None, in_specs=None, out_specs=None, **kw):
+        seen.update(kw)
+        return "probed-fallback"
+
+    monkeypatch.setattr(jax, "__version__", "0.9.0")
+    monkeypatch.delattr(jax, "shard_map", raising=False)
+    monkeypatch.setattr(esm, "shard_map", fake)
+    assert jaxshim.shard_map(lambda x: x, mesh="M", in_specs="I",
+                             out_specs="O") == "probed-fallback"
+    assert seen["check_rep"] is False
+
+
+def test_shard_map_executes_on_running_jax():
+    """Whatever spelling the gate picked for the INSTALLED jax must
+    actually trace: one psum over a real mesh (conftest forces an
+    8-device host platform)."""
+    mesh = jaxshim.make_mesh()
+    n = mesh.devices.size
+    spec = jaxshim.partition_spec("data")
+
+    def body(x):
+        return jax.lax.psum(x, "data")
+
+    y = jax.jit(jaxshim.shard_map(body, mesh=mesh, in_specs=spec,
+                                  out_specs=spec))(
+        np.arange(n, dtype=np.float32))
+    np.testing.assert_allclose(
+        np.asarray(y), np.full(n, np.arange(n).sum(), np.float32))
+
+
+# -- axis_size gate ---------------------------------------------------------
+
+def test_axis_size_prefers_native_spelling(monkeypatch):
+    monkeypatch.setattr(jax.lax, "axis_size", lambda a: 7,
+                        raising=False)
+    assert jaxshim.axis_size("model") == 7
+
+
+def test_axis_size_floor_falls_back_to_psum(monkeypatch):
+    """Below 0.5 there is no jax.lax.axis_size: the shim must lower
+    to the psum(1, axis) constant-fold instead of AttributeError."""
+    seen = {}
+    monkeypatch.delattr(jax.lax, "axis_size", raising=False)
+    monkeypatch.setattr(
+        jax.lax, "psum",
+        lambda v, a: seen.setdefault("call", (v, a)) and 3 or 3)
+    assert jaxshim.axis_size("model") == 3
+    assert seen["call"] == (1, "model")
+
+
+# -- mesh construction ------------------------------------------------------
+
+def test_make_mesh_default_is_one_data_axis():
+    mesh = jaxshim.make_mesh()
+    assert mesh.axis_names == ("data",)
+    assert mesh.devices.size == len(jax.devices())
+
+
+def test_make_mesh_infers_minus_one_axis():
+    mesh = jaxshim.make_mesh({"data": -1, "model": 1})
+    assert mesh.axis_names == ("data", "model")
+    assert mesh.shape["data"] == len(jax.devices())
+
+
+def test_make_mesh_rejects_bad_product():
+    with pytest.raises(ValueError, match="devices"):
+        jaxshim.make_mesh({"data": len(jax.devices()) + 1})
+    with pytest.raises(ValueError, match="-1"):
+        jaxshim.make_mesh({"a": -1, "b": -1})
+
+
+def test_named_sharding_coerces_specs():
+    mesh = jaxshim.make_mesh()
+    for spec in ("data", ("data", None),
+                 jaxshim.partition_spec("data")):
+        s = jaxshim.named_sharding(mesh, spec)
+        assert s.spec[0] == "data"

@@ -2,7 +2,7 @@
 
 Two tiers in one module, both fast/in-process (pytest.mark.lint):
 
-* the PROJECT gate — all eight analyzers over ``horovod_tpu/`` must
+* the PROJECT gate — all nine analyzers over ``horovod_tpu/`` must
   report zero findings (this is the tier-1 rendering of the
   acceptance bar `python -m tools.hvdlint horovod_tpu` exits 0);
 * per-analyzer FIXTURES — for every analyzer, a known-bad snippet that
@@ -1265,9 +1265,9 @@ def test_list_names_every_analyzer():
     listed = out.stdout.split()
     assert listed == sorted(get_analyzers())
     assert listed == [
-        "knobs", "lock-order", "native-codec", "native-lifetime",
-        "teardown", "thread-ownership", "wire-protocol",
-        "world-coherence"]
+        "jax_compat", "knobs", "lock-order", "native-codec",
+        "native-lifetime", "teardown", "thread-ownership",
+        "wire-protocol", "world-coherence"]
 
 
 # -- thread-ownership -------------------------------------------------------
@@ -1823,6 +1823,215 @@ def test_regression_tenant_lane_handoff_lock(mut_tree):
     assert "runtime.Runtime._tenant_lane" in msgs, fs
 
 
+# -- jax_compat -------------------------------------------------------------
+# Three checks, each with a known-bad fixture that must fire and a
+# known-good twin that must stay silent, plus real-tree mutation gates
+# reverting the shim-ported idiom (the exact rot that kept the 52-test
+# shard_map family red from PR 3 to PR 20).
+
+def test_jax_compat_floor_mirrors_shim():
+    """The analyzer may not import the package under analysis, so it
+    carries the supported-jax floor as a literal — this is the bolt
+    keeping the two declarations (and the pyproject pin) one value."""
+    from tools.hvdlint import jax_compat
+    from horovod_tpu.compat import jaxshim
+    assert jax_compat.SUPPORTED_FLOOR == jaxshim.SUPPORTED_JAX_FLOOR
+
+
+# check 1: version-ranged API table — removed symbols...
+BAD_JAX_REMOVED_API = """
+    from jax.experimental.maps import Mesh
+
+    def build(devs):
+        return Mesh(devs, ("data",))
+"""
+
+# ...function-scoped imports (the tree's dominant jax idiom) count too
+BAD_JAX_DEFERRED_TREE_MAP = """
+    def halve(tree):
+        import jax
+        return jax.tree_map(lambda x: x / 2, tree)
+"""
+
+# ...and symbols introduced ABOVE the supported floor are rot as well
+BAD_JAX_ABOVE_FLOOR = """
+    import jax
+
+    def size(axis):
+        return jax.lax.axis_size(axis)
+"""
+
+GOOD_JAX_VIA_SHIM = """
+    from horovod_tpu.compat import jaxshim
+
+    def run(f, devs):
+        mesh = jaxshim.make_mesh({"data": 4}, devices=devs)
+        spec = jaxshim.partition_spec("data")
+        return jaxshim.shard_map(f, mesh=mesh, in_specs=spec,
+                                 out_specs=spec)
+"""
+
+
+def test_jax_compat_removed_api_fires(tmp_path):
+    fs = _lint_snippet(tmp_path, BAD_JAX_REMOVED_API, "jax_compat")
+    msgs = "\n".join(f.message for f in fs)
+    assert "jax.experimental.maps" in msgs and "removed" in msgs, fs
+
+
+def test_jax_compat_deferred_import_fires(tmp_path):
+    """jax.tree_map reached through a function-body import: the
+    analyzer's whole-file import overlay must still resolve it."""
+    fs = _lint_snippet(tmp_path, BAD_JAX_DEFERRED_TREE_MAP,
+                       "jax_compat")
+    msgs = "\n".join(f.message for f in fs)
+    assert "jax.tree_map" in msgs \
+        and "jax.tree_util.tree_map" in msgs, fs
+
+
+def test_jax_compat_above_floor_fires(tmp_path):
+    fs = _lint_snippet(tmp_path, BAD_JAX_ABOVE_FLOOR, "jax_compat")
+    msgs = "\n".join(f.message for f in fs)
+    assert "jax.lax.axis_size" in msgs \
+        and "above the supported floor" in msgs \
+        and "jaxshim.axis_size" in msgs, fs
+
+
+def test_jax_compat_shim_usage_is_clean(tmp_path):
+    assert _lint_snippet(tmp_path, GOOD_JAX_VIA_SHIM,
+                         "jax_compat") == []
+
+
+# check 2: mesh/sharding construction must route through the shim
+BAD_DIRECT_CONSTRUCTION = """
+    def build(devs):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        mesh = Mesh(devs, ("data",))
+        return NamedSharding(mesh, PartitionSpec("data"))
+"""
+
+GOOD_SHIM_CONSTRUCTION = """
+    from horovod_tpu.compat import jaxshim
+
+    def build(devs):
+        mesh = jaxshim.make_mesh({"data": 2, "model": 2},
+                                 devices=devs)
+        spec = jaxshim.partition_spec("data", "model")
+        return jaxshim.named_sharding(mesh, spec)
+"""
+
+
+def test_jax_compat_direct_construction_fires(tmp_path):
+    fs = _lint_snippet(tmp_path, BAD_DIRECT_CONSTRUCTION, "jax_compat")
+    msgs = "\n".join(f.message for f in fs)
+    assert "direct jax.sharding.Mesh construction" in msgs \
+        and "make_mesh" in msgs, fs
+    assert "direct jax.sharding.NamedSharding construction" in msgs \
+        and "named_sharding" in msgs, fs
+
+
+def test_jax_compat_shim_construction_is_clean(tmp_path):
+    assert _lint_snippet(tmp_path, GOOD_SHIM_CONSTRUCTION,
+                         "jax_compat") == []
+
+
+def test_jax_compat_shim_module_itself_exempt(tmp_path):
+    """The one sanctioned call site: a module named jaxshim.py may
+    touch the version-ranged API directly — that's its whole job."""
+    code = """
+        import jax
+
+        def make_raw_mesh(devs, names):
+            return jax.sharding.Mesh(devs, names)
+    """
+    assert _lint_snippet(tmp_path, code, "jax_compat",
+                         name="jaxshim.py") == []
+
+
+# check 3: PartitionSpec axis names must be axes of a mesh in scope
+BAD_STALE_AXIS = """
+    from horovod_tpu.compat import jaxshim
+
+    def build(devs):
+        mesh = jaxshim.make_mesh({"data": 2, "model": 2},
+                                 devices=devs)
+        return jaxshim.named_sharding(
+            mesh, jaxshim.partition_spec("data", "modle"))
+"""
+
+GOOD_UNPROVABLE_MESH_SKIPPED = """
+    from horovod_tpu.compat import jaxshim
+
+    def apply(mesh):
+        # mesh arrives as a parameter: axes statically unknown, so
+        # the check must skip rather than guess
+        return jaxshim.partition_spec("whatever")
+"""
+
+
+def test_jax_compat_stale_axis_fires(tmp_path):
+    fs = _lint_snippet(tmp_path, BAD_STALE_AXIS, "jax_compat")
+    msgs = "\n".join(f.message for f in fs)
+    assert "'modle'" in msgs and "silently replicates" in msgs, fs
+    assert "'data'" not in msgs.split("known axes")[0], \
+        "the coherent axis must not be flagged"
+
+
+def test_jax_compat_unprovable_mesh_is_skipped(tmp_path):
+    assert _lint_snippet(tmp_path, GOOD_UNPROVABLE_MESH_SKIPPED,
+                         "jax_compat") == []
+
+
+# real-tree gates: reverting a shim-ported file to the removed-API
+# idiom must trip jax_compat on the actual package, proving the green
+# tree is green because the port is complete, not because the
+# analyzer is blind to the shipped code.
+
+def test_mutation_axis_size_revert_refound(mut_tree):
+    """spmd.axis_size reverted to the above-floor jax.lax.axis_size
+    spelling (the exact AttributeError that killed the family on
+    0.4.37)."""
+    def revert(s):
+        old = "    return jaxshim.axis_size(axis)"
+        assert old in s
+        return s.replace(old, "    return jax.lax.axis_size(axis)", 1)
+    fs = _mutate_and_lint(mut_tree, "spmd/__init__.py", revert,
+                          "jax_compat")
+    msgs = "\n".join(f.message for f in fs)
+    assert "jax.lax.axis_size" in msgs \
+        and "above the supported floor" in msgs, fs
+
+
+def test_mutation_shard_map_revert_refound(mut_tree):
+    """ring_attention's shard_map reverted to the top-level jax
+    spelling that only exists from 0.5.0."""
+    def revert(s):
+        old = "partial(jaxshim.shard_map, mesh=mesh"
+        assert old in s
+        return s.replace(old, "partial(jax.shard_map, mesh=mesh", 1)
+    fs = _mutate_and_lint(mut_tree, "parallel/ring_attention.py",
+                          revert, "jax_compat")
+    msgs = "\n".join(f.message for f in fs)
+    assert "jax.shard_map" in msgs \
+        and "compat.jaxshim.shard_map" in msgs, fs
+
+
+def test_mutation_direct_sharding_revert_refound(mut_tree):
+    """spmd's named_sharding helper reverted to constructing
+    jax.sharding.NamedSharding directly."""
+    def revert(s):
+        old = ("    return jaxshim.named_sharding("
+               "mesh, jaxshim.partition_spec(axis))")
+        assert old in s
+        return s.replace(
+            old,
+            "    return jax.sharding.NamedSharding("
+            "mesh, jaxshim.partition_spec(axis))", 1)
+    fs = _mutate_and_lint(mut_tree, "spmd/__init__.py", revert,
+                          "jax_compat")
+    msgs = "\n".join(f.message for f in fs)
+    assert "direct jax.sharding.NamedSharding construction" in msgs, fs
+
+
 # -- the --changed cache ----------------------------------------------------
 
 def _seed_pkg(tmp_path):
@@ -1899,6 +2108,46 @@ def test_cache_invalidated_by_analyzer_selection(tmp_path):
     hcache.save([str(pkg)], ["knobs"], cf,
                 lint_paths([str(pkg)], ["knobs"]))
     assert hcache.load([str(pkg)], ["knobs", "teardown"], cf) is None
+
+
+def test_cache_invalidated_by_api_table_edit(tmp_path):
+    """jax_compat's API_TABLE is data, but it IS the analyzer: adding
+    a row must change the tool stamp (so a --changed replay re-runs),
+    and load() must key on that stamp."""
+    from tools.hvdlint import cache as hcache
+    scratch = str(tmp_path / "hvdlint")
+    shutil.copytree(os.path.join(REPO, "tools", "hvdlint"), scratch,
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    before = hcache._tool_stamp(scratch)
+    assert before == hcache._tool_stamp(), \
+        "scratch copy must stamp identically to the shipped suite"
+    jc = os.path.join(scratch, "jax_compat.py")
+    with open(jc) as f:
+        src = f.read()
+    anchor = "API_TABLE: Dict[str, Tuple[Optional[tuple], " \
+             "Optional[tuple], str]] = {"
+    assert anchor in src
+    with open(jc, "w") as f:
+        f.write(src.replace(
+            anchor,
+            anchor + '\n    "jax.experimental.probe": '
+                     '(None, (0, 9, 0), "nothing"),', 1))
+    after = hcache._tool_stamp(scratch)
+    assert after != before, "API-table edit must change the tool stamp"
+
+    # and the load path enforces it: a cache saved under another
+    # suite build is a miss, never a replay
+    pkg = _seed_pkg(tmp_path)
+    cf = str(tmp_path / "c.json")
+    hcache.save([str(pkg)], ["knobs"], cf,
+                lint_paths([str(pkg)], ["knobs"]))
+    assert hcache.load([str(pkg)], ["knobs"], cf) is not None
+    with open(cf) as f:
+        payload = json.load(f)
+    payload["tool"] = after
+    with open(cf, "w") as f:
+        json.dump(payload, f)
+    assert hcache.load([str(pkg)], ["knobs"], cf) is None
 
 
 def test_cache_cli_end_to_end(tmp_path):

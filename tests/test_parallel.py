@@ -12,6 +12,7 @@ from horovod_tpu import spmd
 from horovod_tpu.models.transformer import (
     TransformerConfig, TransformerLM, causal_attention,
 )
+from horovod_tpu.compat import jaxshim
 from horovod_tpu.parallel import (
     Trainer, TrainerConfig, infer_sharding, make_ring_attention,
     ring_attention, transformer_tp_rules,
@@ -30,10 +31,10 @@ def test_ring_attention_matches_reference():
 
     expected = causal_attention(q, k, v)
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(jaxshim.shard_map(
         lambda q, k, v: ring_attention(q, k, v, axis="seq"),
         mesh=mesh, in_specs=(P(None, "seq"),) * 3,
-        out_specs=P(None, "seq"), check_vma=False))
+        out_specs=P(None, "seq")))
     out = f(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
                                atol=2e-5)
@@ -46,9 +47,9 @@ def test_ring_attention_single_shard_degenerates():
     q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
     k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
     v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(jaxshim.shard_map(
         lambda q, k, v: ring_attention(q, k, v, axis="seq"),
-        mesh=mesh, in_specs=(P(),) * 3, out_specs=P(), check_vma=False))
+        mesh=mesh, in_specs=(P(),) * 3, out_specs=P()))
     np.testing.assert_allclose(np.asarray(f(q, k, v)),
                                np.asarray(causal_attention(q, k, v)),
                                atol=2e-5)
@@ -203,11 +204,11 @@ def test_ring_attention_flash_path_matches_dense():
     q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
     k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
     v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(jaxshim.shard_map(
         lambda q, k, v: ring_attention(q, k, v, axis="seq",
                                        use_flash=True),
         mesh=mesh, in_specs=(P(None, "seq"),) * 3,
-        out_specs=P(None, "seq"), check_vma=False))
+        out_specs=P(None, "seq")))
     np.testing.assert_allclose(np.asarray(f(q, k, v)),
                                np.asarray(causal_attention(q, k, v)),
                                atol=2e-5)
@@ -299,11 +300,11 @@ def test_ring_attention_flash_noncausal():
     q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
     k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
     v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(jaxshim.shard_map(
         lambda q, k, v: ring_attention(q, k, v, causal=False,
                                        axis="seq", use_flash=True),
         mesh=mesh, in_specs=(P(None, "seq"),) * 3,
-        out_specs=P(None, "seq"), check_vma=False))
+        out_specs=P(None, "seq")))
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
     ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(logits, -1), v)
     np.testing.assert_allclose(np.asarray(f(q, k, v)), np.asarray(ref),

@@ -11,6 +11,8 @@ from jax.sharding import PartitionSpec as P
 
 from horovod_tpu import spmd
 
+from horovod_tpu.compat import jaxshim
+
 
 @pytest.fixture(scope="module")
 def mesh():
@@ -18,8 +20,8 @@ def mesh():
 
 
 def _shard_map(mesh, body, in_specs, out_specs):
-    return jax.jit(jax.shard_map(body, mesh=mesh, in_specs=in_specs,
-                                 out_specs=out_specs, check_vma=False))
+    return jax.jit(jaxshim.shard_map(body, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs))
 
 
 def test_mesh_default_axes():
@@ -140,7 +142,7 @@ def test_hierarchical_axes():
     m = spmd.create_mesh({"cross": 2, "local": 4})
     x = np.arange(8, dtype=np.float32).reshape(2, 4)
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(jaxshim.shard_map(
         lambda t: spmd.allreduce(t, op=spmd.Sum, axis=("cross", "local")),
         mesh=m, in_specs=P("cross", "local"), out_specs=P()))
     np.testing.assert_allclose(np.asarray(f(x)), x.sum())

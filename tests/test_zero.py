@@ -16,6 +16,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horovod_tpu import spmd
 
+from horovod_tpu.compat import jaxshim
+
 N = 8
 
 
@@ -58,12 +60,11 @@ def _build(mesh, ztx, tx):
         updates, state = ztx.update(g, state, p)
         return optax.apply_updates(p, updates), state
 
-    init_f = jax.jit(jax.shard_map(
-        ztx.init, mesh=mesh, in_specs=(rep,), out_specs=specs,
-        check_vma=False))
-    step_f = jax.jit(jax.shard_map(
+    init_f = jax.jit(jaxshim.shard_map(
+        ztx.init, mesh=mesh, in_specs=(rep,), out_specs=specs))
+    step_f = jax.jit(jaxshim.shard_map(
         step, mesh=mesh, in_specs=(rep, specs, grad_specs),
-        out_specs=(rep, specs), check_vma=False))
+        out_specs=(rep, specs)))
     return init_f, step_f, specs
 
 
@@ -170,11 +171,11 @@ def test_zero_requires_params(mesh):
         return updates
 
     with pytest.raises(ValueError, match="requires params"):
-        jax.jit(jax.shard_map(
+        jax.jit(jaxshim.shard_map(
             bad, mesh=mesh,
             in_specs=(jax.tree_util.tree_map(
                 lambda _: P("data"), _params()),),
-            out_specs=P(), check_vma=False))(_per_rank_grads())
+            out_specs=P()))(_per_rank_grads())
 
 
 def test_zero_accepts_extra_args(mesh):
@@ -191,12 +192,11 @@ def test_zero_accepts_extra_args(mesh):
         return optax.apply_updates(p, updates), state
 
     params = _params()
-    init_f = jax.jit(jax.shard_map(
-        ztx.init, mesh=mesh, in_specs=(P(),), out_specs=specs,
-        check_vma=False))
-    step_f = jax.jit(jax.shard_map(
+    init_f = jax.jit(jaxshim.shard_map(
+        ztx.init, mesh=mesh, in_specs=(P(),), out_specs=specs))
+    step_f = jax.jit(jaxshim.shard_map(
         step, mesh=mesh, in_specs=(P(), specs, grad_specs),
-        out_specs=(P(), specs), check_vma=False))
+        out_specs=(P(), specs)))
     p2, _ = step_f(params, init_f(params), _per_rank_grads())
     want, _ = _run_reference(lambda: optax.sgd(0.1), n_steps=1)
     _tree_close(p2, want, rtol=1e-5, atol=1e-6)
@@ -252,11 +252,11 @@ def test_zero_end_to_end_training_step(mesh):
         return optax.apply_updates(p, updates), state, loss
 
     rep = P()
-    init_f = jax.jit(jax.shard_map(ztx.init, mesh=mesh, in_specs=(rep,),
-                                   out_specs=specs, check_vma=False))
-    step_f = jax.jit(jax.shard_map(
+    init_f = jax.jit(jaxshim.shard_map(ztx.init, mesh=mesh, in_specs=(rep,),
+                                   out_specs=specs))
+    step_f = jax.jit(jaxshim.shard_map(
         step, mesh=mesh, in_specs=(rep, specs, P("data"), P("data")),
-        out_specs=(rep, specs, rep), check_vma=False))
+        out_specs=(rep, specs, rep)))
 
     state = init_f(params)
     losses = []

@@ -55,10 +55,12 @@ def _sha1(path: str) -> str:
     return h.hexdigest()
 
 
-def _tool_stamp() -> str:
-    """Fingerprint of the analyzer suite itself: editing a checker is
-    as much a tree change as editing the tree."""
-    here = os.path.dirname(os.path.abspath(__file__))
+def _tool_stamp(tool_dir: Optional[str] = None) -> str:
+    """Fingerprint of the analyzer suite itself: editing a checker —
+    including a data-table edit like jax_compat's API_TABLE — is as
+    much a tree change as editing the tree. ``tool_dir`` exists so
+    tests can stamp a scratch copy of the suite."""
+    here = tool_dir or os.path.dirname(os.path.abspath(__file__))
     h = hashlib.sha1()
     for fn in sorted(os.listdir(here)):
         if fn.endswith(".py"):

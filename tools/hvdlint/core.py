@@ -623,12 +623,13 @@ class Resolver:
 # runner
 
 def get_analyzers() -> Dict[str, object]:
-    from tools.hvdlint import (knobs, lock_order, native_codec,
-                               native_lifetime, teardown,
+    from tools.hvdlint import (jax_compat, knobs, lock_order,
+                               native_codec, native_lifetime, teardown,
                                thread_ownership, wire_protocol,
                                world_coherence)
     mods = (lock_order, thread_ownership, wire_protocol, native_codec,
-            native_lifetime, world_coherence, teardown, knobs)
+            native_lifetime, world_coherence, teardown, knobs,
+            jax_compat)
     return {m.NAME: m for m in mods}
 
 

@@ -1,0 +1,466 @@
+"""jax_compat: JAX API-rot static analysis.
+
+JAX moves its partitioning surface roughly once a year
+(``jax.experimental.maps``/``sharded_jit`` → ``pjit`` →
+``jax.sharding`` + ``jax.experimental.shard_map`` → top-level
+``jax.shard_map``), and each move has historically rotted exactly the
+modules that called the APIs directly: the 52-test shard_map family
+(parallel/, spmd/zero, adapters) was red from PR 3 to PR 20 because
+call sites were written against one release's spelling. The repair is
+structural — ONE sanctioned shim module
+(``horovod_tpu/compat/jaxshim.py``) pays the version tax — and this
+analyzer keeps it that way. Three checks:
+
+1. **Removed/renamed API table.** A version-ranged table of JAX
+   symbols that do not exist across the whole supported span
+   (:data:`SUPPORTED_FLOOR` .. any future release). Any import or
+   attribute use of a tabled symbol outside the shim is a finding
+   naming the range and the replacement. Both directions of rot are
+   covered: symbols *removed* before the span's future edge
+   (``jax.experimental.maps``) and symbols *introduced* above the
+   floor (``jax.shard_map``).
+
+2. **Shim-only construction.** Mesh/sharding construction —
+   ``Mesh(...)``, ``NamedSharding(...)``, ``mesh_utils.*``,
+   ``jax.make_mesh``, any ``shard_map``, ``with_sharding_constraint``,
+   ``lax.psum_scatter`` — must route through the jaxshim wrappers.
+   These are precisely the call families each JAX migration has
+   re-spelled; one call site per family keeps the next migration a
+   one-module diff.
+
+3. **PartitionSpec axis-name coherence.** Every *literal* axis name in
+   a ``PartitionSpec`` must be an axis of a mesh whose axis names are
+   statically known in the same lexical scope (function body, falling
+   back to module level). A misspelled or stale axis name does not
+   error at trace time — it silently replicates (or silently
+   reshards), the exact rot class the shard_map tests died of.
+   Conservative: scopes containing a mesh whose axes cannot be
+   resolved statically are skipped, as are non-literal spec entries.
+
+Blind spots (accepted): meshes received as function parameters
+(callers are checked at *their* construction site), axis names routed
+through variables, and specs built by helper functions — all resolve
+to "statically unknown", which is skipped, never guessed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.hvdlint.core import (
+    Finding, ModuleIndex, Project, _expand, dotted_name, iter_executed,
+)
+
+NAME = "jax_compat"
+
+# Single sanctioned module: the only place the tabled/construction
+# APIs may appear. Matched on the module's short name so the check
+# holds for fixtures and scratch trees too.
+SHIM_SHORTNAME = "jaxshim"
+
+# The oldest JAX this tree supports; mirrors
+# horovod_tpu.compat.jaxshim.SUPPORTED_JAX_FLOOR (kept as a literal —
+# the analyzer must not import the package under analysis) and the
+# pyproject/README pin. test_lint asserts the two stay equal.
+SUPPORTED_FLOOR = (0, 4, 37)
+
+# ---------------------------------------------------------------------------
+# check 1: the version-ranged API table
+#
+# prefix -> (introduced, removed, replacement). ``None`` introduced =
+# pre-history; ``None`` removed = still shipping. A symbol is rot
+# whenever its range fails to cover the whole supported span
+# [SUPPORTED_FLOOR, +inf): removed is not None, or introduced above
+# the floor. Longest prefix wins.
+
+API_TABLE: Dict[str, Tuple[Optional[tuple], Optional[tuple], str]] = {
+    "jax.experimental.maps": (
+        None, (0, 4, 14),
+        "jax.sharding Mesh/NamedSharding via "
+        "compat.jaxshim.make_mesh/named_sharding"),
+    "jax.experimental.sharded_jit": (
+        None, (0, 2, 21),
+        "jax.jit with shardings (compat.jaxshim.named_sharding)"),
+    "jax.interpreters.sharded_jit": (
+        None, (0, 2, 21),
+        "jax.jit with shardings (compat.jaxshim.named_sharding)"),
+    "jax.experimental.global_device_array": (
+        None, (0, 4, 0), "jax.Array"),
+    "jax.experimental.PartitionSpec": (
+        None, (0, 4, 13), "jax.sharding.PartitionSpec"),
+    "jax.experimental.pjit.PartitionSpec": (
+        None, (0, 4, 13), "jax.sharding.PartitionSpec"),
+    "jax.experimental.pjit.with_sharding_constraint": (
+        None, (0, 4, 7), "compat.jaxshim.with_sharding_constraint"),
+    "jax.experimental.pjit.pjit": (
+        None, (0, 6, 0), "jax.jit (in_shardings/out_shardings)"),
+    "jax.experimental.shard_map": (
+        (0, 4, 3), (0, 8, 0), "compat.jaxshim.shard_map"),
+    "jax.shard_map": (
+        (0, 5, 0), None, "compat.jaxshim.shard_map"),
+    "jax.lax.axis_size": (
+        (0, 5, 0), None, "compat.jaxshim.axis_size"),
+    # pre-0.4.26 tree aliases, removed in 0.6 (jax.tree_util / the
+    # jax.tree namespace replaced them)
+    "jax.tree_map": (None, (0, 6, 0), "jax.tree_util.tree_map"),
+    "jax.tree_multimap": (None, (0, 3, 16), "jax.tree_util.tree_map"),
+    "jax.tree_flatten": (None, (0, 6, 0), "jax.tree_util.tree_flatten"),
+    "jax.tree_unflatten": (
+        None, (0, 6, 0), "jax.tree_util.tree_unflatten"),
+    "jax.tree_leaves": (None, (0, 6, 0), "jax.tree_util.tree_leaves"),
+    "jax.tree_structure": (
+        None, (0, 6, 0), "jax.tree_util.tree_structure"),
+    "jax.tree_transpose": (
+        None, (0, 6, 0), "jax.tree_util.tree_transpose"),
+}
+
+# check 2: construction families that must route through the shim.
+# Matched on the resolved dotted tail (module-qualified or bare
+# from-import), calls only.
+_CONSTRUCTION = {
+    "jax.sharding.Mesh": "make_mesh/make_raw_mesh",
+    "jax.sharding.NamedSharding": "named_sharding",
+    "jax.experimental.mesh_utils.create_device_mesh": "make_mesh",
+    "jax.experimental.mesh_utils.create_hybrid_device_mesh":
+        "make_hybrid_mesh",
+    "jax.make_mesh": "make_mesh",
+    "jax.lax.with_sharding_constraint": "with_sharding_constraint",
+    "jax.lax.psum_scatter": "psum_scatter",
+}
+
+# spec/mesh factory spellings recognized by check 3 (resolved names)
+_SPEC_FACTORIES = {"jax.sharding.PartitionSpec",
+                   "horovod_tpu.compat.jaxshim.partition_spec",
+                   "compat.jaxshim.partition_spec",
+                   "jaxshim.partition_spec"}
+_MESH_DICT_FACTORIES = {"horovod_tpu.compat.jaxshim.make_mesh",
+                        "compat.jaxshim.make_mesh",
+                        "jaxshim.make_mesh",
+                        "horovod_tpu.spmd.create_mesh",
+                        "spmd.create_mesh"}
+_MESH_HYBRID_FACTORIES = {"horovod_tpu.compat.jaxshim.make_hybrid_mesh",
+                          "compat.jaxshim.make_hybrid_mesh",
+                          "jaxshim.make_hybrid_mesh",
+                          "horovod_tpu.spmd.create_hybrid_mesh",
+                          "spmd.create_hybrid_mesh"}
+_MESH_NAMES_FACTORIES = {"jax.sharding.Mesh",
+                         "horovod_tpu.compat.jaxshim.make_raw_mesh",
+                         "compat.jaxshim.make_raw_mesh",
+                         "jaxshim.make_raw_mesh",
+                         "jax.make_mesh"}
+
+
+def _fmt(v: Optional[tuple]) -> str:
+    return ".".join(str(x) for x in v) if v else "?"
+
+
+def _is_shim(modname: str) -> bool:
+    return modname.rsplit(".", 1)[-1] == SHIM_SHORTNAME
+
+
+class _FileImports:
+    """ModuleIndex-shaped import maps that also see *function-scoped*
+    imports — the tree's jax imports are overwhelmingly deferred into
+    function bodies (import-cost hygiene), which the core indexer
+    deliberately ignores. Whole-file merge: a local name imported two
+    ways in different functions is vanishingly rare and resolves to
+    the last spelling, which is wrong-but-loud, never silent."""
+
+    def __init__(self, mod: ModuleIndex):
+        self.imports: Dict[str, str] = dict(mod.imports)
+        self.from_imports: Dict[str, Tuple[str, str]] = \
+            dict(mod.from_imports)
+        for node in ast.walk(mod.src.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.imports[a.asname or
+                                 a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    local = a.asname or a.name
+                    self.from_imports[local] = (node.module, a.name)
+                    self.imports.setdefault(
+                        local, f"{node.module}.{a.name}")
+
+
+def _resolved(raw: Optional[str], mod) -> Optional[str]:
+    """Expand a dotted use through the file's imports."""
+    return _expand(raw, mod)
+
+
+def _table_hit(full: str) -> Optional[Tuple[str, tuple]]:
+    """Longest API_TABLE prefix that ``full`` falls under."""
+    best = None
+    for prefix, entry in API_TABLE.items():
+        if full == prefix or full.startswith(prefix + "."):
+            if best is None or len(prefix) > len(best[0]):
+                best = (prefix, entry)
+    return best
+
+
+def _out_of_span(entry) -> bool:
+    introduced, removed, _ = entry
+    return removed is not None or \
+        (introduced is not None and introduced > SUPPORTED_FLOOR)
+
+
+def _jax_tails(full: str) -> List[str]:
+    """Candidate keys for the construction table: the full resolved
+    name plus shortened tails ('a.b.c.d' -> 'c.d')."""
+    out = [full]
+    parts = full.split(".")
+    if len(parts) > 2:
+        out.append(".".join(parts[-2:]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# checks 1 + 2: tabled symbols and unsanctioned construction
+# ---------------------------------------------------------------------------
+
+def _scan_rot(src, mod, findings: List[Finding]) -> None:
+    reported: Set[Tuple[int, str]] = set()
+
+    def report_table(full: str, line: int) -> None:
+        hit = _table_hit(full)
+        if hit is None or not _out_of_span(hit[1]):
+            return
+        prefix, (introduced, removed, repl) = hit
+        key = (line, prefix)
+        if key in reported:
+            return
+        reported.add(key)
+        if removed is not None and introduced is not None:
+            span = (f"exists only in jax "
+                    f"[{_fmt(introduced)}, {_fmt(removed)})")
+        elif removed is not None:
+            span = f"removed in jax {_fmt(removed)}"
+        else:
+            span = (f"introduced in jax {_fmt(introduced)}, above the "
+                    f"supported floor {_fmt(SUPPORTED_FLOOR)}")
+        findings.append(Finding(
+            NAME, src.path, line,
+            f"{prefix} does not span the supported jax range "
+            f"(>= {_fmt(SUPPORTED_FLOOR)}): {span} — use {repl}; only "
+            f"horovod_tpu/compat/jaxshim.py may touch version-ranged "
+            f"jax API directly"))
+
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                report_table(alias.name, node.lineno)
+        elif isinstance(node, ast.ImportFrom) and node.module and \
+                node.module.split(".", 1)[0] == "jax":
+            for alias in node.names:
+                # importing a constructor is fine; *calling* it is
+                # flagged below via name expansion
+                report_table(f"{node.module}.{alias.name}", node.lineno)
+        elif isinstance(node, ast.Attribute):
+            raw = dotted_name(node)
+            if raw is None:
+                continue
+            full = _resolved(raw, mod)
+            if full is not None:
+                report_table(full, node.lineno)
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            if node.id in mod.from_imports:
+                full = _resolved(node.id, mod)
+                if full is not None and full.split(".")[0] == "jax":
+                    report_table(full, node.lineno)
+        if isinstance(node, ast.Call):
+            raw = dotted_name(node.func)
+            full = _resolved(raw, mod) if raw else None
+            if full is None:
+                continue
+            for key in _jax_tails(full):
+                wrapper = _CONSTRUCTION.get(key)
+                if wrapper is not None:
+                    findings.append(Finding(
+                        NAME, src.path, node.lineno,
+                        f"direct {key} construction — route it through "
+                        f"horovod_tpu.compat.jaxshim.{wrapper} so the "
+                        f"next jax migration is a one-module diff"))
+                    break
+
+
+# ---------------------------------------------------------------------------
+# check 3: PartitionSpec axis-name coherence
+# ---------------------------------------------------------------------------
+
+def _literal_strs(node: ast.AST) -> Optional[List[str]]:
+    """['a', 'b'] for a literal tuple/list of strings (or one string);
+    None when any element is not a string constant."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and \
+                    isinstance(elt.value, str):
+                out.append(elt.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def _mesh_axes_of_call(call: ast.Call, mod
+                       ) -> Optional[Set[str]]:
+    """Axis-name set for a statically-resolvable mesh construction;
+    None when this call is not a mesh factory. The sentinel set
+    {'?'} means "mesh factory, axes unknown" — poisons the scope."""
+    raw = dotted_name(call.func)
+    full = _resolved(raw, mod) if raw else None
+    if full is None:
+        return None
+    keys = set(_jax_tails(full))
+
+    def dict_keys(node) -> Optional[Set[str]]:
+        if isinstance(node, ast.Dict):
+            out = set()
+            for k in node.keys:
+                if isinstance(k, ast.Constant) and \
+                        isinstance(k.value, str):
+                    out.add(k.value)
+                else:
+                    return None
+            return out
+        if isinstance(node, ast.Constant) and node.value is None:
+            return {"data"}
+        return None
+
+    if keys & _MESH_DICT_FACTORIES:
+        if not call.args and not call.keywords:
+            return {"data"}
+        arg = call.args[0] if call.args else None
+        for kw in call.keywords:
+            if kw.arg == "axes":
+                arg = kw.value
+        axes = dict_keys(arg) if arg is not None else {"data"}
+        return axes if axes is not None else {"?"}
+    if keys & _MESH_HYBRID_FACTORIES:
+        args = list(call.args) + [kw.value for kw in call.keywords
+                                  if kw.arg in ("ici_axes", "dcn_axes")]
+        out: Set[str] = set()
+        for a in args[:2]:
+            d = dict_keys(a)
+            if d is None:
+                return {"?"}
+            out |= d
+        return out or {"?"}
+    if keys & _MESH_NAMES_FACTORIES:
+        names_node = None
+        if len(call.args) >= 2:
+            names_node = call.args[1]
+        for kw in call.keywords:
+            if kw.arg in ("axis_names", "names"):
+                names_node = kw.value
+        if names_node is None:
+            return {"?"}
+        names = _literal_strs(names_node)
+        return set(names) if names is not None else {"?"}
+    return None
+
+
+def _spec_axis_literals(call: ast.Call, mod
+                        ) -> Optional[List[Tuple[str, int]]]:
+    """(axis, line) pairs for the literal string axes of a
+    PartitionSpec construction; None when the call is not one."""
+    raw = dotted_name(call.func)
+    full = _resolved(raw, mod) if raw else None
+    if full is None:
+        return None
+    if not set(_jax_tails(full)) & _SPEC_FACTORIES:
+        return None
+    out: List[Tuple[str, int]] = []
+    for arg in call.args:
+        names = _literal_strs(arg)
+        if names is not None:
+            out.extend((n, arg.lineno) for n in names)
+    return out
+
+
+def _check_scope(body_iter, src, mod,
+                 module_axes: Optional[Set[str]],
+                 findings: List[Finding]) -> None:
+    """One lexical scope: gather statically-known mesh axes, then
+    check every literal PartitionSpec axis against their union."""
+    axes: Set[str] = set()
+    unknown = False
+    specs: List[Tuple[str, int]] = []
+    for node in body_iter:
+        if not isinstance(node, ast.Call):
+            continue
+        mesh_axes = _mesh_axes_of_call(node, mod)
+        if mesh_axes is not None:
+            if "?" in mesh_axes:
+                unknown = True
+            else:
+                axes |= mesh_axes
+        lits = _spec_axis_literals(node, mod)
+        if lits:
+            specs.extend(lits)
+    if module_axes:
+        axes |= module_axes
+    if unknown or not axes:
+        return  # no provable mesh in scope — never guess
+    for axis, line in specs:
+        if axis not in axes:
+            findings.append(Finding(
+                NAME, src.path, line,
+                f"PartitionSpec axis {axis!r} is not an axis of any "
+                f"mesh in lexical scope (known axes: "
+                f"{sorted(axes)}) — a stale/misspelled axis name "
+                f"silently replicates instead of sharding"))
+
+
+def _module_level_axes(src, mod) -> Tuple[Set[str], bool]:
+    axes: Set[str] = set()
+    unknown = False
+    for stmt in src.tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        for sub in ast.walk(stmt):
+            if not isinstance(sub, ast.Call):
+                continue
+            mesh_axes = _mesh_axes_of_call(sub, mod)
+            if mesh_axes is not None:
+                if "?" in mesh_axes:
+                    unknown = True
+                else:
+                    axes |= mesh_axes
+    return axes, unknown
+
+
+def _scan_axis_coherence(src, mod, index,
+                         findings: List[Finding]) -> None:
+    module_axes, module_unknown = _module_level_axes(src, mod)
+    # module level as its own scope
+    top = [n for stmt in src.tree.body
+           if not isinstance(stmt, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef, ast.ClassDef))
+           for n in ast.walk(stmt)]
+    if not module_unknown:
+        _check_scope(top, src, mod, None, findings)
+    # each function: own body (non-nested), module axes as fallback
+    for info in index.functions.values():
+        if info.module.src is not src:
+            continue
+        fallback = None if module_unknown else module_axes
+        _check_scope(iter_executed(info.node), src, mod, fallback,
+                     findings)
+
+
+# ---------------------------------------------------------------------------
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in project.files:
+        if _is_shim(src.modname):
+            continue
+        imports = _FileImports(project.index.modules[src.modname])
+        _scan_rot(src, imports, findings)
+        _scan_axis_coherence(src, imports, project.index, findings)
+    return findings
